@@ -1,0 +1,41 @@
+"""tensorframes-tpu: manipulate columnar DataFrames with compiled tensor
+programs on TPU.
+
+A brand-new TPU-native framework with the capabilities of TensorFrames (the
+reference at shobhit-agarwal/tensorframes): ``map_blocks``, ``map_rows``,
+``reduce_blocks``, ``reduce_rows`` and keyed ``aggregate`` over blocks of
+DataFrame rows, plus shape analysis (``analyze``, ``print_schema``) and an
+embedded operator DSL. Computations are captured as JAX programs (serialized
+as StableHLO), compiled by XLA, and executed on TPU; distribution rides a
+``jax.sharding.Mesh`` with ICI collectives instead of a Spark reduce-tree.
+
+Core API (parity with reference ``__init__.py:15-27``):
+
+ - map_rows: adds extra columns one row at a time
+ - map_blocks: adds extra columns block by block
+ - reduce_rows: applies a transform on pairs of rows until one row is left
+ - reduce_blocks: applies a transform on blocks of rows until one row is left
+ - aggregate: algebraic aggregation of blocks of rows grouped by key
+ - analyze: shape analysis of all numerical data in a dataframe
+ - print_schema: prints the schema with tensor metadata
+
+Auto-placeholder helpers (``block``, ``row``) build DSL placeholders shaped
+from a DataFrame column, mirroring reference ``core.py:302-355``.
+"""
+
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from .shape import Shape, Unknown
+from . import dtypes
+from .schema import Field, Schema
+
+__all__ = [
+    "Shape",
+    "Unknown",
+    "Field",
+    "Schema",
+    "dtypes",
+    "__version__",
+]

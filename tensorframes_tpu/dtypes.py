@@ -1,0 +1,166 @@
+"""Scalar dtype registry and host/device dtype policy.
+
+The reference supports exactly Double / Int / Long in its engine
+(``/root/reference/src/main/scala/org/tensorframes/impl/datatypes.scala:202-239``)
+plus Float at the Python boundary (``core.py:357-360``). This module keeps the
+same user-facing dtype vocabulary but separates:
+
+- **storage dtype**: how column data lives in host columnar buffers (numpy);
+- **device dtype**: what the TPU actually computes in.
+
+TPUs have no fp64 ALUs; ``double`` columns compute in float32 on TPU (or
+float64 on CPU when jax x64 mode is on) and are cast back on collect. This is
+the TPU-native substitute for the reference's one-converter-per-scalar design
+(``ScalarTypeOperation``), where the cast is an explicit, documented policy
+instead of a JNI buffer-fill specialization.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+import numpy as np
+
+__all__ = [
+    "DType",
+    "double",
+    "float32",
+    "int32",
+    "int64",
+    "bfloat16",
+    "by_name",
+    "from_numpy",
+    "from_python_value",
+    "supported_dtypes",
+    "widen",
+    "device_dtype",
+]
+
+
+@dataclass(frozen=True)
+class DType:
+    """A framework scalar type.
+
+    ``name`` is the canonical user-facing name; ``np_storage`` the host
+    columnar dtype; ``priority`` orders numeric widening (wider wins).
+    """
+
+    name: str
+    np_storage: np.dtype
+    priority: int
+
+    def __repr__(self) -> str:
+        return self.name
+
+    @property
+    def is_floating(self) -> bool:
+        return np.issubdtype(self.np_storage, np.floating)
+
+    @property
+    def itemsize(self) -> int:
+        return self.np_storage.itemsize
+
+
+double = DType("double", np.dtype(np.float64), 40)
+float32 = DType("float", np.dtype(np.float32), 30)
+int64 = DType("long", np.dtype(np.int64), 20)
+int32 = DType("int", np.dtype(np.int32), 10)
+# bfloat16 is TPU-native extra surface (not in the reference); stored as f32 on
+# host, computed as bf16 on device.
+bfloat16 = DType("bfloat16", np.dtype(np.float32), 25)
+
+_BY_NAME: Dict[str, DType] = {
+    "double": double,
+    "float64": double,
+    "f64": double,
+    "float": float32,
+    "float32": float32,
+    "f32": float32,
+    "long": int64,
+    "int64": int64,
+    "i64": int64,
+    "int": int32,
+    "int32": int32,
+    "i32": int32,
+    "bfloat16": bfloat16,
+    "bf16": bfloat16,
+}
+
+_CORE = (double, float32, int64, int32)
+
+
+def supported_dtypes():
+    return _CORE
+
+
+def by_name(name: str) -> DType:
+    try:
+        return _BY_NAME[name]
+    except KeyError:
+        raise ValueError(
+            f"Unknown dtype {name!r}; supported: {sorted(set(_BY_NAME))}"
+        ) from None
+
+
+def from_numpy(dt) -> DType:
+    """Map a numpy dtype to the framework dtype (widening unsupported ints)."""
+    dt = np.dtype(dt)
+    if dt == np.float64:
+        return double
+    if dt == np.float32:
+        return float32
+    if dt == np.int64:
+        return int64
+    if dt in (np.int32, np.int16, np.int8, np.uint8, np.uint16):
+        return int32
+    if dt.kind == "f":  # float16 etc
+        return float32
+    if str(dt) == "bfloat16":
+        return bfloat16
+    if dt == np.bool_:
+        return int32
+    raise ValueError(f"Unsupported numpy dtype for tensorframes: {dt}")
+
+
+def from_python_value(x) -> DType:
+    if isinstance(x, bool):
+        return int32
+    if isinstance(x, int):
+        return int64
+    if isinstance(x, float):
+        return double
+    if isinstance(x, np.generic):
+        return from_numpy(x.dtype)
+    raise ValueError(f"Unsupported python scalar {type(x)}")
+
+
+def widen(a: DType, b: DType) -> DType:
+    """Numeric widening for mixed-type DSL constants."""
+    if a.is_floating != b.is_floating:
+        return double if (a is double or b is double) else float32
+    return a if a.priority >= b.priority else b
+
+
+def device_dtype(dt: DType, platform: Optional[str] = None) -> np.dtype:
+    """The dtype the computation runs in on the target platform.
+
+    - On TPU: double -> float32 (no fp64 ALUs), long -> int32 when x64 is off.
+    - On CPU: follows jax's x64 flag.
+    """
+    import jax
+
+    if platform is None:
+        platform = jax.default_backend()
+    x64 = bool(jax.config.read("jax_enable_x64"))
+    if dt is bfloat16:
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    if dt is double:
+        if platform == "tpu" or not x64:
+            return np.dtype(np.float32)
+        return np.dtype(np.float64)
+    if dt is int64 and not x64:
+        return np.dtype(np.int32)
+    return dt.np_storage

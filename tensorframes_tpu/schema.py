@@ -1,0 +1,261 @@
+"""Schema layer: fields, tensor metadata, and schema pretty-printing.
+
+TPU-native re-design of the reference's column-metadata layer
+(``/root/reference/src/main/scala/org/tensorframes/ColumnInformation.scala``,
+``MetadataConstants.scala``, ``DataFrameInfo.scala``). The reference smuggles
+tensor info (scalar type + block shape) through Spark ``StructField.metadata``
+under the keys ``org.spartf.shape`` / ``org.sparktf.type``; here the DataFrame
+is ours, so tensor info is a first-class part of :class:`Field`, with a
+dict codec (:meth:`Field.to_meta` / :meth:`Field.from_meta`) preserved for
+serialization and for parity with the metadata round-trip semantics.
+
+Conventions carried over from the reference:
+
+- the recorded shape of a column is the **block** shape: leading dim is the
+  number of rows in a block (``Unknown`` in general), remaining dims are the
+  cell shape (``ColumnInformation.scala:76-80``);
+- a scalar column's block shape is ``[?]`` and can be inferred without a data
+  scan; array columns have unknown cell shape until ``analyze`` stamps it;
+- merging column info refines unknown dims with concrete ones
+  (``ColumnInformation.merged``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from . import dtypes as _dt
+from .shape import Shape, Unknown
+
+__all__ = ["Field", "Schema", "SHAPE_KEY", "TYPE_KEY"]
+
+# Metadata keys, kept wire-compatible in spirit with the reference
+# (``MetadataConstants.scala:19,27`` — including its historical 'spartf' typo,
+# which we do not reproduce; our keys are namespaced fresh).
+SHAPE_KEY = "tensorframes.shape"
+TYPE_KEY = "tensorframes.dtype"
+
+
+@dataclass(frozen=True)
+class Field:
+    """One column: name, scalar dtype, and (optionally) its tensor structure.
+
+    ``block_shape`` is the shape of a block of cells from this column — lead
+    dim is the (usually unknown) row count. ``None`` means the tensor
+    structure has not been determined (non-scalar column before ``analyze``).
+    """
+
+    name: str
+    dtype: _dt.DType
+    block_shape: Optional[Shape] = None
+    nullable: bool = False
+    # rank of the *SQL-level* value (0 scalar, 1 array, 2 array-of-array);
+    # retained so un-analyzed array columns still print sensibly.
+    sql_rank: int = 0
+
+    # -- derived -----------------------------------------------------------
+    @property
+    def has_tensor_info(self) -> bool:
+        return self.block_shape is not None
+
+    @property
+    def cell_shape(self) -> Optional[Shape]:
+        if self.block_shape is None:
+            return None
+        return self.block_shape.tail
+
+    def with_block_shape(self, shape: Shape) -> "Field":
+        return replace(self, block_shape=shape, sql_rank=max(0, shape.ndim - 1))
+
+    def merged(self, other: "Field") -> "Field":
+        """Refine this field's info with another's (unknowns filled in).
+
+        Conflicting concrete dims or dtypes raise rather than silently
+        propagating one side into compiled-program shapes.
+        """
+        if other.block_shape is not None and self.dtype is not other.dtype:
+            raise ValueError(
+                f"Cannot merge field {self.name}: dtypes differ "
+                f"({self.dtype} vs {other.dtype})"
+            )
+        if other.block_shape is None:
+            return self
+        if self.block_shape is None:
+            return replace(self, block_shape=other.block_shape,
+                           sql_rank=other.sql_rank)
+        if self.block_shape.ndim != other.block_shape.ndim:
+            raise ValueError(
+                f"Cannot merge field {self.name}: ranks differ "
+                f"({self.block_shape} vs {other.block_shape})"
+            )
+        dims = []
+        for a, b in zip(self.block_shape.dims, other.block_shape.dims):
+            if a != Unknown and b != Unknown and a != b:
+                raise ValueError(
+                    f"Cannot merge field {self.name}: dims conflict "
+                    f"({self.block_shape} vs {other.block_shape})"
+                )
+            dims.append(b if a == Unknown else a)
+        return replace(self, block_shape=Shape(tuple(dims)))
+
+    # -- metadata codec ----------------------------------------------------
+    def to_meta(self) -> Dict[str, object]:
+        meta: Dict[str, object] = {}
+        if self.block_shape is not None:
+            meta[SHAPE_KEY] = list(self.block_shape.dims)
+            meta[TYPE_KEY] = self.dtype.name
+        return meta
+
+    @staticmethod
+    def from_meta(name: str, dtype: _dt.DType, meta: Dict[str, object],
+                  sql_rank: int = 0, nullable: bool = False) -> "Field":
+        shape = None
+        if SHAPE_KEY in meta:
+            shape = Shape(tuple(int(d) for d in meta[SHAPE_KEY]))
+            tname = meta.get(TYPE_KEY)
+            if tname is not None:
+                dtype = _dt.by_name(str(tname))
+            sql_rank = max(0, shape.ndim - 1)
+        f = Field(name=name, dtype=dtype, block_shape=shape, nullable=nullable,
+                  sql_rank=sql_rank)
+        if shape is None and sql_rank == 0:
+            # scalar columns always have derivable block shape [?]
+            f = f.with_block_shape(Shape(Unknown))
+        return f
+
+    # -- display -----------------------------------------------------------
+    def type_string(self) -> str:
+        base = self.dtype.name
+        for _ in range(self.sql_rank):
+            base = f"array<{base}>"
+        return base
+
+    def describe(self) -> str:
+        if self.block_shape is not None:
+            return (f"{self.name}: {self.type_string()} "
+                    f"(shape={self.block_shape})")
+        return f"{self.name}: {self.type_string()} (no tensor info)"
+
+
+def _field_for_scalar(name: str, dtype: _dt.DType) -> Field:
+    return Field(name, dtype, block_shape=Shape(Unknown), sql_rank=0)
+
+
+class Schema:
+    """An ordered collection of fields."""
+
+    __slots__ = ("_fields", "_index")
+
+    def __init__(self, fields: Sequence[Field]):
+        self._fields: List[Field] = list(fields)
+        self._index = {f.name: i for i, f in enumerate(self._fields)}
+        if len(self._index) != len(self._fields):
+            seen, dup = set(), None
+            for f in self._fields:
+                if f.name in seen:
+                    dup = f.name
+                    break
+                seen.add(f.name)
+            raise ValueError(f"Duplicate column name {dup!r} in schema")
+
+    # -- container protocol ------------------------------------------------
+    def __iter__(self):
+        return iter(self._fields)
+
+    def __len__(self):
+        return len(self._fields)
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._index
+
+    def __getitem__(self, key: Union[int, str]) -> Field:
+        if isinstance(key, str):
+            try:
+                return self._fields[self._index[key]]
+            except KeyError:
+                raise KeyError(
+                    f"No column {key!r}; columns: {self.names}"
+                ) from None
+        return self._fields[key]
+
+    def __eq__(self, other):
+        if isinstance(other, Schema):
+            return self._fields == other._fields
+        return NotImplemented
+
+    def __repr__(self):
+        return "Schema(" + ", ".join(f.describe() for f in self._fields) + ")"
+
+    # -- accessors ---------------------------------------------------------
+    @property
+    def fields(self) -> List[Field]:
+        return list(self._fields)
+
+    @property
+    def names(self) -> List[str]:
+        return [f.name for f in self._fields]
+
+    def get(self, name: str) -> Optional[Field]:
+        i = self._index.get(name)
+        return None if i is None else self._fields[i]
+
+    def index_of(self, name: str) -> int:
+        return self._index[name]
+
+    # -- derivations -------------------------------------------------------
+    def select(self, names: Sequence[str]) -> "Schema":
+        return Schema([self[n] for n in names])
+
+    def append(self, fields: Sequence[Field]) -> "Schema":
+        return Schema(self._fields + list(fields))
+
+    def replace_field(self, field: Field) -> "Schema":
+        out = list(self._fields)
+        out[self._index[field.name]] = field
+        return Schema(out)
+
+    def merged(self, other: "Schema") -> "Schema":
+        """Refine tensor info field-by-field (names/positions must match)."""
+        if self.names != other.names:
+            raise ValueError(
+                f"Schema mismatch: {self.names} vs {other.names}"
+            )
+        return Schema([a.merged(b) for a, b in zip(self._fields, other)])
+
+    # -- display (the `explain` / print_schema analogue) -------------------
+    def tree_string(self) -> str:
+        lines = ["root"]
+        for f in self._fields:
+            extra = ""
+            if f.block_shape is not None:
+                extra = f" {f.dtype.name}{f.block_shape!r}"
+            lines.append(
+                f" |-- {f.name}: {f.type_string()} (nullable = "
+                f"{str(f.nullable).lower()}){extra}"
+            )
+        return "\n".join(lines)
+
+    # -- constructors ------------------------------------------------------
+    @staticmethod
+    def of(**cols: Union[str, _dt.DType]) -> "Schema":
+        """Quick scalar-column schema: ``Schema.of(x='double', n='int')``."""
+        fields = []
+        for name, dt in cols.items():
+            if isinstance(dt, str):
+                dt = _dt.by_name(dt)
+            fields.append(_field_for_scalar(name, dt))
+        return Schema(fields)
+
+    @staticmethod
+    def from_numpy_columns(cols: Dict[str, np.ndarray]) -> "Schema":
+        fields = []
+        for name, arr in cols.items():
+            arr = np.asarray(arr)
+            dt = _dt.from_numpy(arr.dtype)
+            shape = Shape((Unknown,) + arr.shape[1:])
+            fields.append(Field(name, dt, block_shape=shape,
+                                sql_rank=arr.ndim - 1))
+        return Schema(fields)

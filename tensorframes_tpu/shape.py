@@ -1,0 +1,229 @@
+"""Tensor shape model with unknown dimensions.
+
+TPU-native re-design of the reference's shape layer
+(``/root/reference/src/main/scala/org/tensorframes/Shape.scala:13-106``): a
+shape is a tuple of dims where ``Unknown`` (-1) marks a dimension whose size is
+not statically known (typically the leading "rows in this block" dimension).
+
+Unlike the reference — whose shapes travel inside TF ``TensorShapeProto``s —
+these shapes are plain Python data that (a) annotate DataFrame column metadata,
+(b) parameterize JAX avals when computations are compiled, and (c) drive the
+padding/bucketing policy that reconciles dynamic block sizes with XLA's static
+shape requirement.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Iterable, Optional, Sequence, Tuple
+
+Unknown: int = -1
+
+__all__ = [
+    "Unknown",
+    "Shape",
+    "HighDimException",
+]
+
+
+class HighDimException(Exception):
+    """Raised when a tensor of unsupported rank is encountered.
+
+    Mirrors the reference's ``HighDimException`` (``Shape.scala:105-106``).
+    """
+
+    def __init__(self, shape: "Shape"):
+        super().__init__(f"Shape {shape} is too high-dimensional for this operation")
+        self.shape = shape
+
+
+class Shape:
+    """An immutable tensor shape; dims may be ``Unknown`` (-1).
+
+    ``Shape.empty`` is the scalar shape (rank 0).
+    """
+
+    __slots__ = ("_dims",)
+
+    empty: "Shape"  # set below
+
+    def __init__(self, *dims: int):
+        if len(dims) == 1 and isinstance(dims[0], (tuple, list, Shape)):
+            dims = tuple(dims[0])
+        d = []
+        for x in dims:
+            xi = int(x)
+            if xi < 0:
+                xi = Unknown
+            d.append(xi)
+        self._dims = tuple(d)
+
+    # -- basic accessors ---------------------------------------------------
+    @property
+    def dims(self) -> Tuple[int, ...]:
+        return self._dims
+
+    @property
+    def ndim(self) -> int:
+        return len(self._dims)
+
+    def __len__(self) -> int:
+        return len(self._dims)
+
+    def __iter__(self):
+        return iter(self._dims)
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return Shape(self._dims[i])
+        return self._dims[i]
+
+    def __eq__(self, other) -> bool:
+        if isinstance(other, Shape):
+            return self._dims == other._dims
+        if isinstance(other, (tuple, list)):
+            return self._dims == tuple(other)
+        return NotImplemented
+
+    def __hash__(self) -> int:
+        # Must match tuple hashing: __eq__ admits tuple/list interop, so a
+        # dict keyed by Shape must also hit on the equal tuple and vice versa.
+        return hash(self._dims)
+
+    def __repr__(self) -> str:
+        inner = ",".join("?" if d == Unknown else str(d) for d in self._dims)
+        return f"[{inner}]"
+
+    # -- predicates --------------------------------------------------------
+    @property
+    def is_scalar(self) -> bool:
+        return len(self._dims) == 0
+
+    @property
+    def has_unknown(self) -> bool:
+        return Unknown in self._dims
+
+    @property
+    def num_elements(self) -> Optional[int]:
+        """Element count, or None if any dim is unknown."""
+        if self.has_unknown:
+            return None
+        return math.prod(self._dims) if self._dims else 1
+
+    # -- derivations -------------------------------------------------------
+    def prepend(self, dim: int) -> "Shape":
+        """New shape with one leading dimension added (block-of-rows shape)."""
+        return Shape((int(dim) if dim >= 0 else Unknown,) + self._dims)
+
+    @property
+    def tail(self) -> "Shape":
+        """Drop the leading dimension (block shape -> cell shape)."""
+        if not self._dims:
+            raise ValueError("cannot take tail of a scalar shape")
+        return Shape(self._dims[1:])
+
+    @property
+    def head(self) -> int:
+        if not self._dims:
+            raise ValueError("scalar shape has no head dimension")
+        return self._dims[0]
+
+    def with_lead(self, dim: int) -> "Shape":
+        """Replace the leading dimension."""
+        if not self._dims:
+            raise ValueError("scalar shape has no lead dimension")
+        return Shape((int(dim) if dim >= 0 else Unknown,) + self._dims[1:])
+
+    # -- compatibility lattice --------------------------------------------
+    def is_more_precise_than(self, other: "Shape") -> bool:
+        """True if self refines ``other``: same rank and every dim of self is
+        either equal to other's or other's is Unknown.
+
+        The precision check from the reference (``Shape.scala:39-44``):
+        a concrete shape is more precise than one with unknowns.
+        """
+        if len(self._dims) != len(other._dims):
+            return False
+        for mine, theirs in zip(self._dims, other._dims):
+            if theirs != Unknown and mine != theirs:
+                return False
+        return True
+
+    def check_more_precise_than(self, other: "Shape", context: str = "") -> None:
+        if not self.is_more_precise_than(other):
+            msg = f"Shape {self} is not at least as precise as {other}"
+            if context:
+                msg += f" ({context})"
+            raise ValueError(msg)
+
+    def merge(self, other: "Shape") -> Optional["Shape"]:
+        """Least-upper-bound of two shapes: dims that disagree become Unknown.
+
+        Returns None when ranks differ (no common shape). This is the per-column
+        merge used by the deep ``analyze`` scan
+        (reference: ``ExperimentalOperations.scala:118-156``).
+        """
+        if len(self._dims) != len(other._dims):
+            return None
+        merged = tuple(
+            a if a == b else Unknown for a, b in zip(self._dims, other._dims)
+        )
+        return Shape(merged)
+
+    def broadcast_with(self, other: "Shape") -> "Shape":
+        """Numpy-style broadcast of two shapes; Unknown dims broadcast to
+        Unknown unless the other side is 1.
+
+        DSL shape inference for binary elementwise ops (the analogue of the
+        reference's ``broadcastShape``, ``dsl/DslImpl.scala:115-132``).
+        """
+        a, b = self._dims, other._dims
+        if len(a) < len(b):
+            a = (1,) * (len(b) - len(a)) + a
+        elif len(b) < len(a):
+            b = (1,) * (len(a) - len(b)) + b
+        out = []
+        for x, y in zip(a, b):
+            if x == 1:
+                out.append(y)
+            elif y == 1:
+                out.append(x)
+            elif x == Unknown or y == Unknown:
+                # Unknown against anything stays Unknown: the unknown side may
+                # still turn out to be 1 and broadcast the other way.
+                out.append(Unknown)
+            elif x == y:
+                out.append(x)
+            else:
+                raise ValueError(f"Cannot broadcast shapes {self} and {other}")
+        return Shape(tuple(out))
+
+    # -- concrete-shape helpers -------------------------------------------
+    def assert_concrete(self, context: str = "") -> Tuple[int, ...]:
+        if self.has_unknown:
+            raise ValueError(
+                f"Shape {self} has unknown dimensions{': ' + context if context else ''}"
+            )
+        return self._dims
+
+    def matches_concrete(self, concrete: Sequence[int]) -> bool:
+        """Does a concrete runtime shape conform to this (possibly unknown)
+        declared shape?"""
+        if len(concrete) != len(self._dims):
+            return False
+        for mine, got in zip(self._dims, concrete):
+            if mine != Unknown and mine != int(got):
+                return False
+        return True
+
+    # -- constructors ------------------------------------------------------
+    @staticmethod
+    def of(dims: Iterable[int]) -> "Shape":
+        return Shape(tuple(dims))
+
+    @staticmethod
+    def scalar() -> "Shape":
+        return Shape()
+
+
+Shape.empty = Shape()

@@ -1,0 +1,27 @@
+"""Test fixture: CPU backend with 8 virtual devices.
+
+The analogue of the reference's shared `local[1]` Spark fixture with
+`spark.sql.shuffle.partitions=4`
+(`TensorFlossTestSparkContext.scala:10-43`): unit tests run on the CPU
+backend of the same code path that targets TPU, and mesh/partition tests use
+8 virtual devices via XLA_FLAGS, per SURVEY.md §4.
+"""
+
+import os
+
+# Must be set before jax is imported anywhere.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)

@@ -1,0 +1,98 @@
+"""Schema/metadata layer tests (ColumnInformation/DataFrameInfo analogue)."""
+
+import numpy as np
+import pytest
+
+from tensorframes_tpu import dtypes as dt
+from tensorframes_tpu.schema import Field, Schema, SHAPE_KEY, TYPE_KEY
+from tensorframes_tpu.shape import Shape, Unknown
+
+
+def test_dtype_registry():
+    assert dt.by_name("double") is dt.double
+    assert dt.by_name("f32") is dt.float32
+    assert dt.from_numpy(np.float64) is dt.double
+    assert dt.from_numpy(np.int16) is dt.int32
+    assert dt.from_python_value(1.5) is dt.double
+    assert dt.from_python_value(3) is dt.int64
+    with pytest.raises(ValueError):
+        dt.by_name("complex128")
+
+
+def test_widen():
+    assert dt.widen(dt.int32, dt.int64) is dt.int64
+    assert dt.widen(dt.float32, dt.double) is dt.double
+    assert dt.widen(dt.int64, dt.float32) is dt.float32
+    assert dt.widen(dt.int32, dt.double) is dt.double
+
+
+def test_scalar_field_block_shape():
+    s = Schema.of(x="double", n="int")
+    assert s["x"].block_shape == Shape(Unknown)
+    assert s["x"].cell_shape == Shape.empty
+    assert s["n"].dtype is dt.int32
+
+
+def test_schema_duplicate_names_rejected():
+    with pytest.raises(ValueError, match="Duplicate"):
+        Schema([Field("x", dt.double), Field("x", dt.int32)])
+
+
+def test_meta_roundtrip():
+    f = Field("v", dt.double).with_block_shape(Shape(Unknown, 3))
+    meta = f.to_meta()
+    assert meta[SHAPE_KEY] == [Unknown, 3]
+    assert meta[TYPE_KEY] == "double"
+    g = Field.from_meta("v", dt.double, meta, sql_rank=1)
+    assert g.block_shape == Shape(Unknown, 3)
+    assert g.cell_shape == Shape(3)
+
+
+def test_field_merge_refines_unknowns():
+    a = Field("v", dt.double).with_block_shape(Shape(Unknown, Unknown))
+    b = Field("v", dt.double).with_block_shape(Shape(Unknown, 3))
+    assert a.merged(b).block_shape == Shape(Unknown, 3)
+    # concrete info wins over none
+    c = Field("v", dt.double, sql_rank=1)
+    assert c.merged(b).block_shape == Shape(Unknown, 3)
+    with pytest.raises(ValueError, match="ranks differ"):
+        a.merged(Field("v", dt.double).with_block_shape(Shape(Unknown)))
+    with pytest.raises(ValueError, match="dims conflict"):
+        b.merged(Field("v", dt.double).with_block_shape(Shape(Unknown, 4)))
+    with pytest.raises(ValueError, match="dtypes differ"):
+        b.merged(Field("v", dt.int32).with_block_shape(Shape(Unknown, 3)))
+
+
+def test_from_meta_derives_sql_rank():
+    f = Field("v", dt.double).with_block_shape(Shape(Unknown, 3))
+    g = Field.from_meta("v", dt.double, f.to_meta())
+    assert g.sql_rank == 1
+    assert g.type_string() == "array<double>"
+
+
+def test_schema_from_numpy_columns():
+    s = Schema.from_numpy_columns({
+        "x": np.zeros((5,), np.float64),
+        "v": np.zeros((5, 3), np.float32),
+    })
+    assert s["x"].block_shape == Shape(Unknown)
+    assert s["v"].block_shape == Shape(Unknown, 3)
+    assert s["v"].sql_rank == 1
+    assert s["v"].type_string() == "array<float>"
+
+
+def test_schema_select_append_replace():
+    s = Schema.of(a="double", b="int", c="long")
+    assert s.select(["c", "a"]).names == ["c", "a"]
+    s2 = s.append([Field("d", dt.float32)])
+    assert s2.names == ["a", "b", "c", "d"]
+    f = s["b"].with_block_shape(Shape(Unknown))
+    assert s.replace_field(f)["b"].block_shape == Shape(Unknown)
+    with pytest.raises(KeyError):
+        s["nope"]
+
+
+def test_tree_string():
+    s = Schema.of(x="double")
+    out = s.tree_string()
+    assert "root" in out and "x: double" in out and "[?]" in out

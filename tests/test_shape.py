@@ -1,0 +1,84 @@
+"""Shape layer unit tests (mirrors reference Shape.scala semantics)."""
+
+import pytest
+
+from tensorframes_tpu.shape import Shape, Unknown
+
+
+def test_construct_and_repr():
+    s = Shape(2, 3)
+    assert s.dims == (2, 3)
+    assert repr(s) == "[2,3]"
+    assert repr(Shape(Unknown, 4)) == "[?,4]"
+    assert Shape.empty.is_scalar
+    assert repr(Shape.empty) == "[]"
+
+
+def test_from_iterable_and_eq():
+    assert Shape([2, 3]) == Shape(2, 3)
+    assert Shape((2, 3)) == (2, 3)
+    assert Shape(2, 3) != Shape(3, 2)
+
+
+def test_negative_dims_normalize_to_unknown():
+    assert Shape(-5, 3).dims == (Unknown, 3)
+
+
+def test_prepend_tail_head_lead():
+    cell = Shape(3)
+    block = cell.prepend(Unknown)
+    assert block == Shape(Unknown, 3)
+    assert block.tail == cell
+    assert block.head == Unknown
+    assert block.with_lead(7) == Shape(7, 3)
+    with pytest.raises(ValueError):
+        Shape.empty.tail
+
+
+def test_num_elements():
+    assert Shape(2, 3).num_elements == 6
+    assert Shape.empty.num_elements == 1
+    assert Shape(Unknown, 3).num_elements is None
+
+
+def test_more_precise_than():
+    # concrete refines unknown
+    assert Shape(5, 3).is_more_precise_than(Shape(Unknown, 3))
+    assert Shape(5, 3).is_more_precise_than(Shape(5, 3))
+    # unknown does not refine concrete
+    assert not Shape(Unknown, 3).is_more_precise_than(Shape(5, 3))
+    # rank mismatch
+    assert not Shape(3).is_more_precise_than(Shape(3, 1))
+    with pytest.raises(ValueError):
+        Shape(Unknown).check_more_precise_than(Shape(4))
+
+
+def test_merge():
+    assert Shape(5, 3).merge(Shape(7, 3)) == Shape(Unknown, 3)
+    assert Shape(5, 3).merge(Shape(5, 3)) == Shape(5, 3)
+    assert Shape(5).merge(Shape(5, 1)) is None
+    assert Shape(Unknown, 3).merge(Shape(2, 3)) == Shape(Unknown, 3)
+
+
+def test_broadcast():
+    assert Shape(5, 3).broadcast_with(Shape(3)) == Shape(5, 3)
+    assert Shape(5, 1).broadcast_with(Shape(1, 3)) == Shape(5, 3)
+    assert Shape.empty.broadcast_with(Shape(4)) == Shape(4)
+    assert Shape(Unknown, 3).broadcast_with(Shape(3)) == Shape(Unknown, 3)
+    # unknown against concrete stays unknown (the concrete side might be 1)
+    assert Shape(Unknown).broadcast_with(Shape(7)) == Shape(Unknown)
+    with pytest.raises(ValueError):
+        Shape(2).broadcast_with(Shape(3))
+
+
+def test_matches_concrete():
+    assert Shape(Unknown, 3).matches_concrete((9, 3))
+    assert not Shape(Unknown, 3).matches_concrete((9, 4))
+    assert not Shape(Unknown, 3).matches_concrete((9,))
+    assert Shape.empty.matches_concrete(())
+
+
+def test_assert_concrete():
+    assert Shape(2, 2).assert_concrete() == (2, 2)
+    with pytest.raises(ValueError):
+        Shape(Unknown).assert_concrete()
