@@ -30,6 +30,12 @@ __version__ = "0.1.0"
 from .shape import Shape, Unknown
 from . import dtypes
 from .schema import Field, Schema
+from .frame import Block, GroupedFrame, Row, TensorFrame
+from .computation import Computation, TensorSpec, analyze_graph
+from .api import (
+    aggregate, analyze, block, explain, frame, map_blocks, map_rows,
+    print_schema, reduce_blocks, reduce_rows, row,
+)
 
 __all__ = [
     "Shape",
@@ -37,5 +43,23 @@ __all__ = [
     "Field",
     "Schema",
     "dtypes",
+    "Block",
+    "GroupedFrame",
+    "Row",
+    "TensorFrame",
+    "Computation",
+    "TensorSpec",
+    "analyze_graph",
+    "map_rows",
+    "map_blocks",
+    "reduce_rows",
+    "reduce_blocks",
+    "aggregate",
+    "analyze",
+    "print_schema",
+    "explain",
+    "block",
+    "row",
+    "frame",
     "__version__",
 ]

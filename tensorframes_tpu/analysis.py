@@ -1,0 +1,97 @@
+"""Shape analysis: the deep-scan ``analyze`` and schema introspection.
+
+Analogue of the reference's ``ExperimentalOperations`` /
+``ExtraOperations.deepAnalyzeDataFrame``
+(``/root/reference/src/main/scala/org/tensorframes/ExperimentalOperations.scala:34-156``):
+walk the data partition by partition, derive every column's cell shape,
+merge within a partition (dims that disagree become Unknown), prepend the
+partition's row count, merge across partitions, and stamp the result into
+the frame's schema metadata — after which block ops can run on non-scalar
+columns without rescanning.
+
+The columnar layout makes the scan cheap: a dense numpy column *is* its own
+shape evidence (one ``.shape`` read per partition instead of a walk over
+every cell); only ragged columns need the per-cell merge.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from .frame import Block, TensorFrame
+from .schema import Field, Schema
+from .shape import Shape, Unknown
+
+__all__ = ["analyze", "print_schema", "explain"]
+
+
+def _column_block_shape(block: Block, name: str) -> Optional[Shape]:
+    """Block-level shape of one column in one partition, or None when the
+    partition is empty (contributes no evidence)."""
+    if block.num_rows == 0:
+        return None
+    col = block.columns[name]
+    if isinstance(col, np.ndarray):
+        return Shape(col.shape)
+    # ragged: merge the per-cell shapes, then prepend the row count
+    cell: Optional[Shape] = None
+    for a in col:
+        s = Shape(np.asarray(a).shape)
+        if cell is None:
+            cell = s
+        else:
+            merged = cell.merge(s)
+            if merged is None:
+                raise ValueError(
+                    f"Column {name!r} mixes cell ranks "
+                    f"({cell} vs {s}); not analyzable")
+            cell = merged
+    assert cell is not None
+    return cell.prepend(block.num_rows)
+
+
+def analyze(df: TensorFrame) -> TensorFrame:
+    """Scan the data and return the same frame with tensor-shape metadata
+    stamped on every column. Nullable/None cells are rejected by the
+    marshalling layer. Eager (it is a full-data scan by design)."""
+    blocks = df.blocks()
+    fields: List[Field] = []
+    for f in df.schema:
+        shapes = [s for s in
+                  (_column_block_shape(b, f.name) for b in blocks)
+                  if s is not None]
+        if not shapes:
+            # no data: only the scalar default survives
+            fields.append(f if f.block_shape is not None
+                          else f.with_block_shape(Shape(Unknown)))
+            continue
+        acc = shapes[0]
+        for s in shapes[1:]:
+            merged = acc.merge(s)
+            if merged is None:
+                raise ValueError(
+                    f"Column {f.name!r} has incompatible shapes across "
+                    f"partitions ({acc} vs {s})")
+            acc = merged
+        # the lead dim is per-partition row count; it only stays concrete
+        # when every partition agrees (merge() already handles that)
+        fields.append(f.with_block_shape(acc))
+    return df.with_schema(Schema(fields))
+
+
+def explain(df: TensorFrame) -> str:
+    """Pretty-print the frame's tensor info (DataFrameInfo.explain
+    analogue, reference ``DataFrameInfo.scala:24-38``)."""
+    lines = [f"TensorFrame with {len(df.schema)} column(s), "
+             f"{df.num_partitions} partition(s):"]
+    for f in df.schema:
+        lines.append(" " + f.describe())
+    return "\n".join(lines)
+
+
+def print_schema(df: TensorFrame) -> None:
+    """Print the schema including tensor metadata
+    (reference ``core.py:258-267``)."""
+    print(df.schema.tree_string())

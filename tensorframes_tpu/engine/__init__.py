@@ -1,0 +1,23 @@
+"""Execution engine: compile-cached block execution + the six core ops.
+
+The analogue of the reference's ``DebugRowOps`` execution layer
+(``/root/reference/src/main/scala/org/tensorframes/impl/DebugRowOps.scala``),
+re-designed for XLA: instead of a C++ TF ``Session`` per partition guarded by
+a global lock, each distinct (computation, block-shape) pair is jit-compiled
+once and cached; partitions then execute as data-parallel XLA launches with
+no interpreter in the loop.
+"""
+
+from .executor import BlockExecutor, default_executor
+from .ops import (
+    map_blocks, map_rows, reduce_blocks, reduce_rows, aggregate,
+    InputNotFoundError, InvalidTypeError, InvalidShapeError,
+)
+from .compaction import CompactionBuffer
+
+__all__ = [
+    "BlockExecutor", "default_executor",
+    "map_blocks", "map_rows", "reduce_blocks", "reduce_rows", "aggregate",
+    "CompactionBuffer",
+    "InputNotFoundError", "InvalidTypeError", "InvalidShapeError",
+]

@@ -1,0 +1,79 @@
+"""Buffered compaction for keyed aggregation.
+
+The contract of the reference's ``TensorFlowUDAF``
+(``DebugRowOps.scala:587-681``): an aggregation buffer collects incoming rows
+and, whenever it reaches ``buffer_size`` (reference hardcodes 10,
+``DebugRowOps.scala:559``), compacts them through one block-reduce down to a
+single partial row; ``merge`` concatenates two buffers and compacts;
+``evaluate`` compacts whatever remains to exactly one row. This bounds the
+memory per group while amortizing the per-call overhead of the reduction
+program over blocks of rows.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["CompactionBuffer", "DEFAULT_BUFFER_SIZE"]
+
+DEFAULT_BUFFER_SIZE = 10
+
+
+class CompactionBuffer:
+    """Accumulates per-column cell arrays; compacts via a block-reduce fn.
+
+    ``reduce_fn`` maps {col: stacked block [k, *cell]} -> {col: cell} — one
+    partial row from a block of k rows.
+    """
+
+    def __init__(self, columns: List[str],
+                 reduce_fn: Callable[[Dict[str, np.ndarray]], Dict[str, np.ndarray]],
+                 buffer_size: int = DEFAULT_BUFFER_SIZE):
+        if buffer_size < 2:
+            raise ValueError("buffer_size must be >= 2")
+        self.columns = list(columns)
+        self.reduce_fn = reduce_fn
+        self.buffer_size = buffer_size
+        self._rows: List[Dict[str, np.ndarray]] = []
+
+    def __len__(self):
+        return len(self._rows)
+
+    def update(self, row: Dict[str, np.ndarray]) -> None:
+        self._rows.append({c: np.asarray(row[c]) for c in self.columns})
+        if len(self._rows) >= self.buffer_size:
+            self.compact()
+
+    def update_block(self, block: Dict[str, np.ndarray], num_rows: int) -> None:
+        """Bulk ingest: reduce a whole block at once, then buffer the partial.
+
+        The TPU-friendly entry point — one program launch per block instead
+        of per row."""
+        if num_rows == 0:
+            return
+        partial = self.reduce_fn({c: np.asarray(block[c])
+                                  for c in self.columns})
+        self._rows.append({c: np.asarray(partial[c]) for c in self.columns})
+        if len(self._rows) >= self.buffer_size:
+            self.compact()
+
+    def merge(self, other: "CompactionBuffer") -> None:
+        self._rows.extend(other._rows)
+        if len(self._rows) >= self.buffer_size:
+            self.compact()
+
+    def compact(self) -> None:
+        if len(self._rows) <= 1:
+            return
+        block = {c: np.stack([r[c] for r in self._rows])
+                 for c in self.columns}
+        partial = self.reduce_fn(block)
+        self._rows = [{c: np.asarray(partial[c]) for c in self.columns}]
+
+    def evaluate(self) -> Dict[str, np.ndarray]:
+        if not self._rows:
+            raise ValueError("Nothing to evaluate: buffer is empty")
+        self.compact()
+        return dict(self._rows[0])

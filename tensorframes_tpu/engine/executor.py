@@ -1,0 +1,136 @@
+"""Block executor: jit-compile-cached execution of computations on blocks.
+
+Replaces the reference's per-partition C++ session path
+(``DebugRowOps.scala:755-794``: convert -> readGraph -> new Session ->
+``tfLock.synchronized { session.Run }`` -> convertBack). The XLA model has no
+session and needs no lock: a computation is compiled once per distinct input
+signature (shape/dtype tuple) and the compiled executable is re-dispatched
+for every block with that signature. The compile cache is the engine's answer
+to the reference's "unknown leading dimension" problem (SURVEY.md §7 hard
+part #1): exact-shape compiles by default, with an optional bucketed-padding
+mode that pads the row dim to the next power of two so streams of odd-sized
+blocks share executables (safe only for row-local computations, hence opt-in;
+reductions and trim never pad).
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+from typing import Dict, Mapping, Optional, Tuple
+
+import jax
+import numpy as np
+
+from .. import dtypes as _dt
+from ..computation import Computation
+
+__all__ = ["BlockExecutor", "default_executor"]
+
+
+def _next_bucket(n: int, minimum: int = 8) -> int:
+    b = minimum
+    while b < n:
+        b *= 2
+    return b
+
+
+class BlockExecutor:
+    """Executes :class:`Computation`s on columnar blocks with a compile cache.
+
+    ``pad_rows``: when True, blocks are padded along the leading (row)
+    dimension to power-of-two buckets before execution and outputs sliced
+    back — one compile serves many block sizes. Only valid for computations
+    whose per-row outputs do not depend on other rows.
+    """
+
+    def __init__(self, pad_rows: bool = False, donate: bool = True):
+        self.pad_rows = pad_rows
+        self._donate = donate
+        # Keyed by the live Computation object (weakly): entries die with the
+        # computation, so neither unbounded growth nor stale reuse after
+        # CPython id() recycling is possible.
+        self._cache: "weakref.WeakKeyDictionary[Computation, Dict[Tuple, object]]" = \
+            weakref.WeakKeyDictionary()
+        self._lock = threading.Lock()
+        self.compile_count = 0  # observability: distinct signatures compiled
+
+    # -- compile cache -----------------------------------------------------
+    def _compiled(self, comp: Computation, sig: Tuple):
+        per_comp = self._cache.get(comp)
+        fn = None if per_comp is None else per_comp.get(sig)
+        if fn is None:
+            with self._lock:
+                per_comp = self._cache.setdefault(comp, {})
+                fn = per_comp.get(sig)
+                if fn is None:
+                    fn = jax.jit(comp.fn)
+                    per_comp[sig] = fn
+                    self.compile_count += 1
+        return fn
+
+    # -- execution ---------------------------------------------------------
+    def run(self, comp: Computation,
+            arrays: Mapping[str, np.ndarray],
+            pad_ok: bool = True) -> Dict[str, np.ndarray]:
+        """Run a computation on host arrays; returns host arrays.
+
+        Inputs are cast to their device dtypes (double -> f32 on TPU) and
+        outputs cast back to the computation's declared storage dtypes.
+        """
+        dev_arrays = {}
+        n_rows = None
+        for spec in comp.inputs:
+            a = np.asarray(arrays[spec.name])
+            dd = _dt.device_dtype(spec.dtype)
+            if a.dtype != dd:
+                a = a.astype(dd)
+            dev_arrays[spec.name] = a
+            if spec.shape.ndim > 0 and spec.shape.head == -1:
+                n_rows = a.shape[0] if n_rows is None else n_rows
+
+        pad_to = None
+        if self.pad_rows and pad_ok and n_rows is not None:
+            pad_to = _next_bucket(n_rows)
+            if pad_to != n_rows:
+                padded = {}
+                for spec in comp.inputs:
+                    a = dev_arrays[spec.name]
+                    if spec.shape.ndim > 0 and spec.shape.head == -1:
+                        pad = [(0, pad_to - n_rows)] + [(0, 0)] * (a.ndim - 1)
+                        a = np.pad(a, pad, mode="edge")
+                    padded[spec.name] = a
+                dev_arrays = padded
+
+        sig = tuple(sorted(
+            (n, a.shape, str(a.dtype)) for n, a in dev_arrays.items()))
+        fn = self._compiled(comp, sig)
+        out = fn(dev_arrays)
+        result: Dict[str, np.ndarray] = {}
+        for spec in comp.outputs:
+            a = np.asarray(out[spec.name])
+            if pad_to is not None and spec.shape.ndim > 0 \
+                    and spec.shape.head == -1 and a.shape[:1] == (pad_to,):
+                a = a[:n_rows]
+            storage = spec.dtype.np_storage
+            if a.dtype != storage and spec.dtype is not _dt.bfloat16:
+                a = a.astype(storage)
+            result[spec.name] = a
+        return result
+
+    def clear(self):
+        with self._lock:
+            self._cache.clear()
+
+
+_default: Optional[BlockExecutor] = None
+_default_lock = threading.Lock()
+
+
+def default_executor() -> BlockExecutor:
+    global _default
+    if _default is None:
+        with _default_lock:
+            if _default is None:
+                _default = BlockExecutor()
+    return _default

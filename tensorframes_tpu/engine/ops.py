@@ -1,0 +1,553 @@
+"""The six core operations: map/reduce/aggregate over TensorFrames.
+
+Engine analogue of the reference's ``DebugRowOps`` + ``SchemaTransforms``
+(``/root/reference/src/main/scala/org/tensorframes/impl/DebugRowOps.scala``),
+with the same user-visible contracts:
+
+- ``map_blocks`` / ``map_rows`` are **lazy**, append output columns **sorted
+  by name** (``DebugRowOps.scala:344-355``), and reject fetch names that
+  collide with existing columns;
+- ``map_blocks(trim=True)`` returns only the fetch columns and may change the
+  number of rows;
+- ``reduce_blocks`` requires, for each fetch ``z``, an input ``z_input`` of
+  rank one higher (``core.py:234-237``); ``reduce_rows`` requires inputs
+  ``z_1``/``z_2`` of the fetch's own shape (``core.py:109-111``); both are
+  **eager** and reduce per-partition first, then combine partials — the
+  reference's Spark tree-reduce becomes a single stacked block-reduce (the
+  combine order is unspecified by contract);
+- ``aggregate`` groups by key columns and reduces each group with the
+  buffered-compaction contract of the reference's UDAF
+  (``DebugRowOps.scala:587-681``).
+
+Validation errors mirror ``Operations.scala:7-15``'s exception taxonomy.
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Callable, Dict, List, Mapping, Optional, Sequence, Tuple, Union
+
+import jax
+import numpy as np
+
+from .. import dtypes as _dt
+from ..computation import Computation, TensorSpec
+from ..frame import Block, GroupedFrame, Row, TensorFrame
+from ..marshal import Column
+from ..schema import Field, Schema
+from ..shape import Shape, Unknown
+from .compaction import CompactionBuffer, DEFAULT_BUFFER_SIZE
+from .executor import BlockExecutor, default_executor
+
+__all__ = [
+    "map_blocks", "map_rows", "reduce_blocks", "reduce_rows", "aggregate",
+    "InputNotFoundError", "InvalidTypeError", "InvalidShapeError",
+]
+
+
+class InputNotFoundError(ValueError):
+    """A computation input has no matching DataFrame column
+    (``Operations.scala:7-9`` InputNotFoundException analogue)."""
+
+
+class InvalidTypeError(ValueError):
+    """Column/input dtype mismatch — no implicit casting is performed
+    (``Operations.scala:13-15`` InvalidTypeException analogue)."""
+
+
+class InvalidShapeError(ValueError):
+    """Column/input shape incompatibility
+    (``Operations.scala:10-12`` InvalidDimensionException analogue)."""
+
+
+Fetches = Union[Computation, Callable]
+
+
+# ---------------------------------------------------------------------------
+# Computation adaptation: callables -> Computation bound to the frame schema
+# ---------------------------------------------------------------------------
+
+def _callable_input_names(fn: Callable) -> List[str]:
+    sig = inspect.signature(fn)
+    names = []
+    for p in sig.parameters.values():
+        if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD,
+                      p.KEYWORD_ONLY):
+            names.append(p.name)
+        else:
+            raise ValueError(
+                f"Cannot derive computation inputs from *args/**kwargs "
+                f"parameter {p.name!r}; pass a Computation instead")
+    return names
+
+
+def _dsl_to_computation(fetches, schema: Schema, block_level: bool):
+    """Hook for DSL nodes (duck-typed); implemented in tensorframes_tpu.dsl."""
+    from ..dsl import lower as _dsl_lower  # local import; cycle-free
+    return _dsl_lower.nodes_to_computation(fetches, schema, block_level)
+
+
+def _is_dsl(fetches) -> bool:
+    if isinstance(fetches, (list, tuple)) and fetches:
+        fetches = fetches[0]
+    return hasattr(fetches, "_tft_dsl_node")
+
+
+def _field_spec(field: Field, block_level: bool, context: str) -> Shape:
+    if field.block_shape is None:
+        raise InvalidShapeError(
+            f"Column {field.name!r} has no tensor shape information; run "
+            f"analyze() on the frame first ({context})")
+    return field.block_shape if block_level else field.block_shape.tail
+
+
+def _map_computation(fetches: Fetches, schema: Schema,
+                     block_level: bool) -> Computation:
+    if isinstance(fetches, Computation):
+        return fetches
+    if _is_dsl(fetches):
+        return _dsl_to_computation(fetches, schema, block_level)
+    if callable(fetches):
+        names = _callable_input_names(fetches)
+        specs = []
+        for n in names:
+            field = schema.get(n)
+            if field is None:
+                raise InputNotFoundError(
+                    f"Computation input {n!r} found no matching column; "
+                    f"columns: {schema.names}")
+            specs.append(TensorSpec(
+                n, field.dtype, _field_spec(field, block_level, "map")))
+        return Computation.trace(fetches, specs)
+    raise TypeError(f"Unsupported fetches object: {type(fetches)}")
+
+
+def _reduce_computation(fetches: Fetches, schema: Schema,
+                        suffixes: Sequence[str],
+                        block_level: bool) -> Computation:
+    """Build/check a reduce computation whose inputs are derived from fetch
+    names + naming-contract suffixes ('_input' or '_1'/'_2')."""
+    if isinstance(fetches, Computation):
+        return fetches
+    if _is_dsl(fetches):
+        from ..dsl import lower as _dsl_lower
+        return _dsl_lower.nodes_to_reduce_computation(
+            fetches, schema, suffixes, block_level)
+    if callable(fetches):
+        names = _callable_input_names(fetches)
+        specs = []
+        for n in names:
+            base = _strip_suffix(n, suffixes)
+            if base is None:
+                raise InputNotFoundError(
+                    f"Reduce input {n!r} does not follow the naming "
+                    f"contract (expected one of "
+                    f"{[f'<col>{s}' for s in suffixes]})")
+            field = schema.get(base)
+            if field is None:
+                raise InputNotFoundError(
+                    f"Reduce input {n!r}: no column named {base!r}; "
+                    f"columns: {schema.names}")
+            shape = _field_spec(field, block_level, "reduce")
+            specs.append(TensorSpec(n, field.dtype, shape))
+        return Computation.trace(fetches, specs)
+    raise TypeError(f"Unsupported fetches object: {type(fetches)}")
+
+
+def _strip_suffix(name: str, suffixes: Sequence[str]) -> Optional[str]:
+    for s in suffixes:
+        if name.endswith(s) and len(name) > len(s):
+            return name[: -len(s)]
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Schema validation (SchemaTransforms analogue)
+# ---------------------------------------------------------------------------
+
+def _validate_map(comp: Computation, schema: Schema, block_level: bool,
+                  trim: bool) -> Schema:
+    for spec in comp.inputs:
+        field = schema.get(spec.name)
+        if field is None:
+            raise InputNotFoundError(
+                f"Computation input {spec.name!r} found no matching column; "
+                f"columns: {schema.names}")
+        if field.dtype is not spec.dtype:
+            raise InvalidTypeError(
+                f"Column {spec.name!r} has type {field.dtype} but the "
+                f"computation expects {spec.dtype}; no implicit casting is "
+                f"performed")
+        declared = _field_spec(field, block_level, "map")
+        if not declared.is_more_precise_than(spec.shape) and \
+                not spec.shape.is_more_precise_than(declared):
+            raise InvalidShapeError(
+                f"Column {spec.name!r} shape {declared} is incompatible "
+                f"with computation input shape {spec.shape}")
+    out_fields = []
+    for spec in comp.outputs:  # already sorted by name
+        if not trim and spec.name in schema:
+            raise ValueError(
+                f"Fetch name {spec.name!r} collides with an existing "
+                f"column; fetch names must differ from all input columns")
+        shape = spec.shape
+        if block_level:
+            if shape.ndim == 0:
+                raise InvalidShapeError(
+                    f"Fetch {spec.name!r} is a scalar; block-level outputs "
+                    f"must have a leading row dimension")
+            shape = shape.with_lead(Unknown)
+        else:
+            shape = shape.prepend(Unknown)
+        out_fields.append(Field(spec.name, spec.dtype, block_shape=shape,
+                                sql_rank=max(0, shape.ndim - 1)))
+    if trim:
+        return Schema(out_fields)
+    return schema.append(out_fields)
+
+
+def _validate_reduce(comp: Computation, schema: Schema,
+                     suffixes: Sequence[str], rank_delta: int) -> None:
+    """Check the reduce naming contract (reduceBlocksSchema /
+    reduceRowsSchema analogue, ``DebugRowOps.scala:76-258``)."""
+    fetch_names = set(comp.output_names)
+    consumed = set()
+    for spec in comp.inputs:
+        base = _strip_suffix(spec.name, suffixes)
+        if base is None or base not in fetch_names:
+            raise InputNotFoundError(
+                f"Reduce input {spec.name!r} does not correspond to any "
+                f"fetch; fetches: {sorted(fetch_names)} with suffixes "
+                f"{list(suffixes)}")
+        field = schema.get(base)
+        if field is None:
+            raise InputNotFoundError(
+                f"Reduce fetch {base!r} has no matching column; columns: "
+                f"{schema.names}")
+        if field.dtype is not spec.dtype:
+            raise InvalidTypeError(
+                f"Column {base!r} has type {field.dtype} but reduce input "
+                f"{spec.name!r} expects {spec.dtype}")
+        out_spec = comp.output(base)
+        if out_spec.dtype is not spec.dtype:
+            raise InvalidTypeError(
+                f"Fetch {base!r} dtype {out_spec.dtype} differs from its "
+                f"input {spec.name!r} dtype {spec.dtype}")
+        if spec.shape.ndim != out_spec.shape.ndim + rank_delta:
+            raise InvalidShapeError(
+                f"Reduce input {spec.name!r} has rank {spec.shape.ndim}; "
+                f"expected fetch rank + {rank_delta} = "
+                f"{out_spec.shape.ndim + rank_delta}")
+        consumed.add(base)
+    for f in comp.output_names:
+        missing = [f + s for s in suffixes if not any(
+            i.name == f + s for i in comp.inputs)]
+        if missing:
+            raise InputNotFoundError(
+                f"Fetch {f!r} is missing required reduce input(s) "
+                f"{missing}")
+    unused = [n for n in schema.names if n not in consumed]
+    if unused:
+        raise InputNotFoundError(
+            f"Columns {unused} are not consumed by the reduction; drop them "
+            f"with select() first (every column must back a fetch)")
+
+
+# ---------------------------------------------------------------------------
+# map_blocks
+# ---------------------------------------------------------------------------
+
+def map_blocks(fetches: Fetches, df: TensorFrame, trim: bool = False,
+               executor: Optional[BlockExecutor] = None) -> TensorFrame:
+    """Transform a frame block-by-block, appending (or, with ``trim``,
+    replacing with) the computation's outputs. Lazy."""
+    ex = executor or default_executor()
+    comp = _map_computation(fetches, df.schema, block_level=True)
+    out_schema = _validate_map(comp, df.schema, block_level=True, trim=trim)
+    in_names = comp.input_names
+    fetch_names = comp.output_names
+
+    def run_block(b: Block) -> Block:
+        if b.num_rows == 0:
+            # Empty-partition guard (reference DebugRowOps.scala:374-385):
+            # emit an empty block of the right schema without executing.
+            cols: Dict[str, Column] = {}
+            for f in out_schema:
+                cell = f.cell_shape
+                dims = tuple(0 if d == Unknown else d
+                             for d in (cell.dims if cell else ()))
+                cols[f.name] = np.empty((0,) + dims, f.dtype.np_storage)
+            return Block(cols, 0)
+        arrays = {n: b.dense(n) for n in in_names}
+        # trim may legally change the row count; padding would corrupt it,
+        # and non-row-local computations must see the true block.
+        out = ex.run(comp, arrays, pad_ok=not trim)
+        lead = {out[f].shape[0] for f in fetch_names}
+        if len(lead) > 1:
+            raise InvalidShapeError(
+                f"Fetches disagree on output row count: "
+                f"{ {f: out[f].shape[0] for f in fetch_names} }")
+        n_out = lead.pop()
+        if not trim and n_out != b.num_rows:
+            raise InvalidShapeError(
+                f"map_blocks output has {n_out} rows for a {b.num_rows}-row "
+                f"block; use trim=True for row-count-changing computations")
+        if trim:
+            return Block({f: out[f] for f in fetch_names}, n_out)
+        cols = dict(b.columns)
+        cols.update({f: out[f] for f in fetch_names})
+        return Block(cols, b.num_rows)
+
+    return TensorFrame(out_schema,
+                       lambda: [run_block(b) for b in df.blocks()],
+                       df.num_partitions,
+                       plan=f"map_blocks({df._plan})")
+
+
+# ---------------------------------------------------------------------------
+# map_rows
+# ---------------------------------------------------------------------------
+
+def map_rows(fetches: Fetches, df: TensorFrame,
+             executor: Optional[BlockExecutor] = None) -> TensorFrame:
+    """Transform a frame row-by-row, appending output columns. Lazy.
+
+    Dense blocks take a vectorized path (``jax.vmap`` over the row dim — one
+    compile per block signature instead of the reference's one
+    ``Session.Run`` per row, ``DebugRowOps.scala:810-841``); ragged columns
+    fall back to genuine per-row execution, which is what makes
+    variable-length cells work.
+    """
+    ex = executor or default_executor()
+    comp = _map_computation(fetches, df.schema, block_level=False)
+    out_schema = _validate_map(comp, df.schema, block_level=False, trim=False)
+    in_names = comp.input_names
+    fetch_names = comp.output_names
+
+    vcomp = Computation(
+        lambda d: jax.vmap(comp.fn)(d),
+        [TensorSpec(s.name, s.dtype, s.shape.prepend(Unknown))
+         for s in comp.inputs],
+        [TensorSpec(s.name, s.dtype, s.shape.prepend(Unknown))
+         for s in comp.outputs])
+
+    def run_block(b: Block) -> Block:
+        if b.num_rows == 0:
+            cols = dict(b.columns)
+            for f in comp.outputs:
+                dims = tuple(0 if d == Unknown else d for d in f.shape.dims)
+                cols[f.name] = np.empty((0,) + dims, f.dtype.np_storage)
+            return Block(cols, 0)
+        dense = all(not b.is_ragged(n) for n in in_names)
+        if dense:
+            arrays = {n: b.dense(n) for n in in_names}
+            out = ex.run(vcomp, arrays)
+            cols = dict(b.columns)
+            cols.update({f: out[f] for f in fetch_names})
+            return Block(cols, b.num_rows)
+        # ragged: per-row execution, compile cache keyed by cell signature
+        per_row: Dict[str, List[np.ndarray]] = {f: [] for f in fetch_names}
+        for i in range(b.num_rows):
+            cells = {n: np.asarray(b.columns[n][i]) for n in in_names}
+            out = ex.run(comp, cells, pad_ok=False)
+            for f in fetch_names:
+                per_row[f].append(out[f])
+        cols = dict(b.columns)
+        for f in fetch_names:
+            arrays = per_row[f]
+            shapes = {a.shape for a in arrays}
+            cols[f] = (np.stack(arrays) if len(shapes) == 1
+                       else arrays)
+        return Block(cols, b.num_rows)
+
+    return TensorFrame(out_schema,
+                       lambda: [run_block(b) for b in df.blocks()],
+                       df.num_partitions,
+                       plan=f"map_rows({df._plan})")
+
+
+# ---------------------------------------------------------------------------
+# reduce_blocks / reduce_rows
+# ---------------------------------------------------------------------------
+
+def reduce_blocks(fetches: Fetches, df: TensorFrame,
+                  executor: Optional[BlockExecutor] = None) -> Dict[str, np.ndarray]:
+    """Reduce the whole frame to one row. Eager.
+
+    Per-partition block-reduce, then one combine over the stacked partials —
+    the reference's Spark tree-reduce (``DebugRowOps.scala:511-512``)
+    collapses to a single second-level reduce since the combine order is
+    contractually unspecified.
+    """
+    ex = executor or default_executor()
+    comp = _reduce_computation(fetches, df.schema, ("_input",),
+                               block_level=True)
+    _validate_reduce(comp, df.schema, ("_input",), rank_delta=1)
+    fetch_names = comp.output_names
+
+    partials: List[Dict[str, np.ndarray]] = []
+    for b in df.blocks():
+        if b.num_rows == 0:
+            continue  # empty-partition guard (reference :477-479)
+        arrays = {f + "_input": b.dense(f) for f in fetch_names}
+        partials.append(ex.run(comp, arrays, pad_ok=False))
+    if not partials:
+        raise ValueError("reduce_blocks on an empty frame")
+    if len(partials) == 1:
+        return partials[0]
+    stacked = {f + "_input": np.stack([p[f] for p in partials])
+               for f in fetch_names}
+    return ex.run(comp, stacked, pad_ok=False)
+
+
+def reduce_rows(fetches: Fetches, df: TensorFrame,
+                executor: Optional[BlockExecutor] = None) -> Dict[str, np.ndarray]:
+    """Pairwise-reduce the whole frame to one row. Eager.
+
+    Contract: for fetch ``z``, inputs ``z_1``/``z_2`` with z's shape/dtype;
+    combine order unspecified (reference ``core.py:96-97``). Dense partitions
+    fold in a single compiled ``lax.scan`` (the per-partition sequential fold
+    of ``performReducePairwise``, ``DebugRowOps.scala:895-932``, without a
+    session call per row); partials then fold pairwise across partitions.
+    """
+    ex = executor or default_executor()
+    comp = _reduce_computation(fetches, df.schema, ("_1", "_2"),
+                               block_level=False)
+    _validate_reduce(comp, df.schema, ("_1", "_2"), rank_delta=0)
+    fetch_names = comp.output_names
+
+    def scan_comp() -> Computation:
+        def fold(d: Mapping[str, np.ndarray]):
+            init = {f: d[f][0] for f in fetch_names}
+            xs = {f: d[f][1:] for f in fetch_names}
+
+            def step(carry, x):
+                feeds = {f + "_1": carry[f] for f in fetch_names}
+                feeds.update({f + "_2": x[f] for f in fetch_names})
+                out = comp.fn(feeds)
+                return {f: out[f] for f in fetch_names}, ()
+
+            carry, _ = jax.lax.scan(step, init, xs)
+            return carry
+
+        return Computation(
+            fold,
+            [TensorSpec(f, comp.output(f).dtype,
+                        comp.output(f).shape.prepend(Unknown))
+             for f in fetch_names],
+            list(comp.outputs))
+
+    folder = scan_comp()
+    partials: List[Dict[str, np.ndarray]] = []
+    for b in df.blocks():
+        if b.num_rows == 0:
+            continue
+        dense = all(not b.is_ragged(f) for f in fetch_names)
+        if dense:
+            partials.append(ex.run(folder, {f: b.dense(f)
+                                            for f in fetch_names},
+                            pad_ok=False))
+        else:
+            acc = {f: np.asarray(b.columns[f][0]) for f in fetch_names}
+            for i in range(1, b.num_rows):
+                feeds = {f + "_1": acc[f] for f in fetch_names}
+                feeds.update({f + "_2": np.asarray(b.columns[f][i])
+                              for f in fetch_names})
+                acc = ex.run(comp, feeds, pad_ok=False)
+            partials.append(acc)
+    if not partials:
+        raise ValueError("reduce_rows on an empty frame")
+    acc = partials[0]
+    for p in partials[1:]:
+        feeds = {f + "_1": acc[f] for f in fetch_names}
+        feeds.update({f + "_2": p[f] for f in fetch_names})
+        acc = ex.run(comp, feeds, pad_ok=False)
+    return acc
+
+
+# ---------------------------------------------------------------------------
+# aggregate
+# ---------------------------------------------------------------------------
+
+def aggregate(fetches: Fetches, grouped: GroupedFrame,
+              buffer_size: int = DEFAULT_BUFFER_SIZE,
+              executor: Optional[BlockExecutor] = None) -> TensorFrame:
+    """Algebraic keyed aggregation: for each distinct key, reduce the
+    group's rows with the fetch computation (reduce_blocks contract).
+
+    The shuffle is a host-side sort-by-key (the Catalyst groupBy shuffle of
+    the reference, ``DebugRowOps.scala:533-578``); each group then reduces
+    through a :class:`CompactionBuffer` honoring the UDAF buffered-compaction
+    contract (buffer_size=10 by default, ``DebugRowOps.scala:559``).
+    """
+    ex = executor or default_executor()
+    df = grouped.frame
+    keys = grouped.keys
+    value_schema = df.schema.select(
+        [n for n in df.schema.names if n not in keys])
+    comp = _reduce_computation(fetches, value_schema, ("_input",),
+                               block_level=True)
+    _validate_reduce(comp, value_schema, ("_input",), rank_delta=1)
+    fetch_names = comp.output_names
+
+    def reduce_fn(block: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        return ex.run(comp, {f + "_input": block[f] for f in fetch_names},
+                      pad_ok=False)
+
+    merged = Block.concat(df.blocks(), df.schema)
+    for k in keys:
+        if merged.is_ragged(k):
+            raise InvalidTypeError(f"Key column {k!r} must be scalar-typed")
+    key_arrays = [merged.dense(k) for k in keys]
+    for k, a in zip(keys, key_arrays):
+        if a.ndim != 1:
+            raise InvalidTypeError(f"Key column {k!r} must be scalar-typed")
+
+    n = merged.num_rows
+    if n == 0:
+        out_fields = [df.schema[k] for k in keys] + [
+            Field(s.name, s.dtype,
+                  block_shape=s.shape.prepend(Unknown),
+                  sql_rank=s.shape.ndim)
+            for s in comp.outputs]
+        return TensorFrame.from_blocks(
+            [Block({f.name: np.empty((0,), f.dtype.np_storage)
+                    for f in out_fields}, 0)], Schema(out_fields))
+
+    # sort-by-key "shuffle", then contiguous segments per distinct key
+    order = np.lexsort(tuple(reversed(key_arrays)))
+    sorted_keys = [a[order] for a in key_arrays]
+    changed = np.zeros(n, dtype=bool)
+    changed[0] = True
+    for a in sorted_keys:
+        changed[1:] |= a[1:] != a[:-1]
+    seg_starts = np.flatnonzero(changed)
+    seg_ends = np.append(seg_starts[1:], n)
+
+    fetch_blocks = {f: merged.dense(f)[order] for f in fetch_names}
+    out_rows: Dict[str, List[np.ndarray]] = {f: [] for f in fetch_names}
+    key_rows: Dict[str, List] = {k: [] for k in keys}
+    for a, bnd in zip(seg_starts, seg_ends):
+        buf = CompactionBuffer(fetch_names, reduce_fn, buffer_size)
+        # chunk at buffer_size so large groups reuse one compile signature
+        for c in range(a, bnd, buffer_size):
+            d = min(c + buffer_size, bnd)
+            buf.update_block({f: fetch_blocks[f][c:d] for f in fetch_names},
+                             d - c)
+        result = buf.evaluate()
+        for f in fetch_names:
+            out_rows[f].append(result[f])
+        for k, arr in zip(keys, sorted_keys):
+            key_rows[k].append(arr[a])
+
+    cols: Dict[str, np.ndarray] = {}
+    for k in keys:
+        cols[k] = np.asarray(key_rows[k])
+    for f in fetch_names:
+        cols[f] = np.stack(out_rows[f])
+    out_fields = [df.schema[k] for k in keys] + [
+        Field(s.name, s.dtype, block_shape=s.shape.prepend(Unknown),
+              sql_rank=s.shape.ndim)
+        for s in comp.outputs]
+    return TensorFrame.from_blocks([Block(cols, len(seg_starts))],
+                                   Schema(out_fields))

@@ -5,18 +5,26 @@ The analogue of the reference's shared `local[1]` Spark fixture with
 (`TensorFlossTestSparkContext.scala:10-43`): unit tests run on the CPU
 backend of the same code path that targets TPU, and mesh/partition tests use
 8 virtual devices via XLA_FLAGS, per SURVEY.md §4.
+
+Note: this image's sitecustomize registers the TPU (axon) backend at
+interpreter startup and exports JAX_PLATFORMS=axon, so plain env-var
+overrides are too late/ignored; `jax.config.update` before first backend use
+is the reliable switch. x64 is enabled so `double`/`long` columns stay exact
+in tests (on real TPU they compute as f32/i32 by policy — see dtypes.py).
 """
 
 import os
 
-# Must be set before jax is imported anywhere.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
-os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

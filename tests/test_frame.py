@@ -1,0 +1,118 @@
+"""TensorFrame + marshalling tests."""
+
+import numpy as np
+import pytest
+
+from tensorframes_tpu import dtypes as dt
+from tensorframes_tpu.frame import Block, Row, TensorFrame, frame
+from tensorframes_tpu.marshal import (
+    columns_to_rows, infer_physical_shape, rows_to_columns)
+from tensorframes_tpu.schema import Field, Schema
+from tensorframes_tpu.shape import Shape, Unknown
+
+
+def test_infer_physical_shape():
+    assert infer_physical_shape(12, Shape(Unknown, 3)) == (4, 3)
+    assert infer_physical_shape(6, Shape(2, 3)) == (2, 3)
+    assert infer_physical_shape(0, Shape(Unknown, 3)) == (0, 3)
+    with pytest.raises(ValueError, match="cannot fill"):
+        infer_physical_shape(7, Shape(Unknown, 3))
+    with pytest.raises(ValueError, match="does not match"):
+        infer_physical_shape(5, Shape(2, 3))
+    with pytest.raises(ValueError, match="multiple unknown"):
+        infer_physical_shape(6, Shape(Unknown, Unknown))
+
+
+def test_rows_to_columns_fast_and_back():
+    s = Schema.of(x="double", n="int")
+    rows = [(1.0, 1), (2.0, 2), (3.0, 3)]
+    cols = rows_to_columns(rows, s)
+    assert cols["x"].dtype == np.float64
+    assert cols["n"].dtype == np.int32
+    back = columns_to_rows(cols, s)
+    assert back == rows
+
+
+def test_rows_to_columns_ragged():
+    s = Schema([Field("v", dt.double, sql_rank=1)])
+    rows = [([1.0, 2.0],), ([3.0],)]
+    cols = rows_to_columns(rows, s)
+    assert isinstance(cols["v"], list)
+    assert [len(a) for a in cols["v"]] == [2, 1]
+
+
+def test_null_cell_rejected():
+    s = Schema.of(x="double")
+    with pytest.raises(ValueError, match="[Nn]ull"):
+        rows_to_columns([(1.0,), (None,)], s, fast=False)
+
+
+def test_frame_from_rows_schema_inference():
+    df = frame([(1.0, 1), (2.0, 2)], columns=["x", "n"])
+    assert df.schema["x"].dtype is dt.double
+    assert df.schema["n"].dtype is dt.int64  # python int -> long, Spark-style
+    assert df.count() == 2
+    r = df.first()
+    assert r["x"] == 1.0 and r[1] == 1
+
+
+def test_frame_from_columns_and_partitions():
+    df = frame({"x": np.arange(10.0)}, num_partitions=3)
+    assert df.num_partitions == 3
+    sizes = [b.num_rows for b in df.blocks()]
+    assert sum(sizes) == 10 and max(sizes) - min(sizes) <= 1
+    assert [r["x"] for r in df.collect()] == list(np.arange(10.0))
+
+
+def test_vector_column_block_shape():
+    df = frame({"v": np.ones((6, 3))}, num_partitions=2)
+    assert df.schema["v"].block_shape == Shape(Unknown, 3)
+    assert df.blocks()[0].dense("v").shape == (3, 3)
+
+
+def test_ragged_dense_raises():
+    s = Schema([Field("v", dt.double, sql_rank=1)])
+    df = TensorFrame.from_rows([([1.0, 2.0],), ([3.0],)], schema=s)
+    b = df.blocks()[0]
+    assert b.is_ragged("v")
+    with pytest.raises(ValueError, match="map_rows"):
+        b.dense("v")
+
+
+def test_select_and_row_access():
+    df = frame([(1.0, 10), (2.0, 20)], columns=["x", "n"])
+    sel = df.select(["n"])
+    assert sel.columns == ["n"]
+    assert [r["n"] for r in sel.collect()] == [10, 20]
+    with pytest.raises(KeyError):
+        df.collect()[0]["zz"]
+
+
+def test_repartition_roundtrip():
+    df = frame({"x": np.arange(7.0)}, num_partitions=2).repartition(3)
+    assert sorted(r["x"] for r in df.collect()) == list(np.arange(7.0))
+    assert len(df.blocks()) == 3
+
+
+def test_group_by_validates():
+    df = frame({"x": np.arange(4.0)})
+    with pytest.raises(KeyError):
+        df.group_by("nope")
+    g = df.group_by("x")
+    assert g.keys == ["x"]
+
+
+def test_empty_partition_representable():
+    df = frame({"x": np.arange(2.0)}, num_partitions=1)
+    blocks = df.blocks() + [Block({"x": np.empty((0,))}, 0)]
+    df2 = TensorFrame.from_blocks(blocks, df.schema)
+    assert df2.count() == 2
+
+
+def test_block_concat_mixed():
+    s = Schema.of(x="double")
+    b1 = Block({"x": np.array([1.0, 2.0])})
+    b2 = Block({"x": np.array([3.0])})
+    c = Block.concat([b1, b2], s)
+    assert c.num_rows == 3
+    np.testing.assert_array_equal(c.dense("x"), [1.0, 2.0, 3.0])

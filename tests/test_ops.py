@@ -1,0 +1,382 @@
+"""End-to-end op integration tests.
+
+Mirrors the reference's test strategy (SURVEY.md §4): build a computation,
+run an op on a real local frame, compare collected rows — including
+multi-partition frames to force the cross-partition reduce/merge paths, and
+type-parametric replication over double/int/long
+(reference ``BasicOperationsSuite.scala``, ``type_suites.scala``).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import tensorframes_tpu as tft
+from tensorframes_tpu import dtypes as dt
+from tensorframes_tpu.engine import (
+    CompactionBuffer, InputNotFoundError, InvalidShapeError, InvalidTypeError)
+from tensorframes_tpu.frame import Block, TensorFrame
+from tensorframes_tpu.schema import Field, Schema
+from tensorframes_tpu.shape import Shape, Unknown
+
+
+# ---------------------------------------------------------------------------
+# map_blocks
+# ---------------------------------------------------------------------------
+
+def test_map_blocks_readme_x_plus_3():
+    # README.md:56-87 — the flagship acceptance slice
+    df = tft.frame({"x": np.arange(10.0)}, num_partitions=3)
+    df2 = tft.map_blocks(lambda x: {"z": x + 3.0}, df)
+    rows = df2.collect()
+    assert df2.columns == ["x", "z"]
+    assert [r["z"] for r in rows] == [x + 3.0 for x in range(10)]
+
+
+def test_map_blocks_is_lazy():
+    # The computation is frozen (traced) at call time — like the reference,
+    # where the GraphDef is serialized eagerly (core.py:183-184) — but no
+    # block executes until the frame is forced.
+    df = tft.frame({"x": np.arange(4.0)})
+    df2 = tft.map_blocks(lambda x: {"z": x * 2}, df)
+    assert df2._cache is None  # nothing materialized yet
+    df2.collect()
+    assert df2._cache is not None
+
+
+def test_map_blocks_multiple_fetches_sorted():
+    df = tft.frame({"x": np.arange(4.0)})
+    df2 = tft.map_blocks(lambda x: {"b": x + 1, "a": x - 1}, df)
+    assert df2.columns == ["x", "a", "b"]  # fetches sorted by name
+
+
+def test_map_blocks_vector_column():
+    df = tft.frame({"v": np.arange(12.0).reshape(6, 2)}, num_partitions=2)
+    df2 = tft.map_blocks(lambda v: {"s": jnp.sum(v, axis=1)}, df)
+    np.testing.assert_allclose(
+        [r["s"] for r in df2.collect()],
+        np.arange(12.0).reshape(6, 2).sum(axis=1))
+
+
+def test_map_blocks_2d_cells():
+    m = np.arange(24.0).reshape(2, 3, 4)
+    df = tft.frame({"m": m})
+    df2 = tft.map_blocks(lambda m: {"t": m * 2.0}, df)
+    np.testing.assert_allclose(df2.collect()[1]["t"], m[1] * 2)
+
+
+def test_map_blocks_name_collision():
+    df = tft.frame({"x": np.arange(3.0)})
+    with pytest.raises(ValueError, match="collides"):
+        tft.map_blocks(lambda x: {"x": x}, df)
+
+
+def test_map_blocks_missing_column():
+    df = tft.frame({"x": np.arange(3.0)})
+    with pytest.raises(InputNotFoundError, match="no matching column"):
+        tft.map_blocks(lambda y: {"z": y}, df)
+
+
+def test_map_blocks_dtype_mismatch():
+    from tensorframes_tpu.computation import Computation, TensorSpec
+    comp = Computation.trace(
+        lambda x: {"z": x + 1},
+        [TensorSpec("x", dt.int32, Shape(Unknown))])
+    df = tft.frame({"x": np.arange(3.0)})  # double column
+    with pytest.raises(InvalidTypeError, match="no implicit casting"):
+        tft.map_blocks(comp, df)
+
+
+def test_map_blocks_row_count_change_requires_trim():
+    df = tft.frame({"x": np.arange(6.0)})
+    bad = tft.map_blocks(lambda x: {"z": x[:3]}, df)
+    with pytest.raises(InvalidShapeError, match="trim"):
+        bad.collect()
+
+
+def test_map_blocks_trim_fewer_rows():
+    # TrimmingOperationsSuite analogue: per-block row-count change
+    df = tft.frame({"x": np.arange(6.0)}, num_partitions=2)
+    df2 = tft.map_blocks(lambda x: {"z": x[:2]}, df, trim=True)
+    assert df2.columns == ["z"]
+    assert df2.count() == 4  # 2 per partition
+
+
+def test_map_blocks_trim_more_rows():
+    df = tft.frame({"x": np.arange(2.0)})
+    df2 = tft.map_blocks(
+        lambda x: {"z": jnp.concatenate([x, x, x])}, df, trim=True)
+    assert df2.count() == 6
+
+
+def test_map_blocks_empty_partition():
+    s = Schema.of(x="double")
+    blocks = [Block({"x": np.array([1.0, 2.0])}),
+              Block({"x": np.empty((0,))}, 0)]
+    df = TensorFrame.from_blocks(blocks, s)
+    df2 = tft.map_blocks(lambda x: {"z": x + 1.0}, df)
+    assert [r["z"] for r in df2.collect()] == [2.0, 3.0]
+
+
+def test_map_blocks_block_global_computation():
+    # non-row-local computations must see the true block (no padding)
+    df = tft.frame({"x": np.arange(5.0)})
+    df2 = tft.map_blocks(lambda x: {"c": x - jnp.mean(x)}, df)
+    np.testing.assert_allclose(
+        [r["c"] for r in df2.collect()],
+        np.arange(5.0) - 2.0)
+
+
+# ---------------------------------------------------------------------------
+# map_rows
+# ---------------------------------------------------------------------------
+
+def test_map_rows_scalar():
+    df = tft.frame({"x": np.arange(5.0)}, num_partitions=2)
+    df2 = tft.map_rows(lambda x: {"z": x * x}, df)
+    assert [r["z"] for r in df2.collect()] == [x * x for x in range(5)]
+
+
+def test_map_rows_ragged_cells():
+    # BasicOperationsSuite "Identity - 1 dim with unknown size" analogue
+    s = Schema([Field("v", dt.double, sql_rank=1)])
+    df = TensorFrame.from_rows([([1.0, 2.0],), ([3.0, 4.0, 5.0],)], schema=s)
+    df = tft.analyze(df)  # stamps cell shape [?]
+    df2 = tft.map_rows(lambda v: {"s": jnp.sum(v)}, df)
+    assert [r["s"] for r in df2.collect()] == [3.0, 12.0]
+
+
+def test_map_rows_ragged_identity_output():
+    s = Schema([Field("v", dt.double, sql_rank=1)])
+    df = TensorFrame.from_rows([([1.0, 2.0],), ([3.0],)], schema=s)
+    df = tft.analyze(df)
+    df2 = tft.map_rows(lambda v: {"w": v * 2.0}, df)
+    rows = df2.collect()
+    np.testing.assert_allclose(rows[0]["w"], [2.0, 4.0])
+    np.testing.assert_allclose(rows[1]["w"], [6.0])
+
+
+def test_map_rows_collision():
+    df = tft.frame({"x": np.arange(3.0)})
+    with pytest.raises(ValueError, match="collides"):
+        tft.map_rows(lambda x: {"x": x}, df)
+
+
+# ---------------------------------------------------------------------------
+# reduce_rows / reduce_blocks
+# ---------------------------------------------------------------------------
+
+def test_reduce_rows_sum():
+    df = tft.frame({"x": np.arange(10.0)}, num_partitions=3)
+    out = tft.reduce_rows(lambda x_1, x_2: {"x": x_1 + x_2}, df)
+    assert out == pytest.approx(45.0)
+
+
+def test_reduce_rows_single_partition_single_row():
+    df = tft.frame({"x": np.array([7.0])})
+    assert tft.reduce_rows(lambda x_1, x_2: {"x": x_1 + x_2}, df) == 7.0
+
+
+def test_reduce_rows_naming_contract():
+    df = tft.frame({"x": np.arange(4.0)})
+    with pytest.raises(InputNotFoundError, match="naming"):
+        tft.reduce_rows(lambda a, b: {"x": a + b}, df)
+
+
+def test_reduce_blocks_sum_min_vector():
+    # README reduce example over a vector column
+    v = np.arange(12.0).reshape(4, 3)
+    df = tft.frame({"x": v}, num_partitions=2)
+    out = tft.reduce_blocks(
+        lambda x_input: {"x": jnp.sum(x_input, axis=0)}, df)
+    np.testing.assert_allclose(out, v.sum(axis=0))
+    out = tft.reduce_blocks(
+        lambda x_input: {"x": jnp.min(x_input, axis=0)}, df)
+    np.testing.assert_allclose(out, v.min(axis=0))
+
+
+def test_reduce_blocks_multiple_fetches():
+    df = tft.frame({"x": np.arange(6.0), "y": np.arange(6.0) * 2},
+                   num_partitions=2)
+    out = tft.reduce_blocks(
+        lambda x_input, y_input: {"x": jnp.sum(x_input, axis=0),
+                                  "y": jnp.max(y_input, axis=0)}, df)
+    # fetches sorted by name: x then y
+    assert out[0] == pytest.approx(15.0)
+    assert out[1] == pytest.approx(10.0)
+
+
+def test_reduce_blocks_unused_column_rejected():
+    df = tft.frame({"x": np.arange(4.0), "junk": np.arange(4.0)})
+    with pytest.raises(InputNotFoundError, match="not consumed"):
+        tft.reduce_blocks(lambda x_input: {"x": jnp.sum(x_input)}, df)
+
+
+def test_reduce_blocks_missing_input_for_fetch():
+    df = tft.frame({"x": np.arange(4.0)})
+    with pytest.raises(InputNotFoundError, match="missing required"):
+        from tensorframes_tpu.computation import Computation, TensorSpec
+        comp = Computation.trace(
+            lambda x_input: {"x": jnp.sum(x_input), "y": jnp.sum(x_input)},
+            [TensorSpec("x_input", dt.double, Shape(Unknown))])
+        tft.reduce_blocks(comp, df)
+
+
+def test_reduce_blocks_empty_frame():
+    df = tft.frame({"x": np.empty((0,))})
+    with pytest.raises(ValueError, match="empty"):
+        tft.reduce_blocks(lambda x_input: {"x": jnp.sum(x_input)}, df)
+
+
+def test_reduce_blocks_empty_partition_skipped():
+    s = Schema.of(x="double")
+    blocks = [Block({"x": np.array([1.0, 2.0])}),
+              Block({"x": np.empty((0,))}, 0),
+              Block({"x": np.array([3.0])})]
+    df = TensorFrame.from_blocks(blocks, s)
+    out = tft.reduce_blocks(lambda x_input: {"x": jnp.sum(x_input)}, df)
+    assert out == pytest.approx(6.0)
+
+
+# ---------------------------------------------------------------------------
+# aggregate
+# ---------------------------------------------------------------------------
+
+def test_aggregate_sum_by_key():
+    df = tft.frame(
+        {"key": np.array([1, 1, 2, 2, 2], np.int64),
+         "x": np.array([1.0, 2.0, 3.0, 4.0, 5.0])},
+        num_partitions=2)
+    out = tft.aggregate(lambda x_input: {"x": jnp.sum(x_input, axis=0)},
+                        df.group_by("key"))
+    rows = sorted(out.collect(), key=lambda r: r["key"])
+    assert [(r["key"], r["x"]) for r in rows] == [(1, 3.0), (2, 12.0)]
+
+
+def test_aggregate_compaction_over_buffer_size():
+    n = 37  # > buffer_size to force compactions
+    df = tft.frame({"key": np.ones(n, np.int64),
+                    "x": np.arange(float(n))})
+    out = tft.aggregate(lambda x_input: {"x": jnp.sum(x_input, axis=0)},
+                        df.group_by("key"), buffer_size=4)
+    assert out.collect()[0]["x"] == pytest.approx(sum(range(n)))
+
+
+def test_aggregate_vector_values_and_multi_key():
+    df = tft.frame(
+        {"k1": np.array([0, 0, 1, 1], np.int64),
+         "k2": np.array([0, 1, 0, 0], np.int64),
+         "v": np.arange(8.0).reshape(4, 2)})
+    out = tft.aggregate(lambda v_input: {"v": jnp.sum(v_input, axis=0)},
+                        df.group_by("k1", "k2"))
+    rows = sorted(out.collect(), key=lambda r: (r["k1"], r["k2"]))
+    assert len(rows) == 3
+    np.testing.assert_allclose(rows[2]["v"], [10.0, 12.0])  # rows 2+3
+
+
+def test_aggregate_unused_value_column_rejected():
+    df = tft.frame({"key": np.zeros(3, np.int64), "x": np.arange(3.0),
+                    "extra": np.arange(3.0)})
+    with pytest.raises(InputNotFoundError, match="not consumed"):
+        tft.aggregate(lambda x_input: {"x": jnp.sum(x_input, axis=0)},
+                      df.group_by("key"))
+
+
+# ---------------------------------------------------------------------------
+# type-parametric replication (type_suites.scala analogue)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("np_dt,expected_dt", [
+    (np.float64, "double"), (np.int32, "int"), (np.int64, "long"),
+    (np.float32, "float"),
+])
+def test_map_and_reduce_all_scalar_types(np_dt, expected_dt):
+    data = np.arange(1, 7).astype(np_dt)
+    df = tft.frame({"x": data}, num_partitions=2)
+    assert df.schema["x"].dtype.name == expected_dt
+    df2 = tft.map_blocks(lambda x: {"z": x + x}, df)
+    assert [r["z"] for r in df2.collect()] == [2 * x for x in range(1, 7)]
+    # jnp.sum promotes int32 -> int64; the contract demands exact dtype
+    # equality between fetch and input, so the cast is explicit.
+    out = tft.reduce_blocks(
+        lambda x_input: {"x": jnp.sum(x_input, axis=0).astype(x_input.dtype)},
+        df)
+    assert out == pytest.approx(21)
+
+
+# ---------------------------------------------------------------------------
+# CompactionBuffer unit tests (TensorFlowUDAF contract)
+# ---------------------------------------------------------------------------
+
+def _sum_reduce(block):
+    return {"x": np.sum(block["x"], axis=0)}
+
+
+def test_compaction_buffer_update_and_evaluate():
+    buf = CompactionBuffer(["x"], _sum_reduce, buffer_size=3)
+    for i in range(7):
+        buf.update({"x": np.float64(i)})
+        assert len(buf) < 3  # compacts at the threshold
+    assert buf.evaluate()["x"] == 21.0
+
+
+def test_compaction_buffer_merge():
+    a = CompactionBuffer(["x"], _sum_reduce, buffer_size=10)
+    b = CompactionBuffer(["x"], _sum_reduce, buffer_size=10)
+    for i in range(4):
+        a.update({"x": np.float64(i)})
+        b.update({"x": np.float64(10 + i)})
+    a.merge(b)
+    assert a.evaluate()["x"] == sum(range(4)) + sum(range(10, 14))
+
+
+def test_compaction_buffer_empty_evaluate_raises():
+    buf = CompactionBuffer(["x"], _sum_reduce)
+    with pytest.raises(ValueError, match="empty"):
+        buf.evaluate()
+
+
+# ---------------------------------------------------------------------------
+# analyze / print_schema / explain
+# ---------------------------------------------------------------------------
+
+def test_analyze_stamps_vector_shape():
+    s = Schema([Field("v", dt.double, sql_rank=1)])
+    df = TensorFrame.from_rows([([1.0, 2.0],), ([3.0, 4.0],)], schema=s)
+    assert df.schema["v"].block_shape is None
+    df2 = tft.analyze(df)
+    assert df2.schema["v"].block_shape == Shape(2, 2)
+    # ops now accept the vector column
+    out = tft.reduce_blocks(
+        lambda v_input: {"v": jnp.sum(v_input, axis=0)}, df2)
+    np.testing.assert_allclose(out, [4.0, 6.0])
+
+
+def test_analyze_variable_sizes_to_unknown():
+    # ExtraOperationsSuite analogue: disagreeing dims become Unknown
+    s = Schema([Field("v", dt.double, sql_rank=1)])
+    df = TensorFrame.from_rows([([1.0, 2.0],), ([3.0],)], schema=s)
+    df2 = tft.analyze(df)
+    assert df2.schema["v"].block_shape == Shape(2, Unknown)
+
+
+def test_analyze_multi_partition_lead_dim():
+    df = tft.frame({"x": np.arange(5.0)}, num_partitions=2)  # sizes 3,2
+    df2 = tft.analyze(df)
+    assert df2.schema["x"].block_shape == Shape(Unknown)
+
+
+def test_explain_and_print_schema(capsys):
+    df = tft.frame({"x": np.arange(3.0)})
+    text = tft.explain(df)
+    assert "x: double" in text
+    tft.print_schema(df)
+    out = capsys.readouterr().out
+    assert "root" in out and "x: double" in out
+
+
+def test_block_ops_without_analyze_rejected():
+    s = Schema([Field("v", dt.double, sql_rank=1)])
+    df = TensorFrame.from_rows([([1.0, 2.0],)], schema=s)
+    with pytest.raises(InvalidShapeError, match="analyze"):
+        tft.map_blocks(lambda v: {"z": v * 2}, df)
