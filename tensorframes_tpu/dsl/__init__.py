@@ -56,10 +56,7 @@ def constant(value, dtype: Optional[_dt.DType] = None,
     ``impl/DenseTensor.scala``)."""
     arr = np.asarray(value)
     if dtype is None:
-        if arr.dtype == np.float64 or arr.dtype.kind == "f" and arr.dtype.itemsize == 8:
-            dtype = _dt.double
-        else:
-            dtype = _dt.from_numpy(arr.dtype)
+        dtype = _dt.from_numpy(arr.dtype)
     arr = arr.astype(dtype.np_storage)
     return Node("Const", [], dtype, Shape(arr.shape),
                 impl=None, value=arr, name=name)

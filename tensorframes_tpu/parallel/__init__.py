@@ -1,0 +1,23 @@
+"""Distribution layer: device meshes, sharded frames, ICI collectives.
+
+This replaces the reference's entire Spark distribution model (SURVEY.md
+§2.3): partition-parallel ``mapPartitions`` becomes batch sharding over a
+``jax.sharding.Mesh`` data axis; the Spark broadcast of the serialized graph
+becomes XLA program replication; the reduce tree / shuffle becomes
+``psum``-family collectives over ICI (with DCN mesh axes for multi-host).
+Long-context sequence parallelism (ring attention over ``ppermute``) is a
+first-class citizen of the same mesh.
+"""
+
+from .mesh import DeviceMesh, local_mesh
+from .distributed import (
+    DistributedFrame, distribute, dmap_blocks, dreduce_blocks)
+from .collectives import COMBINERS
+from .ring import ring_attention, ring_allreduce
+
+__all__ = [
+    "DeviceMesh", "local_mesh",
+    "DistributedFrame", "distribute", "dmap_blocks", "dreduce_blocks",
+    "COMBINERS",
+    "ring_attention", "ring_allreduce",
+]

@@ -1,0 +1,68 @@
+"""Collective combiners for mesh reductions.
+
+The reference's cross-partition combine was always a user graph evaluated
+pairwise over Spark's reduce tree (``DebugRowOps.scala:511-512, 721-739``).
+On a mesh, the combine becomes an XLA collective when it is one of the
+known associative monoids — ``psum``-family over ICI — and each combiner
+carries its neutral element so row-padding to equal shard sizes is safe.
+Arbitrary user combines fall back to gather-then-local-reduce (see
+``distributed.py``), mirroring the reference's "order unspecified" contract.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["Combiner", "COMBINERS"]
+
+
+class Combiner(NamedTuple):
+    """An associative reduction: local block-reduce, mesh collective, and
+    the padding-neutral element."""
+
+    name: str
+    local: Callable  # (block, axis) -> partial
+    collective: Callable  # (partial, axis_name) -> combined
+    neutral: Callable  # (dtype) -> scalar
+
+
+def _neutral_min(dt):
+    dt = np.dtype(dt)
+    if np.issubdtype(dt, np.floating):
+        return np.array(np.inf, dt)
+    return np.array(np.iinfo(dt).max, dt)
+
+
+def _neutral_max(dt):
+    dt = np.dtype(dt)
+    if np.issubdtype(dt, np.floating):
+        return np.array(-np.inf, dt)
+    return np.array(np.iinfo(dt).min, dt)
+
+
+COMBINERS: Dict[str, Combiner] = {
+    "sum": Combiner(
+        "sum",
+        lambda b, axis=0: jnp.sum(b, axis=axis),
+        lambda x, axis_name: jax.lax.psum(x, axis_name),
+        lambda dt: np.array(0, dt)),
+    "min": Combiner(
+        "min",
+        lambda b, axis=0: jnp.min(b, axis=axis),
+        lambda x, axis_name: jax.lax.pmin(x, axis_name),
+        _neutral_min),
+    "max": Combiner(
+        "max",
+        lambda b, axis=0: jnp.max(b, axis=axis),
+        lambda x, axis_name: jax.lax.pmax(x, axis_name),
+        _neutral_max),
+    "prod": Combiner(
+        "prod",
+        lambda b, axis=0: jnp.prod(b, axis=axis),
+        lambda x, axis_name: jax.lax.all_gather(x, axis_name).prod(axis=0),
+        lambda dt: np.array(1, dt)),
+}

@@ -1,0 +1,249 @@
+"""Distributed frames: mesh-sharded columns and mesh-level map/reduce.
+
+The TPU-native re-expression of the reference's executor-side distribution
+(SURVEY.md §2.3). A :class:`DistributedFrame` holds each column as ONE global
+``jax.Array`` row-sharded over the mesh's data axis — partitions become
+shards, the broadcast-the-graph step becomes XLA program replication, and:
+
+- :func:`dmap_blocks` — the ``rdd.mapPartitions`` analogue
+  (``DebugRowOps.scala:372-386``): one jit dispatch executes every shard in
+  parallel with no cross-device traffic;
+- :func:`dreduce_blocks` — the block-reduce + Spark-tree-combine analogue
+  (``DebugRowOps.scala:490-513``). For the associative monoid combiners
+  (sum/min/max/prod) it lowers to one ``shard_map`` program whose
+  cross-shard combine is a ``psum``-family ICI collective, with pad rows
+  masked to the combiner's neutral element; arbitrary user computations
+  take the per-device path — one async jit dispatch per shard device (JAX's
+  async dispatch overlaps them), partials stacked and reduced once, which
+  preserves the reference's "combine order unspecified" contract exactly.
+
+Multi-host: build the mesh over ``jax.devices()`` after
+``jax.distributed.initialize`` and the same code spans hosts — data-axis
+collectives ride ICI within a slice and DCN across slices.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Mapping, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+from jax import shard_map
+
+from .. import dtypes as _dt
+from ..engine import ops as _ops
+from ..frame import Block, TensorFrame
+from ..schema import Schema
+from .collectives import COMBINERS
+from .mesh import DeviceMesh
+
+__all__ = ["DistributedFrame", "distribute", "dmap_blocks",
+           "dreduce_blocks"]
+
+
+class DistributedFrame:
+    """Columns as global row-sharded jax Arrays + the true row count.
+
+    ``num_rows`` is the un-padded row count; rows are padded up to a
+    multiple of the data-axis size so every shard is equal (XLA's static
+    world), and consumers mask or slice the pad away.
+    """
+
+    def __init__(self, mesh: DeviceMesh, schema: Schema,
+                 columns: Dict[str, jax.Array], num_rows: int):
+        self.mesh = mesh
+        self.schema = schema
+        self.columns = columns
+        self.num_rows = num_rows
+
+    @property
+    def padded_rows(self) -> int:
+        first = next(iter(self.columns.values()))
+        return first.shape[0]
+
+    def collect_frame(self, num_partitions: Optional[int] = None) -> TensorFrame:
+        """Bring the data back to the host as a TensorFrame (pad dropped)."""
+        cols = {n: np.asarray(a)[: self.num_rows]
+                for n, a in self.columns.items()}
+        host_cols = {}
+        for f in self.schema:
+            a = cols[f.name]
+            if a.dtype != f.dtype.np_storage and f.dtype is not _dt.bfloat16:
+                a = a.astype(f.dtype.np_storage)
+            host_cols[f.name] = a
+        return TensorFrame.from_columns(
+            host_cols, schema=self.schema,
+            num_partitions=num_partitions or self.mesh.num_data_shards)
+
+    def __repr__(self):
+        return (f"DistributedFrame[{', '.join(self.schema.names)}] "
+                f"rows={self.num_rows} mesh={self.mesh!r}")
+
+
+def distribute(df: TensorFrame, mesh: DeviceMesh) -> DistributedFrame:
+    """Shard a host frame over the mesh's data axis.
+
+    The analogue of Spark scattering partitions to executors — except the
+    placement is an explicit ``device_put`` with a ``NamedSharding``, and
+    the "partitions" are equal shards of one global array (pad rows, zero
+    filled, make up the remainder; ``num_rows`` remembers the truth).
+    """
+    merged = Block.concat(df.blocks(), df.schema)
+    n = merged.num_rows
+    shards = mesh.num_data_shards
+    padded = ((n + shards - 1) // shards) * shards if n else shards
+    cols: Dict[str, jax.Array] = {}
+    for f in df.schema:
+        a = merged.dense(f.name)
+        dd = _dt.device_dtype(f.dtype)
+        if a.dtype != dd:
+            a = a.astype(dd)
+        if padded != n:
+            pad = [(0, padded - n)] + [(0, 0)] * (a.ndim - 1)
+            a = np.pad(a, pad)
+        cols[f.name] = jax.device_put(a, mesh.row_sharding(a.ndim))
+    return DistributedFrame(mesh, df.schema, cols, n)
+
+
+def dmap_blocks(fetches, dist: DistributedFrame,
+                trim: bool = False) -> DistributedFrame:
+    """Mesh-parallel map: one jit dispatch, all shards in parallel.
+
+    Row-local computations only (each output row may depend on its input
+    row and on replicated constants): pad rows flow through and are dropped
+    at collect. Block-global computations (e.g. subtract-the-block-mean)
+    need the per-partition host path (``tft.map_blocks``).
+    """
+    schema = dist.schema
+    comp = _ops._map_computation(fetches, schema, block_level=True)
+    out_schema = _ops._validate_map(comp, schema, block_level=True, trim=trim)
+    mesh = dist.mesh
+
+    jitted = jax.jit(comp.fn)
+    out = jitted({n: dist.columns[n] for n in comp.input_names})
+    cols = {} if trim else dict(dist.columns)
+    for spec in comp.outputs:
+        a = out[spec.name]
+        if a.shape[0] != dist.padded_rows:
+            raise ValueError(
+                f"Distributed map output {spec.name!r} changed the row "
+                f"count ({a.shape[0]} vs {dist.padded_rows}); row-count "
+                f"changing computations are per-partition only")
+        cols[spec.name] = a
+    return DistributedFrame(mesh, out_schema, cols, dist.num_rows)
+
+
+def dreduce_blocks(fetches, dist: DistributedFrame):
+    """Mesh-parallel reduce to one row.
+
+    Two strategies:
+
+    - ``fetches`` is a mapping ``{column: combiner-name}`` (sum/min/max/
+      prod): ONE compiled ``shard_map`` program — local shard reduce, pad
+      rows masked to the combiner's neutral element, cross-shard combine as
+      an ICI collective (``lax.psum``/``pmin``/``pmax``). This is the
+      BASELINE north-star path.
+    - ``fetches`` is a computation (z/z_input contract): generic combine —
+      per-shard async jit dispatches, partials stacked, one final reduce.
+    """
+    if isinstance(fetches, Mapping) and all(
+            isinstance(v, str) for v in fetches.values()):
+        return _collective_reduce(fetches, dist)
+    return _generic_reduce(fetches, dist)
+
+
+def _collective_reduce(col_combiners: Mapping[str, str],
+                       dist: DistributedFrame) -> Dict[str, np.ndarray]:
+    mesh = dist.mesh
+    axis = mesh.data_axis
+    n_valid = dist.num_rows
+    if n_valid == 0:
+        raise ValueError("reduce on an empty distributed frame")
+    combs = {}
+    for name, cname in col_combiners.items():
+        if name not in dist.schema:
+            raise KeyError(f"No column {name!r}")
+        if cname not in COMBINERS:
+            raise KeyError(
+                f"Unknown combiner {cname!r}; known: {sorted(COMBINERS)}")
+        combs[name] = COMBINERS[cname]
+
+    names = sorted(col_combiners)
+    arrays = [dist.columns[n] for n in names]
+    in_specs = tuple(P(axis, *([None] * (a.ndim - 1))) for a in arrays)
+    out_specs = tuple(P() for _ in arrays)
+
+    def shard_fn(*shards):
+        outs = []
+        rows = shards[0].shape[0]
+        idx = jax.lax.axis_index(axis) * rows + jnp.arange(rows)
+        valid = idx < n_valid
+        for name, s in zip(names, shards):
+            c = combs[name]
+            mask = valid.reshape((rows,) + (1,) * (s.ndim - 1))
+            neutral = jnp.asarray(c.neutral(s.dtype))
+            masked = jnp.where(mask, s, neutral)
+            local = c.local(masked, 0)
+            outs.append(c.collective(local, axis))
+        return tuple(outs)
+
+    fn = jax.jit(shard_map(shard_fn, mesh=mesh.mesh,
+                           in_specs=in_specs, out_specs=out_specs))
+    outs = fn(*arrays)
+    result = {}
+    for name, a in zip(names, outs):
+        v = np.asarray(a)
+        f = dist.schema[name]
+        if v.dtype != f.dtype.np_storage and f.dtype is not _dt.bfloat16:
+            v = v.astype(f.dtype.np_storage)
+        result[name] = v
+    return result
+
+
+def _generic_reduce(fetches, dist: DistributedFrame) -> Dict[str, np.ndarray]:
+    schema = dist.schema
+    comp = _ops._reduce_computation(fetches, schema, ("_input",),
+                                    block_level=True)
+    _ops._validate_reduce(comp, schema, ("_input",), rank_delta=1)
+    fetch_names = comp.output_names
+    mesh = dist.mesh
+    shards = mesh.num_data_shards
+    n = dist.num_rows
+    if n == 0:
+        raise ValueError("reduce on an empty distributed frame")
+    rows_per = dist.padded_rows // shards
+
+    # Per-device async dispatch: each device reduces its own (unpadded
+    # portion of its) shard; dispatches overlap via JAX async execution.
+    devices = [d for d in mesh.mesh.devices.flatten()][:shards]
+    # inputs are committed per device; the jitted computation follows the
+    # data, and jax.jit's own shape-keyed cache handles the ragged tail
+    jf = jax.jit(comp.fn)
+    partials = []
+    for s in range(shards):
+        a0 = s * rows_per
+        b0 = min((s + 1) * rows_per, n)
+        if b0 <= a0:
+            continue
+        dev = devices[s % len(devices)]
+        feeds = {f + "_input": jax.device_put(dist.columns[f][a0:b0], dev)
+                 for f in fetch_names}
+        partials.append(jf(feeds))
+    # partials live on distinct devices; gather them to host (tiny — one
+    # cell each, the reference's driver-side combine did the same) and run
+    # the final combine as one stacked block-reduce
+    stacked = {
+        f + "_input": np.stack([np.asarray(p[f]) for p in partials])
+        for f in fetch_names}
+    final = jax.jit(comp.fn)(stacked)
+    out = {}
+    for f in fetch_names:
+        v = np.asarray(final[f])
+        fld = schema.get(f)
+        if fld is not None and v.dtype != fld.dtype.np_storage \
+                and fld.dtype is not _dt.bfloat16:
+            v = v.astype(fld.dtype.np_storage)
+        out[f] = v
+    return out

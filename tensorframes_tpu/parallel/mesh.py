@@ -1,0 +1,77 @@
+"""Device mesh construction and sharding helpers.
+
+The TPU-native replacement for the reference's "cluster": where Spark gave
+the reference a set of executor JVMs, a :class:`DeviceMesh` names the TPU
+chips of a slice (and, multi-host, of a pod) as mesh axes. The default
+1-axis ``data`` mesh reproduces the reference's pure data parallelism
+(``rdd.mapPartitions``); extra axes (``model``, ``seq``) host tensor and
+sequence parallelism the reference never had but the design must not
+preclude (SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+__all__ = ["DeviceMesh", "local_mesh"]
+
+
+class DeviceMesh:
+    """A named mesh over JAX devices with sharding convenience methods."""
+
+    def __init__(self, mesh: Mesh, data_axis: str = "data"):
+        self.mesh = mesh
+        self.data_axis = data_axis
+        if data_axis not in mesh.axis_names:
+            raise ValueError(
+                f"Mesh has axes {mesh.axis_names}; no {data_axis!r} axis")
+
+    @property
+    def axis_names(self) -> Tuple[str, ...]:
+        return tuple(self.mesh.axis_names)
+
+    @property
+    def num_data_shards(self) -> int:
+        return self.mesh.shape[self.data_axis]
+
+    @property
+    def num_devices(self) -> int:
+        return int(np.prod(list(self.mesh.shape.values())))
+
+    def row_sharding(self, ndim: int) -> NamedSharding:
+        """Shard the leading (row) dim over the data axis, replicate rest."""
+        spec = PartitionSpec(self.data_axis, *([None] * (ndim - 1)))
+        return NamedSharding(self.mesh, spec)
+
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, PartitionSpec())
+
+    def __repr__(self):
+        shape = dict(self.mesh.shape)
+        return f"DeviceMesh({shape}, data_axis={self.data_axis!r})"
+
+
+def local_mesh(num_devices: Optional[int] = None,
+               axis_names: Sequence[str] = ("data",),
+               shape: Optional[Sequence[int]] = None) -> DeviceMesh:
+    """Build a mesh over the locally visible devices.
+
+    One real chip gives a 1-device mesh (the degenerate case every op still
+    runs through); 8 virtual CPU devices (tests) or a v5e-8 slice give the
+    8-way data mesh of the BASELINE configs.
+    """
+    devices = jax.devices()
+    if num_devices is not None:
+        devices = devices[:num_devices]
+    n = len(devices)
+    if shape is None:
+        shape = (n,) + (1,) * (len(axis_names) - 1)
+    if int(np.prod(shape)) != n:
+        raise ValueError(f"Mesh shape {shape} does not cover {n} devices")
+    arr = np.array(devices).reshape(shape)
+    return DeviceMesh(Mesh(arr, tuple(axis_names)),
+                      data_axis=axis_names[0])
