@@ -1,0 +1,28 @@
+"""Model zoo for the framework's acceptance workloads.
+
+The reference has no model zoo (SURVEY.md: "What the reference is NOT"), but
+its BASELINE configs define model workloads the TPU build must run through
+the DataFrame ops:
+
+- config 4: ResNet-50 frozen-graph batch inference over an image-tensor
+  column (:mod:`.resnet`);
+- config 5: logistic-regression gradient step via ``map_blocks`` +
+  ``reduce_blocks`` allreduce on a v5e-8 (:mod:`.logreg`).
+
+:mod:`.transformer` is the framework's flagship long-context model: a
+decoder-only LM whose attention can run as ring attention over a mesh
+``seq`` axis (sequence parallelism) with tensor-parallel weights over a
+``model`` axis and data-parallel batch — exercising every mesh axis the
+parallel layer provides.
+
+Models are pure-JAX: parameters are nested-dict pytrees, forward passes are
+jit-friendly pure functions. This keeps sharding fully explicit
+(``NamedSharding`` per leaf) instead of hiding it behind a module library.
+"""
+
+from .logreg import LogisticRegression
+from .resnet import ResNet50
+from .transformer import TransformerLM, TransformerConfig
+
+__all__ = ["LogisticRegression", "ResNet50", "TransformerLM",
+           "TransformerConfig"]

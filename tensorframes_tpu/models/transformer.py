@@ -1,0 +1,240 @@
+"""Decoder-only transformer LM — the framework's flagship long-context model.
+
+The reference predates attention entirely (SURVEY.md §5: "no attention, no
+sequences"), but long-context and distributed execution are first-class in
+this framework, so the flagship model exercises every mesh axis the parallel
+layer provides in ONE compiled training step:
+
+- **data parallelism**: batch row-sharded over the ``data`` axis (the
+  reference's partition parallelism);
+- **tensor parallelism**: attention heads and MLP hidden dim sharded over
+  the ``model`` axis, Megatron-style — XLA inserts the two allreduces per
+  layer from the ``NamedSharding`` annotations alone;
+- **sequence parallelism**: activations sequence-sharded over the ``seq``
+  axis with :func:`~tensorframes_tpu.parallel.ring.ring_attention` rotating
+  k/v blocks around the ICI ring (peak per-chip memory O(S/n)).
+
+Pure JAX: params are nested-dict pytrees, rotary positions (no position
+table — computed from global indices, so sequence sharding needs no
+parameter surgery), pre-LN blocks, bf16-friendly.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..parallel.mesh import DeviceMesh
+from ..parallel.ring import ring_attention
+
+__all__ = ["TransformerConfig", "TransformerLM"]
+
+Params = Dict[str, Any]
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_heads: int = 8
+    n_layers: int = 6
+    d_ff: int = 2048
+    rope_base: float = 10000.0
+    dtype: Any = jnp.float32
+
+    @property
+    def head_dim(self) -> int:
+        assert self.d_model % self.n_heads == 0
+        return self.d_model // self.n_heads
+
+
+def _rope(x: jax.Array, positions: jax.Array, base: float) -> jax.Array:
+    """Rotary position embedding. x: [..., S, H, D], positions: [S]."""
+    d = x.shape[-1]
+    half = d // 2
+    freqs = base ** (-jnp.arange(half, dtype=jnp.float32) / half)
+    angles = positions[:, None].astype(jnp.float32) * freqs  # [S, half]
+    cos = jnp.cos(angles)[None, :, None, :].astype(x.dtype)
+    sin = jnp.sin(angles)[None, :, None, :].astype(x.dtype)
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([x1 * cos - x2 * sin,
+                            x1 * sin + x2 * cos], axis=-1)
+
+
+def _rms_norm(x: jax.Array, scale: jax.Array, eps: float = 1e-6) -> jax.Array:
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x.astype(jnp.float32) * jax.lax.rsqrt(var + eps)
+            ).astype(x.dtype) * scale
+
+
+class TransformerLM:
+    """Causal LM: tokens [B, S] (int32) -> logits [B, S, vocab]."""
+
+    def __init__(self, config: TransformerConfig):
+        self.config = config
+
+    # -- parameters ---------------------------------------------------------
+    def init(self, rng: Optional[jax.Array] = None) -> Params:
+        c = self.config
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        n_keys = 2 + 6 * c.n_layers
+        keys = iter(jax.random.split(rng, n_keys))
+
+        def dense(shape, fan_in):
+            return (jax.random.normal(next(keys), shape, c.dtype)
+                    * np.sqrt(1.0 / fan_in).astype(np.float32))
+
+        H, D, Dh, F = c.n_heads, c.d_model, c.head_dim, c.d_ff
+        layers = []
+        for _ in range(c.n_layers):
+            layers.append({
+                "ln1": jnp.ones((D,), c.dtype),
+                "wq": dense((D, H, Dh), D),
+                "wk": dense((D, H, Dh), D),
+                "wv": dense((D, H, Dh), D),
+                "wo": dense((H, Dh, D), D),
+                "ln2": jnp.ones((D,), c.dtype),
+                "w1": dense((D, F), D),
+                "w2": dense((F, D), F),
+            })
+        return {
+            "embed": dense((c.vocab_size, D), D) * np.float32(np.sqrt(D)),
+            "layers": layers,
+            "ln_f": jnp.ones((D,), c.dtype),
+            "head": dense((D, c.vocab_size), D),
+        }
+
+    # -- forward ------------------------------------------------------------
+    def _attention(self, q, k, v, *, mesh: Optional[DeviceMesh],
+                   seq_axis: Optional[str], data_axis: Optional[str],
+                   model_axis: Optional[str]):
+        if mesh is not None and seq_axis is not None:
+            return ring_attention(q, k, v, mesh, seq_axis=seq_axis,
+                                  causal=True, batch_axis=data_axis,
+                                  head_axis=model_axis)
+        scale = float(1.0 / np.sqrt(q.shape[-1]))
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+        S = q.shape[1]
+        mask = jnp.tril(jnp.ones((S, S), bool))
+        scores = jnp.where(mask[None, None], scores, -jnp.inf)
+        p = jax.nn.softmax(scores.astype(jnp.float32), axis=-1
+                           ).astype(q.dtype)
+        return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+    def apply(self, params: Params, tokens: jax.Array,
+              mesh: Optional[DeviceMesh] = None,
+              seq_axis: Optional[str] = None,
+              data_axis: Optional[str] = None,
+              model_axis: Optional[str] = None) -> jax.Array:
+        """Forward pass. With ``mesh`` + ``seq_axis``, attention runs as a
+        sequence-parallel ring; positions are global, so rotary phases are
+        correct on every shard."""
+        c = self.config
+        S = tokens.shape[1]
+        x = params["embed"][tokens]  # [B, S, D]
+        positions = jnp.arange(S)
+        for lp in params["layers"]:
+            h = _rms_norm(x, lp["ln1"])
+            q = jnp.einsum("bsd,dhk->bshk", h, lp["wq"])
+            k = jnp.einsum("bsd,dhk->bshk", h, lp["wk"])
+            v = jnp.einsum("bsd,dhk->bshk", h, lp["wv"])
+            q = _rope(q, positions, c.rope_base)
+            k = _rope(k, positions, c.rope_base)
+            attn = self._attention(q, k, v, mesh=mesh, seq_axis=seq_axis,
+                                   data_axis=data_axis,
+                                   model_axis=model_axis)
+            x = x + jnp.einsum("bshk,hkd->bsd", attn, lp["wo"])
+            h = _rms_norm(x, lp["ln2"])
+            x = x + jax.nn.gelu(h @ lp["w1"]) @ lp["w2"]
+        x = _rms_norm(x, params["ln_f"])
+        return x @ params["head"]
+
+    def loss(self, params: Params, tokens: jax.Array, targets: jax.Array,
+             **apply_kw) -> jax.Array:
+        """Mean next-token cross-entropy; ``targets[b, s]`` is the label
+        for position ``s`` (caller pre-shifts)."""
+        logits = self.apply(params, tokens, **apply_kw)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        return -jnp.mean(ll)
+
+    # -- sharding -----------------------------------------------------------
+    def param_shardings(self, mesh: DeviceMesh, model_axis: str = "model"
+                        ) -> Params:
+        """Megatron-style tensor-parallel placement over ``model_axis``."""
+        m = mesh.mesh
+
+        def s(*spec):
+            return NamedSharding(m, P(*spec))
+
+        layer = {
+            "ln1": s(), "ln2": s(),
+            "wq": s(None, model_axis, None),
+            "wk": s(None, model_axis, None),
+            "wv": s(None, model_axis, None),
+            "wo": s(model_axis, None, None),
+            "w1": s(None, model_axis),
+            "w2": s(model_axis, None),
+        }
+        return {
+            "embed": s(None, None),
+            "layers": [dict(layer) for _ in range(self.config.n_layers)],
+            "ln_f": s(),
+            "head": s(None, model_axis),
+        }
+
+    def make_sharded_train_step(self, mesh: DeviceMesh,
+                                data_axis: str = "data",
+                                model_axis: Optional[str] = "model",
+                                seq_axis: Optional[str] = None,
+                                learning_rate: float = 1e-3):
+        """One compiled SPMD training step (adam) over the mesh.
+
+        Returns ``(step, init_state)`` factories: ``state = init_state(rng)``
+        then ``state, loss = step(state, tokens, targets)``. Shardings:
+        params tensor-parallel over ``model_axis`` (replicated if the axis is
+        absent/None), batch over ``data_axis``, and — when ``seq_axis`` is
+        given — activations sequence-sharded with ring attention.
+        """
+        import optax
+
+        axes = mesh.axis_names
+        ma = model_axis if model_axis in axes else None
+        sa = seq_axis if seq_axis in axes else None
+        p_shard = (self.param_shardings(mesh, ma) if ma
+                   else jax.tree_util.tree_map(
+                       lambda _: NamedSharding(mesh.mesh, P()),
+                       self.init(jax.random.PRNGKey(0)),
+                       is_leaf=lambda x: isinstance(x, jax.Array)))
+        tok_shard = NamedSharding(mesh.mesh, P(data_axis, sa))
+        opt = optax.adam(learning_rate)
+
+        def init_state(rng=None):
+            params = jax.device_put(self.init(rng), p_shard)
+            # adam moments inherit each param's sharding (jit propagates
+            # input shardings to the zeros_like outputs)
+            opt_state = jax.jit(opt.init)(params)
+            return {"params": params, "opt": opt_state}
+
+        def step(state, tokens, targets):
+            def loss_fn(p):
+                return self.loss(p, tokens, targets, mesh=mesh,
+                                 seq_axis=sa, data_axis=data_axis,
+                                 model_axis=ma)
+
+            loss, grads = jax.value_and_grad(loss_fn)(state["params"])
+            updates, new_opt = opt.update(grads, state["opt"],
+                                          state["params"])
+            new_params = optax.apply_updates(state["params"], updates)
+            return {"params": new_params, "opt": new_opt}, loss
+
+        jstep = jax.jit(step,
+                        in_shardings=(None, tok_shard, tok_shard),
+                        donate_argnums=(0,))
+        return jstep, init_state

@@ -42,6 +42,16 @@ from .mesh import DeviceMesh
 __all__ = ["DistributedFrame", "distribute", "dmap_blocks",
            "dreduce_blocks"]
 
+def _jitted(comp):
+    """One jitted wrapper per live Computation, stored on the object so it
+    is collected with it: repeated dmap/dreduce calls on the same
+    computation reuse the trace instead of re-wrapping jax.jit."""
+    fn = getattr(comp, "_tft_jitted", None)
+    if fn is None:
+        fn = jax.jit(comp.fn)
+        comp._tft_jitted = fn
+    return fn
+
 
 class DistributedFrame:
     """Columns as global row-sharded jax Arrays + the true row count.
@@ -121,7 +131,7 @@ def dmap_blocks(fetches, dist: DistributedFrame,
     out_schema = _ops._validate_map(comp, schema, block_level=True, trim=trim)
     mesh = dist.mesh
 
-    jitted = jax.jit(comp.fn)
+    jitted = _jitted(comp)
     out = jitted({n: dist.columns[n] for n in comp.input_names})
     cols = {} if trim else dict(dist.columns)
     for spec in comp.outputs:
@@ -154,12 +164,18 @@ def dreduce_blocks(fetches, dist: DistributedFrame):
     return _generic_reduce(fetches, dist)
 
 
+# Compiled collective-reduce programs, keyed by everything that shapes the
+# program (mesh, axis, column names/ranks/dtypes/shapes, combiners). The
+# valid-row count is a traced scalar argument, NOT baked in, so frames of
+# different sizes with the same schema share one executable.
+_collective_cache: Dict[tuple, object] = {}
+
+
 def _collective_reduce(col_combiners: Mapping[str, str],
                        dist: DistributedFrame) -> Dict[str, np.ndarray]:
     mesh = dist.mesh
     axis = mesh.data_axis
-    n_valid = dist.num_rows
-    if n_valid == 0:
+    if dist.num_rows == 0:
         raise ValueError("reduce on an empty distributed frame")
     combs = {}
     for name, cname in col_combiners.items():
@@ -172,26 +188,33 @@ def _collective_reduce(col_combiners: Mapping[str, str],
 
     names = sorted(col_combiners)
     arrays = [dist.columns[n] for n in names]
-    in_specs = tuple(P(axis, *([None] * (a.ndim - 1))) for a in arrays)
-    out_specs = tuple(P() for _ in arrays)
+    key = (mesh.mesh, axis,
+           tuple((n, col_combiners[n], a.shape, str(a.dtype))
+                 for n, a in zip(names, arrays)))
+    fn = _collective_cache.get(key)
+    if fn is None:
+        in_specs = (P(),) + tuple(
+            P(axis, *([None] * (a.ndim - 1))) for a in arrays)
+        out_specs = tuple(P() for _ in arrays)
 
-    def shard_fn(*shards):
-        outs = []
-        rows = shards[0].shape[0]
-        idx = jax.lax.axis_index(axis) * rows + jnp.arange(rows)
-        valid = idx < n_valid
-        for name, s in zip(names, shards):
-            c = combs[name]
-            mask = valid.reshape((rows,) + (1,) * (s.ndim - 1))
-            neutral = jnp.asarray(c.neutral(s.dtype))
-            masked = jnp.where(mask, s, neutral)
-            local = c.local(masked, 0)
-            outs.append(c.collective(local, axis))
-        return tuple(outs)
+        def shard_fn(n_valid, *shards):
+            outs = []
+            rows = shards[0].shape[0]
+            idx = jax.lax.axis_index(axis) * rows + jnp.arange(rows)
+            valid = idx < n_valid
+            for name, s in zip(names, shards):
+                c = combs[name]
+                mask = valid.reshape((rows,) + (1,) * (s.ndim - 1))
+                neutral = jnp.asarray(c.neutral(s.dtype))
+                masked = jnp.where(mask, s, neutral)
+                local = c.local(masked, 0)
+                outs.append(c.collective(local, axis))
+            return tuple(outs)
 
-    fn = jax.jit(shard_map(shard_fn, mesh=mesh.mesh,
-                           in_specs=in_specs, out_specs=out_specs))
-    outs = fn(*arrays)
+        fn = jax.jit(shard_map(shard_fn, mesh=mesh.mesh,
+                               in_specs=in_specs, out_specs=out_specs))
+        _collective_cache[key] = fn
+    outs = fn(jnp.asarray(dist.num_rows, jnp.int32), *arrays)
     result = {}
     for name, a in zip(names, outs):
         v = np.asarray(a)
@@ -220,7 +243,7 @@ def _generic_reduce(fetches, dist: DistributedFrame) -> Dict[str, np.ndarray]:
     devices = [d for d in mesh.mesh.devices.flatten()][:shards]
     # inputs are committed per device; the jitted computation follows the
     # data, and jax.jit's own shape-keyed cache handles the ragged tail
-    jf = jax.jit(comp.fn)
+    jf = _jitted(comp)
     partials = []
     for s in range(shards):
         a0 = s * rows_per
@@ -237,7 +260,7 @@ def _generic_reduce(fetches, dist: DistributedFrame) -> Dict[str, np.ndarray]:
     stacked = {
         f + "_input": np.stack([np.asarray(p[f]) for p in partials])
         for f in fetch_names}
-    final = jax.jit(comp.fn)(stacked)
+    final = _jitted(comp)(stacked)
     out = {}
     for f in fetch_names:
         v = np.asarray(final[f])

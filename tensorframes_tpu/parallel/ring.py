@@ -53,7 +53,9 @@ def _local_attn_update(q, k, v, m, l, o, scale, mask):
 
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                    mesh: DeviceMesh, seq_axis: Optional[str] = None,
-                   causal: bool = False) -> jax.Array:
+                   causal: bool = False,
+                   batch_axis: Optional[str] = None,
+                   head_axis: Optional[str] = None) -> jax.Array:
     """Exact attention over a sequence sharded across a mesh axis.
 
     q/k/v: [batch, seq, heads, head_dim], seq row-sharded over ``seq_axis``
@@ -62,6 +64,11 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     interaction and ppermutes k/v one hop; softmax is exact via online
     (m, l, o) accumulation. Peak per-chip memory is O(S/n), enabling
     sequences n times longer than single-chip attention.
+
+    ``batch_axis``/``head_axis`` additionally shard the batch and head dims
+    (data/tensor parallelism composed with the sequence ring): attention is
+    independent across batch and heads, so those axes never communicate —
+    only k/v hop the ring over ``seq_axis``.
     """
     axis = seq_axis or mesh.data_axis
     n = mesh.mesh.shape[axis]
@@ -95,7 +102,7 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         l_safe = jnp.where(l == 0.0, 1.0, l)
         return o / l_safe.transpose(0, 2, 1)[..., None]
 
-    spec = P(None, axis, None, None)
+    spec = P(batch_axis, axis, head_axis, None)
     # check_vma=False: the (m, l, o) fori_loop carries start as unvarying
     # constants and become device-varying after the first update — a pattern
     # the varying-manual-axes checker cannot type without explicit pcasts
