@@ -1,0 +1,73 @@
+/* tfruntime — native runtime core for tensorframes_tpu.
+ *
+ * The reference framework's execution path bottoms out in a C++ runtime
+ * (libtensorflow via javacpp JNI; see SURVEY.md §2.2). In the TPU-native
+ * design, XLA is the compute engine, and THIS library is the native side of
+ * everything around it: the host-side marshalling hot loops
+ * (DataOps.convert / convertBack analogues), ragged-cell packing, and an
+ * aligned, pooled host allocator for staging buffers.
+ *
+ * Pure C ABI — consumed from Python via ctypes (tensorframes_tpu/native.py)
+ * with a numpy fallback when the library is not built.
+ */
+#ifndef TFRUNTIME_H
+#define TFRUNTIME_H
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* dtype codes (stable ABI; mirrored in tensorframes_tpu/native.py) */
+enum tfr_dtype {
+  TFR_F32 = 0,
+  TFR_F64 = 1,
+  TFR_I32 = 2,
+  TFR_I64 = 3,
+  TFR_U8  = 4,
+};
+
+const char *tfr_version(void);
+
+/* Parallelism knob for the conversion/gather kernels. n <= 0 resets to the
+ * hardware default. */
+void tfr_set_threads(int n);
+int  tfr_get_threads(void);
+
+/* Elementwise dtype conversion src[0..n) -> dst[0..n), multithreaded for
+ * large n. Returns 0 on success, -1 on unsupported dtype pair. */
+int tfr_convert(const void *src, int src_dtype, void *dst, int dst_dtype,
+                int64_t n);
+
+/* Row gather: dst[i] = src[idx[i]] where each row is row_bytes wide.
+ * idx values must be in [0, n_src). Returns 0, or -1 on a bad index. */
+int tfr_gather_rows(const void *src, int64_t n_src, const int64_t *idx,
+                    int64_t n_idx, int64_t row_bytes, void *dst);
+
+/* Ragged pack: concatenate n buffers (ptrs[i], nbytes[i]) into dst;
+ * offsets[0..n] gets the CSR byte offsets (offsets[n] = total). dst may be
+ * NULL to only compute offsets. Returns total bytes. */
+int64_t tfr_pack_ragged(const void *const *ptrs, const int64_t *nbytes,
+                        int64_t n, void *dst, int64_t *offsets);
+
+/* Ragged pad-to-dense: row i holds lens[i] elements of elem_size bytes;
+ * dst is [n, max_len] elements, zero padded; mask (may be NULL) is
+ * [n, max_len] bytes, 1 = valid. Returns 0, or -1 if some lens[i] > max_len. */
+int tfr_pad_ragged(const void *const *ptrs, const int64_t *lens, int64_t n,
+                   int64_t max_len, int64_t elem_size, void *dst,
+                   uint8_t *mask);
+
+/* Pooled 64-byte-aligned host allocation. Freed buffers are kept in
+ * per-size-class freelists for reuse (staging buffers have a few hot
+ * sizes); tfr_pool_trim releases them to the OS. */
+void   *tfr_alloc(int64_t nbytes);
+void    tfr_free(void *p, int64_t nbytes);
+int64_t tfr_pool_bytes(void);   /* bytes currently cached in freelists */
+void    tfr_pool_trim(void);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* TFRUNTIME_H */

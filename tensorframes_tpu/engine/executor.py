@@ -23,6 +23,7 @@ import jax
 import numpy as np
 
 from .. import dtypes as _dt
+from .. import native as _native
 from ..computation import Computation
 
 __all__ = ["BlockExecutor", "default_executor"]
@@ -84,7 +85,7 @@ class BlockExecutor:
             a = np.asarray(arrays[spec.name])
             dd = _dt.device_dtype(spec.dtype)
             if a.dtype != dd:
-                a = a.astype(dd)
+                a = _native.convert(a, dd)  # threaded kernel when built
             dev_arrays[spec.name] = a
             if spec.shape.ndim > 0 and spec.shape.head == -1:
                 n_rows = a.shape[0] if n_rows is None else n_rows
@@ -97,8 +98,13 @@ class BlockExecutor:
                 for spec in comp.inputs:
                     a = dev_arrays[spec.name]
                     if spec.shape.ndim > 0 and spec.shape.head == -1:
-                        pad = [(0, pad_to - n_rows)] + [(0, 0)] * (a.ndim - 1)
-                        a = np.pad(a, pad, mode="edge")
+                        # pooled staging buffer: bucketed sizes are hot, so
+                        # freed buffers are immediately reused (native.py)
+                        dst = _native.empty_aligned(
+                            (pad_to,) + a.shape[1:], a.dtype)
+                        dst[:n_rows] = a
+                        dst[n_rows:] = a[n_rows - 1:n_rows]  # edge fill
+                        a = dst
                     padded[spec.name] = a
                 dev_arrays = padded
 
@@ -114,7 +120,7 @@ class BlockExecutor:
                 a = a[:n_rows]
             storage = spec.dtype.np_storage
             if a.dtype != storage and spec.dtype is not _dt.bfloat16:
-                a = a.astype(storage)
+                a = _native.convert(a, storage)
             result[spec.name] = a
         return result
 

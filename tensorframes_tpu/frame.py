@@ -301,6 +301,80 @@ class TensorFrame:
         return TensorFrame(self._schema, thunk, n,
                            plan=f"repartition({self._plan})")
 
+    def pad_column(self, name: str, max_len: Optional[int] = None,
+                   pow2: bool = False, mask_col: Optional[str] = None,
+                   len_col: Optional[str] = None) -> "TensorFrame":
+        """Pad a ragged 1-d column to a dense ``[rows, L]`` column plus a
+        validity-mask column and a length column — making it usable by the
+        block-level ops despite XLA's static-shape world (SURVEY.md §7 hard
+        part #1: bucketed padding + mask). Eager on the column lengths.
+
+        ``pow2`` rounds L up to a power of two so frames of many ragged
+        profiles share compile signatures downstream.
+        """
+        field = self._schema.get(name)
+        if field is None:
+            raise KeyError(f"No column {name!r}")
+        mask_col = mask_col or f"{name}_mask"
+        len_col = len_col or f"{name}_len"
+        for c in (mask_col, len_col):
+            if c in self._schema:
+                raise ValueError(f"Column {c!r} already exists")
+        blocks = self.blocks()
+
+        def cell_list(b: Block) -> List[np.ndarray]:
+            col = b.columns[name]
+            return [np.asarray(col[i]) for i in range(b.num_rows)]
+
+        longest = 0
+        for b in blocks:
+            for c in cell_list(b):
+                if c.ndim != 1:
+                    raise ValueError(
+                        f"pad_column supports 1-d cells; {name!r} has a "
+                        f"rank-{c.ndim} cell")
+                longest = max(longest, c.size)
+        L = max_len if max_len is not None else longest
+        if pow2:
+            p = 1
+            while p < L:
+                p *= 2
+            L = p
+
+        from . import native as _native
+
+        def pad_block(b: Block) -> Block:
+            cols = dict(b.columns)
+            if b.num_rows == 0:
+                cols[name] = np.zeros((0, L), field.dtype.np_storage)
+                cols[mask_col] = np.zeros((0, L), np.int32)
+                cols[len_col] = np.zeros((0,), np.int64)
+            else:
+                cells = cell_list(b)
+                dense, mask = _native.pad_ragged(
+                    cells, max_len=L, dtype=field.dtype.np_storage)
+                cols[name] = dense
+                cols[mask_col] = mask.astype(np.int32)
+                cols[len_col] = np.array([c.size for c in cells], np.int64)
+            return Block(cols, b.num_rows)
+
+        fields = []
+        for f in self._schema:
+            if f.name == name:
+                fields.append(Field(name, f.dtype,
+                                    block_shape=Shape(Unknown, L),
+                                    sql_rank=1))
+            else:
+                fields.append(f)
+        fields.append(Field(mask_col, _dt.int32,
+                            block_shape=Shape(Unknown, L), sql_rank=1))
+        fields.append(Field(len_col, _dt.int64,
+                            block_shape=Shape(Unknown), sql_rank=0))
+        out = [pad_block(b) for b in blocks]
+        return TensorFrame(Schema(fields), lambda: out,
+                           self._num_partitions,
+                           plan=f"pad_column({self._plan})")
+
     def group_by(self, *cols: str) -> "GroupedFrame":
         for c in cols:
             if c not in self._schema:

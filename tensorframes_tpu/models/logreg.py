@@ -95,7 +95,8 @@ class LogisticRegression:
         gb_s, gw_s, nll_s = sums  # fetches come back sorted by name
         grad = {"w": gw_s / n_total + self.l2 * w,
                 "b": gb_s / n_total}
-        return grad, float(nll_s / n_total)
+        loss = float(nll_s / n_total + 0.5 * self.l2 * np.sum(w ** 2))
+        return grad, loss
 
     def fit_via_frame(self, df: TensorFrame, steps: int = 10,
                       lr: float = 0.5, features: str = "features",

@@ -210,8 +210,7 @@ class TransformerLM:
         p_shard = (self.param_shardings(mesh, ma) if ma
                    else jax.tree_util.tree_map(
                        lambda _: NamedSharding(mesh.mesh, P()),
-                       self.init(jax.random.PRNGKey(0)),
-                       is_leaf=lambda x: isinstance(x, jax.Array)))
+                       jax.eval_shape(self.init)))
         tok_shard = NamedSharding(mesh.mesh, P(data_axis, sa))
         opt = optax.adam(learning_rate)
 

@@ -109,7 +109,8 @@ def distribute(df: TensorFrame, mesh: DeviceMesh) -> DistributedFrame:
         a = merged.dense(f.name)
         dd = _dt.device_dtype(f.dtype)
         if a.dtype != dd:
-            a = a.astype(dd)
+            from .. import native as _native
+            a = _native.convert(a, dd)
         if padded != n:
             pad = [(0, padded - n)] + [(0, 0)] * (a.ndim - 1)
             a = np.pad(a, pad)
@@ -165,10 +166,14 @@ def dreduce_blocks(fetches, dist: DistributedFrame):
 
 
 # Compiled collective-reduce programs, keyed by everything that shapes the
-# program (mesh, axis, column names/ranks/dtypes/shapes, combiners). The
-# valid-row count is a traced scalar argument, NOT baked in, so frames of
-# different sizes with the same schema share one executable.
-_collective_cache: Dict[tuple, object] = {}
+# program (mesh, axis, column names/padded shapes/dtypes, combiners). The
+# valid-row count is a traced scalar argument, not baked in, so frames whose
+# padded global shapes coincide share one executable. LRU-bounded: distinct
+# padded shapes otherwise accumulate executables without limit.
+from collections import OrderedDict
+
+_collective_cache: "OrderedDict[tuple, object]" = OrderedDict()
+_COLLECTIVE_CACHE_CAP = 64
 
 
 def _collective_reduce(col_combiners: Mapping[str, str],
@@ -192,7 +197,9 @@ def _collective_reduce(col_combiners: Mapping[str, str],
            tuple((n, col_combiners[n], a.shape, str(a.dtype))
                  for n, a in zip(names, arrays)))
     fn = _collective_cache.get(key)
-    if fn is None:
+    if fn is not None:
+        _collective_cache.move_to_end(key)
+    else:
         in_specs = (P(),) + tuple(
             P(axis, *([None] * (a.ndim - 1))) for a in arrays)
         out_specs = tuple(P() for _ in arrays)
@@ -214,6 +221,8 @@ def _collective_reduce(col_combiners: Mapping[str, str],
         fn = jax.jit(shard_map(shard_fn, mesh=mesh.mesh,
                                in_specs=in_specs, out_specs=out_specs))
         _collective_cache[key] = fn
+        while len(_collective_cache) > _COLLECTIVE_CACHE_CAP:
+            _collective_cache.popitem(last=False)
     outs = fn(jnp.asarray(dist.num_rows, jnp.int32), *arrays)
     result = {}
     for name, a in zip(names, outs):

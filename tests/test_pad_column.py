@@ -1,0 +1,53 @@
+"""pad_column: ragged -> dense+mask+len, then block ops on the result."""
+
+import numpy as np
+import pytest
+
+import tensorframes_tpu as tft
+
+
+def _ragged_frame(parts=2):
+    rows = [(np.arange(i + 1, dtype=np.float64),) for i in range(7)]
+    return tft.frame(rows, columns=["v"], num_partitions=parts)
+
+
+def test_pad_column_shapes_and_mask():
+    df = _ragged_frame().pad_column("v")
+    assert df.columns == ["v", "v_mask", "v_len"]
+    rows = df.collect()
+    assert len(rows) == 7
+    for i, r in enumerate(rows):
+        assert r["v"].shape == (7,)
+        np.testing.assert_array_equal(r["v"][: i + 1], np.arange(i + 1))
+        assert (r["v"][i + 1:] == 0).all()
+        np.testing.assert_array_equal(
+            r["v_mask"], (np.arange(7) < i + 1).astype(np.int32))
+        assert r["v_len"] == i + 1
+
+
+def test_pad_column_pow2_and_block_op():
+    df = _ragged_frame().pad_column("v", pow2=True)
+    rows = df.collect()
+    assert rows[0]["v"].shape == (8,)  # 7 -> 8
+
+    # the padded frame is block-op capable: masked per-row mean
+    out = df.map_blocks(
+        lambda v, v_mask, v_len: {
+            "mean": (v * v_mask).sum(axis=1) / v_len})
+    for i, r in enumerate(out.collect()):
+        assert r["mean"] == pytest.approx(np.arange(i + 1).mean())
+
+
+def test_pad_column_rejects_collision_and_rank():
+    df = _ragged_frame()
+    with pytest.raises(ValueError):
+        df.pad_column("v", mask_col="v")
+    dense = tft.frame({"m": np.zeros((3, 2, 2))})
+    with pytest.raises(ValueError):
+        dense.pad_column("m")
+
+
+def test_pad_column_explicit_max_len_overflow():
+    df = _ragged_frame()
+    with pytest.raises(ValueError):
+        df.pad_column("v", max_len=3).blocks()
