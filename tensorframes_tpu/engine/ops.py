@@ -364,7 +364,10 @@ def map_rows(fetches: Fetches, df: TensorFrame,
                 grp = [cells[n][i] for i in idxs]
                 values, _ = _native.pack_ragged(grp, dtype=grp[0].dtype)
                 arrays[n] = values.reshape((len(idxs),) + grp[0].shape)
-            out = ex.run(vcomp, arrays, pad_ok=False)
+            # rows are independent under vmap, so row-dim padding is as
+            # safe here as on the dense path: group sizes bucket to O(log)
+            # compile signatures instead of one per distinct count
+            out = ex.run(vcomp, arrays)
             for f in fetch_names:
                 for j, i in enumerate(idxs):
                     per_row[f][i] = out[f][j]

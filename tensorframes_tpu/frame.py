@@ -326,6 +326,7 @@ class TensorFrame:
             col = b.columns[name]
             return [np.asarray(col[i]) for i in range(b.num_rows)]
 
+        # eager only on the length/rank scan; padded blocks build lazily
         longest = 0
         for b in blocks:
             for c in cell_list(b):
@@ -370,8 +371,8 @@ class TensorFrame:
                             block_shape=Shape(Unknown, L), sql_rank=1))
         fields.append(Field(len_col, _dt.int64,
                             block_shape=Shape(Unknown), sql_rank=0))
-        out = [pad_block(b) for b in blocks]
-        return TensorFrame(Schema(fields), lambda: out,
+        return TensorFrame(Schema(fields),
+                           lambda: [pad_block(b) for b in blocks],
                            self._num_partitions,
                            plan=f"pad_column({self._plan})")
 

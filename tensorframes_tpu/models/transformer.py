@@ -118,14 +118,10 @@ class TransformerLM:
             return ring_attention(q, k, v, mesh, seq_axis=seq_axis,
                                   causal=True, batch_axis=data_axis,
                                   head_axis=model_axis)
-        scale = float(1.0 / np.sqrt(q.shape[-1]))
-        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
-        S = q.shape[1]
-        mask = jnp.tril(jnp.ones((S, S), bool))
-        scores = jnp.where(mask[None, None], scores, -jnp.inf)
-        p = jax.nn.softmax(scores.astype(jnp.float32), axis=-1
-                           ).astype(q.dtype)
-        return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+        # single-device path: the Pallas flash kernel on TPU (blockwise,
+        # scores never leave VMEM), plain-XLA softmax attention elsewhere
+        from ..ops import flash_attention
+        return flash_attention(q, k, v, causal=True)
 
     def apply(self, params: Params, tokens: jax.Array,
               mesh: Optional[DeviceMesh] = None,

@@ -136,6 +136,9 @@ def gather_rows(src: np.ndarray, idx: np.ndarray) -> np.ndarray:
     idx = np.ascontiguousarray(idx, np.int64)
     if (lib is None or src.nbytes < _MIN_NATIVE_BYTES
             or not src.flags.c_contiguous or src.ndim < 1):
+        # match the native kernel's contract exactly: no negative-wrapping
+        if idx.size and (idx.min() < 0 or idx.max() >= src.shape[0]):
+            raise IndexError("gather_rows: index out of bounds")
         return src[idx]
     row_bytes = src.dtype.itemsize * int(np.prod(src.shape[1:], dtype=np.int64))
     if row_bytes == 0:

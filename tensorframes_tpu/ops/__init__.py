@@ -1,0 +1,20 @@
+"""Hand-written TPU kernels (Pallas) for the framework's hot ops.
+
+The reference's compute path bottoms out in whatever libtensorflow's C++
+kernels do (SURVEY.md §2.2); here XLA covers the general case and this
+package holds the ops worth hand-scheduling on the TPU's memory hierarchy:
+
+- :func:`flash_attention` — blockwise attention with online softmax; the
+  quadratic-memory score matrix never leaves VMEM.
+- :func:`segment_sum` — keyed segment reduction via one-hot matmul on the
+  MXU; the device-side core of ``aggregate`` and the k-means
+  ``unsorted_segment_sum`` pattern.
+
+Every kernel has a pure-XLA fallback (`impl="xla"`) that is the semantic
+reference; CPU tests run the Pallas path in interpret mode.
+"""
+
+from .flash_attention import flash_attention
+from .segment_reduce import segment_sum
+
+__all__ = ["flash_attention", "segment_sum"]

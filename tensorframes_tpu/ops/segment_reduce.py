@@ -1,0 +1,121 @@
+"""Keyed segment reduction as a Pallas TPU kernel.
+
+``segment_sum(values, segment_ids, num_segments)`` is the device-side core
+of keyed aggregation — the TPU-native answer to the reference's
+``unsorted_segment_sum`` k-means pattern (``kmeans_demo.py:128-140``) and
+the UDAF shuffle+reduce (``DebugRowOps.scala:533-578``).
+
+XLA lowers ``jax.ops.segment_sum`` to scatter-add, which serializes on the
+TPU. This kernel instead expresses the reduction as a **one-hot matmul**:
+for each row-block, build the ``[block_rows, num_segments]`` one-hot matrix
+of segment ids and contract it against the values block on the MXU —
+``[S, bn] @ [bn, d] -> [S, d]`` — accumulating partials into the output
+block across the sequential grid. Out-of-range ids (e.g. -1 pad rows)
+produce an all-zero one-hot row and contribute nothing, for free.
+
+Fallback (`impl="xla"`): ``jax.ops.segment_sum``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["segment_sum"]
+
+
+def _kernel(ids_ref, vals_ref, out_ref, *, block_rows: int,
+            num_segments: int):
+    step = pl.program_id(0)
+
+    @pl.when(step == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    ids = ids_ref[:]                       # [bn, 1] int32
+    vals = vals_ref[:]                     # [bn, d]
+    seg = jax.lax.broadcasted_iota(jnp.int32, (block_rows, num_segments), 1)
+    onehot = (ids == seg).astype(jnp.float32)            # [bn, S]
+    partial = jax.lax.dot_general(
+        onehot, vals.astype(jnp.float32),
+        (((0,), (0,)), ((), ())),          # contract the row dim: [S, d]
+        precision=jax.lax.Precision.HIGHEST,  # exact f32: this is an
+        preferred_element_type=jnp.float32)   # aggregation, not attention
+    out_ref[:] = out_ref[:] + partial.astype(out_ref.dtype)
+
+
+def _pallas_segment_sum(values, segment_ids, num_segments: int,
+                        block_rows: int, interpret: bool):
+    n, d = values.shape
+    acc_dtype = jnp.float32 if jnp.issubdtype(values.dtype, jnp.floating) \
+        else values.dtype
+    if n == 0:
+        return jnp.zeros((num_segments, d), values.dtype)
+    block_rows = min(block_rows, n)
+    pad = (-n) % block_rows
+    if pad:
+        values = jnp.pad(values, ((0, pad), (0, 0)))
+        # pad ids with -1: matches no segment, so pad rows vanish
+        segment_ids = jnp.pad(segment_ids, (0, pad), constant_values=-1)
+    nblocks = values.shape[0] // block_rows
+
+    kern = functools.partial(_kernel, block_rows=block_rows,
+                             num_segments=num_segments)
+    out = pl.pallas_call(
+        kern,
+        grid=(nblocks,),
+        in_specs=[
+            pl.BlockSpec((block_rows, 1), lambda i: (i, 0)),
+            pl.BlockSpec((block_rows, d), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((num_segments, d), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((num_segments, d), acc_dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)),
+        interpret=interpret,
+    )(segment_ids.astype(jnp.int32).reshape(-1, 1), values)
+    return out.astype(values.dtype)
+
+
+def segment_sum(values: jax.Array, segment_ids: jax.Array,
+                num_segments: int, block_rows: int = 512,
+                impl: Optional[str] = None) -> jax.Array:
+    """Sum ``values`` rows into ``num_segments`` buckets by ``segment_ids``.
+
+    ``values``: [N, ...] (trailing dims flattened for the kernel and
+    restored); ``segment_ids``: [N] ints in [0, num_segments) — rows with
+    out-of-range ids are dropped. Returns [num_segments, ...].
+
+    ``impl``: ``"pallas"`` / ``"xla"`` / ``"interpret"``; None picks Pallas
+    on TPU.
+    """
+    if impl is None:
+        impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+    values = jnp.asarray(values)
+    segment_ids = jnp.asarray(segment_ids)
+    if not jnp.issubdtype(values.dtype, jnp.floating):
+        # the one-hot matmul accumulates in f32, which is only exact to
+        # 2^24 — integer aggregation must stay exact, so it always takes
+        # the scatter-add path
+        impl = "xla"
+    if impl == "xla":
+        valid = (segment_ids >= 0) & (segment_ids < num_segments)
+        shaped = jnp.where(
+            valid.reshape((-1,) + (1,) * (values.ndim - 1)), values, 0)
+        ids = jnp.where(valid, segment_ids, 0)
+        return jax.ops.segment_sum(shaped, ids, num_segments=num_segments)
+    if impl not in ("pallas", "interpret"):
+        raise ValueError(f"Unknown segment_sum impl {impl!r}")
+    tail = values.shape[1:]
+    d = 1
+    for t in tail:
+        d *= t
+    flat = values.reshape(values.shape[0], d)
+    out = _pallas_segment_sum(flat, segment_ids, num_segments,
+                              block_rows, interpret=(impl == "interpret"))
+    return out.reshape((num_segments,) + tail)

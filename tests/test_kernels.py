@@ -1,0 +1,132 @@
+"""Pallas kernel tests, run in interpreter mode on the CPU backend.
+
+The XLA implementations are the semantic oracles (the ExtractNodes pattern
+from SURVEY.md §4 applied to kernels: same computation, two lowerings, equal
+outputs).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensorframes_tpu.ops import flash_attention, segment_sum
+
+
+def _qkv(rng, b=2, s=64, h=2, d=16, dtype=jnp.float32):
+    q = jnp.asarray(rng.standard_normal((b, s, h, d)), dtype)
+    k = jnp.asarray(rng.standard_normal((b, s, h, d)), dtype)
+    v = jnp.asarray(rng.standard_normal((b, s, h, d)), dtype)
+    return q, k, v
+
+
+class TestFlashAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_xla(self, rng, causal):
+        q, k, v = _qkv(rng)
+        ref = flash_attention(q, k, v, causal=causal, impl="xla")
+        out = flash_attention(q, k, v, causal=causal, impl="interpret",
+                              block_q=16, block_k=16)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_non_multiple_seq_len(self, rng):
+        # seq length not a multiple of the block: pad rows must not leak
+        q, k, v = _qkv(rng, s=37)
+        ref = flash_attention(q, k, v, impl="xla")
+        out = flash_attention(q, k, v, impl="interpret",
+                              block_q=16, block_k=16)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_causal_non_multiple(self, rng):
+        q, k, v = _qkv(rng, s=21)
+        ref = flash_attention(q, k, v, causal=True, impl="xla")
+        out = flash_attention(q, k, v, causal=True, impl="interpret",
+                              block_q=8, block_k=8)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_cross_attention_lengths(self, rng):
+        # Sq != Sk (decoder attending over a different-length memory)
+        q = jnp.asarray(rng.standard_normal((1, 24, 2, 8)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((1, 40, 2, 8)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((1, 40, 2, 8)), jnp.float32)
+        ref = flash_attention(q, k, v, impl="xla")
+        out = flash_attention(q, k, v, impl="interpret",
+                              block_q=16, block_k=16)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_single_block(self, rng):
+        q, k, v = _qkv(rng, s=8)
+        ref = flash_attention(q, k, v, impl="xla")
+        out = flash_attention(q, k, v, impl="interpret")
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_matches_ring_attention(self, rng):
+        """Kernel and the mesh-level ring implementation agree — the two
+        halves of the long-context story compute the same function."""
+        from tensorframes_tpu.parallel.mesh import local_mesh
+        from tensorframes_tpu.parallel.ring import ring_attention
+
+        mesh = local_mesh(4)
+        q, k, v = _qkv(rng, b=1, s=32, h=2, d=8)
+        ref = np.asarray(flash_attention(q, k, v, causal=True, impl="xla"))
+        ring = np.asarray(ring_attention(q, k, v, mesh, causal=True))
+        flash = np.asarray(flash_attention(q, k, v, causal=True,
+                                           impl="interpret",
+                                           block_q=8, block_k=8))
+        np.testing.assert_allclose(ring, ref, rtol=2e-5, atol=2e-5)
+        np.testing.assert_allclose(flash, ref, rtol=2e-5, atol=2e-5)
+
+
+class TestSegmentSum:
+    def test_matches_xla(self, rng):
+        vals = jnp.asarray(rng.standard_normal((100, 5)), jnp.float32)
+        ids = jnp.asarray(rng.integers(0, 7, 100), jnp.int32)
+        ref = segment_sum(vals, ids, 7, impl="xla")
+        out = segment_sum(vals, ids, 7, impl="interpret", block_rows=16)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_out_of_range_ids_dropped(self, rng):
+        vals = jnp.ones((10, 2), jnp.float32)
+        ids = jnp.asarray([0, 1, -1, 2, 5, 1, 0, -1, 2, 1], jnp.int32)
+        out = segment_sum(vals, ids, 3, impl="interpret", block_rows=4)
+        ref = segment_sum(vals, ids, 3, impl="xla")
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref))
+        # id 5 and -1 dropped: total mass = rows with id in [0, 3)
+        assert float(np.asarray(out).sum()) == pytest.approx(2 * 7)
+
+    def test_1d_values(self, rng):
+        vals = jnp.asarray(rng.standard_normal(50), jnp.float32)
+        ids = jnp.asarray(rng.integers(0, 4, 50), jnp.int32)
+        ref = segment_sum(vals, ids, 4, impl="xla")
+        out = segment_sum(vals, ids, 4, impl="interpret", block_rows=8)
+        assert out.shape == (4,)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_nd_values(self, rng):
+        vals = jnp.asarray(rng.standard_normal((30, 2, 3)), jnp.float32)
+        ids = jnp.asarray(rng.integers(0, 5, 30), jnp.int32)
+        ref = segment_sum(vals, ids, 5, impl="xla")
+        out = segment_sum(vals, ids, 5, impl="interpret", block_rows=8)
+        assert out.shape == (5, 2, 3)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_empty(self):
+        vals = jnp.zeros((0, 3), jnp.float32)
+        ids = jnp.zeros((0,), jnp.int32)
+        out = segment_sum(vals, ids, 4, impl="interpret")
+        np.testing.assert_array_equal(np.asarray(out), np.zeros((4, 3)))
+
+    def test_int_values(self, rng):
+        vals = jnp.asarray(rng.integers(-5, 5, (40, 2)), jnp.int32)
+        ids = jnp.asarray(rng.integers(0, 3, 40), jnp.int32)
+        ref = segment_sum(vals, ids, 3, impl="xla")
+        out = segment_sum(vals, ids, 3, impl="interpret", block_rows=16)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
