@@ -29,6 +29,8 @@ __version__ = "0.1.0"
 
 from .shape import Shape, Unknown
 from . import dtypes
+from . import utils
+from .utils.logging import initialize_logging
 from .schema import Field, Schema
 from .frame import Block, GroupedFrame, Row, TensorFrame
 from .computation import Computation, TensorSpec, analyze_graph
@@ -61,5 +63,7 @@ __all__ = [
     "block",
     "row",
     "frame",
+    "utils",
+    "initialize_logging",
     "__version__",
 ]

@@ -25,8 +25,12 @@ import numpy as np
 from .. import dtypes as _dt
 from .. import native as _native
 from ..computation import Computation
+from ..utils.logging import get_logger
+from ..utils.tracing import enabled as _tracing_enabled, span
 
 __all__ = ["BlockExecutor", "default_executor"]
+
+_log = get_logger("engine.executor")
 
 
 def _next_bucket(n: int, minimum: int = 8) -> int:
@@ -68,6 +72,8 @@ class BlockExecutor:
                     fn = jax.jit(comp.fn)
                     per_comp[sig] = fn
                     self.compile_count += 1
+                    _log.debug("compile #%d for signature %s",
+                               self.compile_count, sig)
         return fn
 
     # -- execution ---------------------------------------------------------
@@ -81,14 +87,15 @@ class BlockExecutor:
         """
         dev_arrays = {}
         n_rows = None
-        for spec in comp.inputs:
-            a = np.asarray(arrays[spec.name])
-            dd = _dt.device_dtype(spec.dtype)
-            if a.dtype != dd:
-                a = _native.convert(a, dd)  # threaded kernel when built
-            dev_arrays[spec.name] = a
-            if spec.shape.ndim > 0 and spec.shape.head == -1:
-                n_rows = a.shape[0] if n_rows is None else n_rows
+        with span("executor.convert"):
+            for spec in comp.inputs:
+                a = np.asarray(arrays[spec.name])
+                dd = _dt.device_dtype(spec.dtype)
+                if a.dtype != dd:
+                    a = _native.convert(a, dd)  # threaded kernel when built
+                dev_arrays[spec.name] = a
+                if spec.shape.ndim > 0 and spec.shape.head == -1:
+                    n_rows = a.shape[0] if n_rows is None else n_rows
 
         pad_to = None
         if self.pad_rows and pad_ok and n_rows is not None:
@@ -111,17 +118,23 @@ class BlockExecutor:
         sig = tuple(sorted(
             (n, a.shape, str(a.dtype)) for n, a in dev_arrays.items()))
         fn = self._compiled(comp, sig)
-        out = fn(dev_arrays)
+        with span("executor.dispatch"):
+            out = fn(dev_arrays)
+            if _tracing_enabled():
+                # JAX dispatch is async; without this the device time would
+                # be misattributed to convert_back's np.asarray
+                jax.block_until_ready(out)
         result: Dict[str, np.ndarray] = {}
-        for spec in comp.outputs:
-            a = np.asarray(out[spec.name])
-            if pad_to is not None and spec.shape.ndim > 0 \
-                    and spec.shape.head == -1 and a.shape[:1] == (pad_to,):
-                a = a[:n_rows]
-            storage = spec.dtype.np_storage
-            if a.dtype != storage and spec.dtype is not _dt.bfloat16:
-                a = _native.convert(a, storage)
-            result[spec.name] = a
+        with span("executor.convert_back"):
+            for spec in comp.outputs:
+                a = np.asarray(out[spec.name])
+                if pad_to is not None and spec.shape.ndim > 0 \
+                        and spec.shape.head == -1 and a.shape[:1] == (pad_to,):
+                    a = a[:n_rows]
+                storage = spec.dtype.np_storage
+                if a.dtype != storage and spec.dtype is not _dt.bfloat16:
+                    a = _native.convert(a, storage)
+                result[spec.name] = a
         return result
 
     def clear(self):

@@ -36,8 +36,12 @@ from ..frame import Block, GroupedFrame, Row, TensorFrame
 from ..marshal import Column
 from ..schema import Field, Schema
 from ..shape import Shape, Unknown
+from ..utils.logging import get_logger
+from ..utils.tracing import span
 from .compaction import CompactionBuffer, DEFAULT_BUFFER_SIZE
 from .executor import BlockExecutor, default_executor
+
+_log = get_logger("engine.ops")
 
 __all__ = [
     "map_blocks", "map_rows", "reduce_blocks", "reduce_rows", "aggregate",
@@ -266,6 +270,8 @@ def map_blocks(fetches: Fetches, df: TensorFrame, trim: bool = False,
     out_schema = _validate_map(comp, df.schema, block_level=True, trim=trim)
     in_names = comp.input_names
     fetch_names = comp.output_names
+    _log.debug("map_blocks: inputs=%s fetches=%s trim=%s",
+               in_names, fetch_names, trim)
 
     def run_block(b: Block) -> Block:
         if b.num_rows == 0:
@@ -278,10 +284,11 @@ def map_blocks(fetches: Fetches, df: TensorFrame, trim: bool = False,
                              for d in (cell.dims if cell else ()))
                 cols[f.name] = np.empty((0,) + dims, f.dtype.np_storage)
             return Block(cols, 0)
-        arrays = {n: b.dense(n) for n in in_names}
-        # trim may legally change the row count; padding would corrupt it,
-        # and non-row-local computations must see the true block.
-        out = ex.run(comp, arrays, pad_ok=not trim)
+        with span("map_blocks.block"):
+            arrays = {n: b.dense(n) for n in in_names}
+            # trim may legally change the row count; padding would corrupt
+            # it, and non-row-local computations must see the true block.
+            out = ex.run(comp, arrays, pad_ok=not trim)
         lead = {out[f].shape[0] for f in fetch_names}
         if len(lead) > 1:
             raise InvalidShapeError(
@@ -340,8 +347,9 @@ def map_rows(fetches: Fetches, df: TensorFrame,
             return Block(cols, 0)
         dense = all(not b.is_ragged(n) for n in in_names)
         if dense:
-            arrays = {n: b.dense(n) for n in in_names}
-            out = ex.run(vcomp, arrays)
+            with span("map_rows.block_dense"):
+                arrays = {n: b.dense(n) for n in in_names}
+                out = ex.run(vcomp, arrays)
             cols = dict(b.columns)
             cols.update({f: out[f] for f in fetch_names})
             return Block(cols, b.num_rows)
@@ -405,18 +413,20 @@ def reduce_blocks(fetches: Fetches, df: TensorFrame,
     fetch_names = comp.output_names
 
     partials: List[Dict[str, np.ndarray]] = []
-    for b in df.blocks():
-        if b.num_rows == 0:
-            continue  # empty-partition guard (reference :477-479)
-        arrays = {f + "_input": b.dense(f) for f in fetch_names}
-        partials.append(ex.run(comp, arrays, pad_ok=False))
+    with span("reduce_blocks.partials"):
+        for b in df.blocks():
+            if b.num_rows == 0:
+                continue  # empty-partition guard (reference :477-479)
+            arrays = {f + "_input": b.dense(f) for f in fetch_names}
+            partials.append(ex.run(comp, arrays, pad_ok=False))
     if not partials:
         raise ValueError("reduce_blocks on an empty frame")
     if len(partials) == 1:
         return partials[0]
-    stacked = {f + "_input": np.stack([p[f] for p in partials])
-               for f in fetch_names}
-    return ex.run(comp, stacked, pad_ok=False)
+    with span("reduce_blocks.combine"):
+        stacked = {f + "_input": np.stack([p[f] for p in partials])
+                   for f in fetch_names}
+        return ex.run(comp, stacked, pad_ok=False)
 
 
 def reduce_rows(fetches: Fetches, df: TensorFrame,

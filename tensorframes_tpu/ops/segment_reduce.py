@@ -52,8 +52,8 @@ def _kernel(ids_ref, vals_ref, out_ref, *, block_rows: int,
 def _pallas_segment_sum(values, segment_ids, num_segments: int,
                         block_rows: int, interpret: bool):
     n, d = values.shape
-    acc_dtype = jnp.float32 if jnp.issubdtype(values.dtype, jnp.floating) \
-        else values.dtype
+    # callers guarantee floating values (segment_sum routes ints to XLA)
+    acc_dtype = jnp.float32
     if n == 0:
         return jnp.zeros((num_segments, d), values.dtype)
     block_rows = min(block_rows, n)
@@ -94,15 +94,21 @@ def segment_sum(values: jax.Array, segment_ids: jax.Array,
     ``impl``: ``"pallas"`` / ``"xla"`` / ``"interpret"``; None picks Pallas
     on TPU.
     """
-    if impl is None:
-        impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+    if impl not in (None, "pallas", "interpret", "xla"):
+        raise ValueError(f"Unknown segment_sum impl {impl!r}")
     values = jnp.asarray(values)
     segment_ids = jnp.asarray(segment_ids)
     if not jnp.issubdtype(values.dtype, jnp.floating):
         # the one-hot matmul accumulates in f32, which is only exact to
         # 2^24 — integer aggregation must stay exact, so it always takes
         # the scatter-add path
+        if impl in ("pallas", "interpret"):
+            raise ValueError(
+                f"segment_sum impl={impl!r} accumulates in f32 and is "
+                "inexact for integer values; use impl='xla'")
         impl = "xla"
+    elif impl is None:
+        impl = "pallas" if jax.default_backend() == "tpu" else "xla"
     if impl == "xla":
         valid = (segment_ids >= 0) & (segment_ids < num_segments)
         shaped = jnp.where(

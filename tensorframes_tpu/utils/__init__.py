@@ -1,0 +1,25 @@
+"""Cross-cutting utilities: logging facade and tracing/profiling hooks.
+
+The reference's analogues: ``Logging.scala`` (the logging trait every class
+mixes in), the packaged log4j config, and the log-line narration that stood
+in for a tracer (SURVEY.md §5). Here logging and tracing are first-class
+modules the engine imports.
+"""
+
+from .logging import TRACE, get_logger, initialize_logging, set_level
+from .tracing import (Timings, disable, enable, enabled, profile, span,
+                      timings)
+
+__all__ = [
+    "TRACE",
+    "get_logger",
+    "initialize_logging",
+    "set_level",
+    "Timings",
+    "timings",
+    "span",
+    "enable",
+    "disable",
+    "enabled",
+    "profile",
+]

@@ -1,0 +1,113 @@
+"""Logging shim for tensorframes-tpu.
+
+The reference carries a tiny logging facade (``Logging.scala:5-9`` —
+``logDebug/logInfo/logTrace`` over scala-logging/slf4j), a packaged log4j
+config defaulting the framework's package to DEBUG
+(``src/main/resources/org/tensorframes/log4j.properties:1-7``), and a
+Python-side ``initialize_logging`` that repairs PySpark's log4j
+misconfiguration (``PythonInterface.scala:26-41``, ``core.py:14``). The
+TPU-native equivalents here:
+
+ - every module grabs a child of the ``tensorframes_tpu`` logger via
+   :func:`get_logger` (the ``Logging`` trait analogue);
+ - :func:`initialize_logging` installs a handler/format once and sets the
+   framework level — callable by users the way PySpark users called
+   ``tfs.core._java_api().initialize_logging()``;
+ - a TRACE level below DEBUG mirrors the reference's ``logTrace`` narration
+   of marshalling hot loops (``datatypes.scala:280-284``).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+from typing import Optional
+
+__all__ = ["TRACE", "get_logger", "initialize_logging", "set_level"]
+
+# slf4j has TRACE below DEBUG; python logging does not. Register it once.
+TRACE = 5
+if logging.getLevelName(TRACE) != "TRACE":
+    logging.addLevelName(TRACE, "TRACE")
+
+_ROOT_NAME = "tensorframes_tpu"
+_initialized = False
+
+
+def _trace(self: logging.Logger, msg, *args, **kwargs):
+    """The ``logTrace`` analogue, bound onto framework loggers."""
+    if self.isEnabledFor(TRACE):
+        self._log(TRACE, msg, args, **kwargs)
+
+
+def _framework_logger(name: str) -> logging.Logger:
+    logger = logging.getLogger(name)
+    if not hasattr(logger, "trace"):
+        logger.trace = _trace.__get__(logger)
+    return logger
+
+
+_root_logger = _framework_logger(_ROOT_NAME)
+
+
+def get_logger(name: Optional[str] = None) -> logging.Logger:
+    """Return the framework logger or a child of it.
+
+    ``get_logger("engine.executor")`` -> ``tensorframes_tpu.engine.executor``.
+    Child loggers inherit the level/handler installed by
+    :func:`initialize_logging` and carry a ``trace`` method (slf4j's level
+    below DEBUG).
+    """
+    if not name or name == _ROOT_NAME:
+        return _root_logger
+    if name.startswith(_ROOT_NAME + "."):
+        name = name[len(_ROOT_NAME) + 1:]
+    return _framework_logger(_ROOT_NAME + "." + name)
+
+
+def initialize_logging(level: Optional[int] = None,
+                       stream=None) -> logging.Logger:
+    """Install a stderr handler + format on the framework logger (idempotent).
+
+    Level resolution order: explicit ``level`` arg, the ``TFT_LOG_LEVEL``
+    environment variable (name or number), else WARNING — the packaged
+    default config analogue (the reference ships DEBUG in its log4j
+    properties; we default quieter and let tests/users opt in).
+    """
+    global _initialized
+    if level is None:
+        env = os.environ.get("TFT_LOG_LEVEL")
+        if env:
+            known = getattr(logging, env.upper(), None)
+            if isinstance(known, int):
+                level = known
+            elif env.upper() == "TRACE":
+                level = TRACE
+            else:
+                try:
+                    level = int(env)
+                except ValueError:
+                    _root_logger.warning(
+                        "unrecognized TFT_LOG_LEVEL=%r; using WARNING", env)
+                    level = logging.WARNING
+        else:
+            level = logging.WARNING
+    if not _initialized:
+        handler = logging.StreamHandler(stream or sys.stderr)
+        handler.setFormatter(logging.Formatter(
+            "%(asctime)s %(levelname)s %(name)s: %(message)s",
+            datefmt="%H:%M:%S"))
+        _root_logger.addHandler(handler)
+        _root_logger.propagate = False
+        _initialized = True
+    _root_logger.setLevel(level)
+    return _root_logger
+
+
+def set_level(level) -> None:
+    """Set the framework log level (accepts names, including "TRACE")."""
+    if isinstance(level, str):
+        level = TRACE if level.upper() == "TRACE" else \
+            getattr(logging, level.upper())
+    _root_logger.setLevel(level)

@@ -1,0 +1,161 @@
+"""Tracing / profiling hooks.
+
+The reference has no tracer — only ``logDebug`` narration along the
+execution path and self-timed perf suites (``System.nanoTime``,
+``perf/ConvertPerformanceSuite.scala:44-53``; SURVEY.md §5). The TPU-native
+replacement is real instrumentation:
+
+ - :func:`span` — a context manager timing a named stage on the host AND
+   annotating it into the XLA device trace via
+   ``jax.profiler.TraceAnnotation``, so host stages line up with device ops
+   in the profiler UI;
+ - :class:`Timings` — a process-wide registry of per-stage statistics
+   (count / total / min / max seconds), the structured replacement for the
+   reference's log-line narration; the engine's hot stages (validate,
+   convert, execute, convertBack) report here;
+ - :func:`profile` — wraps ``jax.profiler.start_trace/stop_trace`` for a
+   whole-program device trace dump viewable in TensorBoard/XProf.
+
+All hooks are zero-cost-when-off: ``span`` skips stat collection and device
+annotation unless tracing is enabled (it is during :func:`profile`, under
+``TFT_TRACE=1``, or after :func:`enable`).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import time
+from typing import Dict, Iterator, Optional
+
+from .logging import get_logger
+
+__all__ = ["Timings", "timings", "span", "enable", "disable", "enabled",
+           "profile"]
+
+_log = get_logger("utils.tracing")
+
+
+class _Stat:
+    __slots__ = ("count", "total", "min", "max")
+
+    def __init__(self):
+        self.count = 0
+        self.total = 0.0
+        self.min = float("inf")
+        self.max = 0.0
+
+    def add(self, dt: float):
+        self.count += 1
+        self.total += dt
+        if dt < self.min:
+            self.min = dt
+        if dt > self.max:
+            self.max = dt
+
+    def as_dict(self) -> Dict[str, float]:
+        return {"count": self.count, "total_s": self.total,
+                "mean_s": self.total / self.count if self.count else 0.0,
+                "min_s": self.min if self.count else 0.0, "max_s": self.max}
+
+
+class Timings:
+    """Thread-safe per-stage timing registry."""
+
+    def __init__(self):
+        self._stats: Dict[str, _Stat] = {}
+        self._lock = threading.Lock()
+
+    def add(self, name: str, dt: float) -> None:
+        with self._lock:
+            stat = self._stats.get(name)
+            if stat is None:
+                stat = self._stats[name] = _Stat()
+            stat.add(dt)
+
+    def snapshot(self) -> Dict[str, Dict[str, float]]:
+        with self._lock:
+            return {k: v.as_dict() for k, v in self._stats.items()}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._stats.clear()
+
+    def report(self) -> str:
+        snap = self.snapshot()
+        if not snap:
+            return "(no spans recorded; enable tracing first)"
+        width = max(len(k) for k in snap)
+        lines = ["%-*s %8s %12s %12s" % (width, "span", "count",
+                                         "total_s", "mean_s")]
+        for name in sorted(snap, key=lambda k: -snap[k]["total_s"]):
+            s = snap[name]
+            lines.append("%-*s %8d %12.6f %12.6f"
+                         % (width, name, s["count"], s["total_s"], s["mean_s"]))
+        return "\n".join(lines)
+
+
+timings = Timings()
+
+_enabled = os.environ.get("TFT_TRACE", "") not in ("", "0", "false")
+
+
+def enable() -> None:
+    global _enabled
+    _enabled = True
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def _device_annotation(name: str):
+    try:
+        import jax.profiler
+        return jax.profiler.TraceAnnotation(name)
+    except Exception:  # profiler unavailable on some backends
+        return contextlib.nullcontext()
+
+
+@contextlib.contextmanager
+def span(name: str) -> Iterator[None]:
+    """Time a named stage; no-op (two dict lookups) when tracing is off."""
+    if not _enabled:
+        yield
+        return
+    with _device_annotation(name):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            timings.add(name, dt)
+            _log.trace("span %s: %.6fs", name, dt)
+
+
+@contextlib.contextmanager
+def profile(log_dir: str, host_spans: bool = True) -> Iterator[None]:
+    """Capture a full XLA device trace to ``log_dir`` (TensorBoard format).
+
+    Also enables host spans for the duration so the :data:`timings` registry
+    covers the same window.
+    """
+    import jax
+
+    was = _enabled
+    if host_spans:
+        enable()
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+        if not was:
+            disable()
+        _log.info("profile written to %s", log_dir)

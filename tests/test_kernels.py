@@ -124,9 +124,19 @@ class TestSegmentSum:
         out = segment_sum(vals, ids, 4, impl="interpret")
         np.testing.assert_array_equal(np.asarray(out), np.zeros((4, 3)))
 
-    def test_int_values(self, rng):
+    def test_int_values_routed_to_exact_path(self, rng):
         vals = jnp.asarray(rng.integers(-5, 5, (40, 2)), jnp.int32)
         ids = jnp.asarray(rng.integers(0, 3, 40), jnp.int32)
-        ref = segment_sum(vals, ids, 3, impl="xla")
-        out = segment_sum(vals, ids, 3, impl="interpret", block_rows=16)
-        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+        out = segment_sum(vals, ids, 3)  # default impl: ints -> scatter-add
+        ref = np.zeros((3, 2), np.int64)
+        np.add.at(ref, np.asarray(ids), np.asarray(vals, np.int64))
+        np.testing.assert_array_equal(np.asarray(out, np.int64), ref)
+        # an explicit f32-accumulating impl on ints is an error, not silent
+        with pytest.raises(ValueError, match="inexact for integer"):
+            segment_sum(vals, ids, 3, impl="interpret", block_rows=16)
+
+    def test_unknown_impl_rejected(self, rng):
+        vals = jnp.asarray(rng.integers(-5, 5, (4, 2)), jnp.int32)
+        ids = jnp.zeros(4, jnp.int32)
+        with pytest.raises(ValueError, match="Unknown segment_sum impl"):
+            segment_sum(vals, ids, 1, impl="bogus")

@@ -1,0 +1,98 @@
+"""Tests for the utils/ observability layer (logging shim + tracing).
+
+Reference analogues: ``Logging.scala:5-9`` (logDebug/logTrace facade) and
+the self-timed perf narration replaced here by the span/timings registry
+(SURVEY.md §5).
+"""
+
+import logging
+
+import numpy as np
+import pytest
+
+import tensorframes_tpu as tft
+from tensorframes_tpu.utils import logging as tlog
+from tensorframes_tpu.utils import tracing
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracing():
+    was = tracing.enabled()
+    tracing.timings.reset()
+    yield
+    tracing.timings.reset()
+    (tracing.enable if was else tracing.disable)()
+
+
+def test_get_logger_hierarchy():
+    root = tlog.get_logger()
+    child = tlog.get_logger("engine.executor")
+    assert child.name == "tensorframes_tpu.engine.executor"
+    assert root.name == "tensorframes_tpu"
+    # name already qualified -> not doubled
+    same = tlog.get_logger("tensorframes_tpu.engine.executor")
+    assert same is child
+
+
+def test_trace_level_below_debug(caplog):
+    log = tlog.get_logger("t1")
+    log.setLevel(tlog.TRACE)
+    with caplog.at_level(tlog.TRACE, logger="tensorframes_tpu.t1"):
+        log.trace("hot loop %d", 7)
+    assert any(r.levelno == tlog.TRACE and "hot loop 7" in r.message
+               for r in caplog.records)
+    assert tlog.TRACE < logging.DEBUG
+
+
+def test_initialize_logging_idempotent():
+    root = tft.initialize_logging(level=logging.INFO)
+    n = len(root.handlers)
+    root2 = tft.initialize_logging(level=logging.WARNING)
+    assert root2 is root
+    assert len(root.handlers) == n  # no handler stacking
+    assert root.level == logging.WARNING
+
+
+def test_span_disabled_records_nothing():
+    tracing.disable()
+    with tracing.span("nothing"):
+        pass
+    assert tracing.timings.snapshot() == {}
+
+
+def test_span_enabled_records_stats():
+    tracing.enable()
+    for _ in range(3):
+        with tracing.span("stage"):
+            pass
+    snap = tracing.timings.snapshot()
+    assert snap["stage"]["count"] == 3
+    assert snap["stage"]["total_s"] >= 0.0
+    assert "stage" in tracing.timings.report()
+
+
+def test_engine_stages_report_spans():
+    tracing.enable()
+    df = tft.frame({"x": np.arange(8.0)}, num_partitions=2)
+    out = tft.map_blocks(lambda x: {"z": x + 3.0}, df)
+    out.collect()
+    tft.reduce_blocks(lambda x_input: {"x": x_input.sum(0)}, df)
+    snap = tracing.timings.snapshot()
+    assert snap["map_blocks.block"]["count"] == 2
+    assert "executor.dispatch" in snap
+    assert "reduce_blocks.partials" in snap
+
+
+def test_report_empty_message():
+    assert "no spans" in tracing.timings.report()
+
+
+def test_profile_writes_trace(tmp_path):
+    tracing.disable()
+    with tracing.profile(str(tmp_path)):
+        df = tft.frame({"x": np.arange(4.0)})
+        tft.map_blocks(lambda x: {"z": x * 2.0}, df).collect()
+        assert tracing.enabled()  # host spans on during the window
+    assert not tracing.enabled()
+    assert list(tmp_path.rglob("*"))  # something was written
+    assert tracing.timings.snapshot()  # host spans captured in-window
