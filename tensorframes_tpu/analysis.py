@@ -59,6 +59,9 @@ def analyze(df: TensorFrame) -> TensorFrame:
     blocks = df.blocks()
     fields: List[Field] = []
     for f in df.schema:
+        if not f.dtype.tensor:
+            fields.append(f)  # string etc: pass-through, no tensor shape
+            continue
         shapes = [s for s in
                   (_column_block_shape(b, f.name) for b in blocks)
                   if s is not None]

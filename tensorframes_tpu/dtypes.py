@@ -43,19 +43,24 @@ class DType:
     """A framework scalar type.
 
     ``name`` is the canonical user-facing name; ``np_storage`` the host
-    columnar dtype; ``priority`` orders numeric widening (wider wins).
+    columnar dtype; ``priority`` orders numeric widening (wider wins);
+    ``tensor`` marks types that can feed device computations — non-tensor
+    types (string) are pass-through/group-key only, the way the reference
+    carries non-numeric Spark columns alongside tensor columns
+    (``geom_mean.py:21-24``: "non numeric columns (string)" was a found bug).
     """
 
     name: str
     np_storage: np.dtype
     priority: int
+    tensor: bool = True
 
     def __repr__(self) -> str:
         return self.name
 
     @property
     def is_floating(self) -> bool:
-        return np.issubdtype(self.np_storage, np.floating)
+        return self.tensor and np.issubdtype(self.np_storage, np.floating)
 
     @property
     def itemsize(self) -> int:
@@ -69,6 +74,8 @@ int32 = DType("int", np.dtype(np.int32), 10)
 # bfloat16 is TPU-native extra surface (not in the reference); stored as f32 on
 # host, computed as bf16 on device.
 bfloat16 = DType("bfloat16", np.dtype(np.float32), 25)
+# pass-through only: valid as a column / group-by key, never a tensor input
+string = DType("string", np.dtype(object), 0, tensor=False)
 
 _BY_NAME: Dict[str, DType] = {
     "double": double,
@@ -85,6 +92,8 @@ _BY_NAME: Dict[str, DType] = {
     "i32": int32,
     "bfloat16": bfloat16,
     "bf16": bfloat16,
+    "string": string,
+    "str": string,
 }
 
 _CORE = (double, float32, int64, int32)
@@ -120,6 +129,11 @@ def from_numpy(dt) -> DType:
         return bfloat16
     if dt == np.bool_:
         return int32
+    if dt.kind in ("U", "S"):
+        return string
+    # object arrays are NOT classified here: without the values there is no
+    # way to tell a string column from arbitrary Python objects — callers
+    # with data in hand (Schema.from_numpy_columns) decide
     raise ValueError(f"Unsupported numpy dtype for tensorframes: {dt}")
 
 
@@ -150,6 +164,8 @@ def device_dtype(dt: DType, platform: Optional[str] = None) -> np.dtype:
     """
     import jax
 
+    if not dt.tensor:
+        raise ValueError(f"{dt.name} columns cannot be device tensors")
     if platform is None:
         platform = jax.default_backend()
     x64 = bool(jax.config.read("jax_enable_x64"))

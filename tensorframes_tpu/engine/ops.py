@@ -98,6 +98,11 @@ def _is_dsl(fetches) -> bool:
 
 
 def _field_spec(field: Field, block_level: bool, context: str) -> Shape:
+    if not field.dtype.tensor:
+        raise InvalidTypeError(
+            f"Column {field.name!r} has non-tensor type {field.dtype.name} "
+            f"and cannot feed a computation ({context}); it can only pass "
+            f"through or serve as a group_by key")
     if field.block_shape is None:
         raise InvalidShapeError(
             f"Column {field.name!r} has no tensor shape information; run "

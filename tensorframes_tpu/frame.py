@@ -151,9 +151,10 @@ def _infer_schema_from_rows(rows: Sequence[Sequence],
                 probe = 0.0
                 break
             probe = probe[0]
-        dt = _dt.from_python_value(probe)
+        dt = _dt.string if isinstance(probe, (str, np.str_, bytes)) \
+            else _dt.from_python_value(probe)
         f = Field(name, dt, sql_rank=rank)
-        if rank == 0:
+        if rank == 0 and dt.tensor:
             f = f.with_block_shape(Shape(Unknown))
         fields.append(f)
     return Schema(fields)

@@ -136,7 +136,8 @@ def columns_to_rows(columns: Dict[str, Column], schema: Schema,
         for c, is_scalar in zip(cols, scalar):
             v = c[i]
             if is_scalar:
-                v = v.item()
+                # object columns (string) index straight to python values
+                v = v.item() if isinstance(v, np.generic) else v
             elif isinstance(v, np.ndarray):
                 v = np.asarray(v)
             row.append(v)

@@ -115,8 +115,6 @@ def segment_sum(values: jax.Array, segment_ids: jax.Array,
             valid.reshape((-1,) + (1,) * (values.ndim - 1)), values, 0)
         ids = jnp.where(valid, segment_ids, 0)
         return jax.ops.segment_sum(shaped, ids, num_segments=num_segments)
-    if impl not in ("pallas", "interpret"):
-        raise ValueError(f"Unknown segment_sum impl {impl!r}")
     tail = values.shape[1:]
     d = 1
     for t in tail:

@@ -122,10 +122,20 @@ def dmap_blocks(fetches, dist: DistributedFrame,
                 trim: bool = False) -> DistributedFrame:
     """Mesh-parallel map: one jit dispatch, all shards in parallel.
 
-    Row-local computations only (each output row may depend on its input
-    row and on replicated constants): pad rows flow through and are dropped
-    at collect. Block-global computations (e.g. subtract-the-block-mean)
-    need the per-partition host path (``tft.map_blocks``).
+    Without ``trim``, outputs ride alongside the inputs and must be
+    row-local (each output row depends on its input row and replicated
+    constants); pad rows flow through and are dropped at collect. With
+    ``trim=True`` the computation sees the GLOBAL padded array and may
+    change the row count (e.g. an in-graph pre-aggregation emitting one
+    global row — the ``kmeans_demo.py:128-140`` pattern at mesh scale);
+    XLA/GSPMD inserts whatever cross-shard collectives the program needs.
+    Such computations must mask pad rows themselves (``dist.num_rows`` is
+    the true count; ``padded_rows`` what they will see). Contract: a trim
+    output whose row count equals ``padded_rows`` is interpreted as
+    row-aligned with the input (the pad structure survives and is dropped
+    at collect) — a global computation must therefore emit a row count
+    different from ``padded_rows`` (its results would otherwise be
+    truncated to ``num_rows``).
     """
     schema = dist.schema
     comp = _ops._map_computation(fetches, schema, block_level=True)
@@ -134,16 +144,22 @@ def dmap_blocks(fetches, dist: DistributedFrame,
 
     jitted = _jitted(comp)
     out = jitted({n: dist.columns[n] for n in comp.input_names})
+    leads = {out[s.name].shape[0] for s in comp.outputs}
+    if len(leads) > 1:
+        raise ValueError(
+            f"Distributed map fetches disagree on output row count: "
+            f"{ {s.name: out[s.name].shape[0] for s in comp.outputs} }")
+    n_out = leads.pop() if leads else dist.padded_rows
+    if n_out != dist.padded_rows and not trim:
+        raise ValueError(
+            f"Distributed map output changed the row count ({n_out} vs "
+            f"{dist.padded_rows}); use trim=True for row-count-changing "
+            f"(global) computations")
     cols = {} if trim else dict(dist.columns)
     for spec in comp.outputs:
-        a = out[spec.name]
-        if a.shape[0] != dist.padded_rows:
-            raise ValueError(
-                f"Distributed map output {spec.name!r} changed the row "
-                f"count ({a.shape[0]} vs {dist.padded_rows}); row-count "
-                f"changing computations are per-partition only")
-        cols[spec.name] = a
-    return DistributedFrame(mesh, out_schema, cols, dist.num_rows)
+        cols[spec.name] = out[spec.name]
+    num_rows = dist.num_rows if n_out == dist.padded_rows else n_out
+    return DistributedFrame(mesh, out_schema, cols, num_rows)
 
 
 def dreduce_blocks(fetches, dist: DistributedFrame):

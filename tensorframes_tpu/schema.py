@@ -254,7 +254,19 @@ class Schema:
         fields = []
         for name, arr in cols.items():
             arr = np.asarray(arr)
-            dt = _dt.from_numpy(arr.dtype)
+            if arr.dtype.kind == "O":
+                # only string cells qualify; arbitrary objects are rejected
+                # here, at construction, not deep in the engine
+                if not all(isinstance(c, (str, bytes)) for c in arr.flat):
+                    raise ValueError(
+                        f"Column {name!r} holds non-string Python objects; "
+                        f"supported: numeric tensors and strings")
+                dt = _dt.string
+            else:
+                dt = _dt.from_numpy(arr.dtype)
+            if not dt.tensor:
+                fields.append(Field(name, dt, sql_rank=0))
+                continue
             shape = Shape((Unknown,) + arr.shape[1:])
             fields.append(Field(name, dt, block_shape=shape,
                                 sql_rank=arr.ndim - 1))

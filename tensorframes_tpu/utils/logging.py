@@ -33,6 +33,7 @@ if logging.getLevelName(TRACE) != "TRACE":
 
 _ROOT_NAME = "tensorframes_tpu"
 _initialized = False
+_handler: Optional[logging.StreamHandler] = None
 
 
 def _trace(self: logging.Logger, msg, *args, **kwargs):
@@ -75,7 +76,7 @@ def initialize_logging(level: Optional[int] = None,
     default config analogue (the reference ships DEBUG in its log4j
     properties; we default quieter and let tests/users opt in).
     """
-    global _initialized
+    global _initialized, _handler
     if level is None:
         env = os.environ.get("TFT_LOG_LEVEL")
         if env:
@@ -94,13 +95,15 @@ def initialize_logging(level: Optional[int] = None,
         else:
             level = logging.WARNING
     if not _initialized:
-        handler = logging.StreamHandler(stream or sys.stderr)
-        handler.setFormatter(logging.Formatter(
+        _handler = logging.StreamHandler(stream or sys.stderr)
+        _handler.setFormatter(logging.Formatter(
             "%(asctime)s %(levelname)s %(name)s: %(message)s",
             datefmt="%H:%M:%S"))
-        _root_logger.addHandler(handler)
+        _root_logger.addHandler(_handler)
         _root_logger.propagate = False
         _initialized = True
+    elif stream is not None:
+        _handler.setStream(stream)  # re-init with a new sink: honor it
     _root_logger.setLevel(level)
     return _root_logger
 

@@ -149,13 +149,13 @@ def profile(log_dir: str, host_spans: bool = True) -> Iterator[None]:
     import jax
 
     was = _enabled
-    if host_spans:
+    jax.profiler.start_trace(log_dir)  # before enable(): a failure here
+    if host_spans:                     # must not leave spans on forever
         enable()
-    jax.profiler.start_trace(log_dir)
     try:
         yield
     finally:
-        jax.profiler.stop_trace()
         if not was:
             disable()
+        jax.profiler.stop_trace()
         _log.info("profile written to %s", log_dir)

@@ -96,3 +96,17 @@ def test_tree_string():
     s = Schema.of(x="double")
     out = s.tree_string()
     assert "root" in out and "x: double" in out and "[?]" in out
+
+
+def test_object_column_rejected_unless_strings():
+    import numpy as np
+    import pytest
+    import tensorframes_tpu as tft
+    from tensorframes_tpu.schema import Schema
+
+    with pytest.raises(ValueError, match="non-string Python objects"):
+        Schema.from_numpy_columns(
+            {"c": np.array([{"a": 1}, {"b": 2}], dtype=object)})
+    s = Schema.from_numpy_columns({"k": np.array(["a", "b"], dtype=object)})
+    assert s["k"].dtype.name == "string"
+    assert not s["k"].dtype.tensor

@@ -16,12 +16,17 @@ from tensorframes_tpu.utils import tracing
 
 
 @pytest.fixture(autouse=True)
-def _clean_tracing():
+def _clean_state():
     was = tracing.enabled()
     tracing.timings.reset()
+    root = tlog.get_logger()
+    saved = (list(root.handlers), root.level, root.propagate,
+             tlog._initialized, tlog._handler)
     yield
     tracing.timings.reset()
     (tracing.enable if was else tracing.disable)()
+    root.handlers, root.level, root.propagate = saved[0], saved[1], saved[2]
+    tlog._initialized, tlog._handler = saved[3], saved[4]
 
 
 def test_get_logger_hierarchy():
