@@ -1,0 +1,84 @@
+"""End-to-end map+aggregate benchmark — the reference's PerformanceSuite.
+
+``perf/PerformanceSuite.scala:14-26`` (ignored in CI): ``mapBlocks(z = x+x)``
+followed by ``agg(sum(z))`` over a 20M-row DataFrame, 10 iterations. Here the
+same pipeline runs twice:
+
+ - ``host`` path: blocks marshalled host->device each call (the honest
+   analogue of the reference's executor loop);
+ - ``device`` path: the frame ``distribute``d once, map + collective reduce
+   as compiled XLA dispatches (what the TPU-native design buys).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List
+
+import numpy as np
+
+import tensorframes_tpu as tft
+from tensorframes_tpu.engine import ops as engine_ops
+
+N_ROWS = 20_000_000
+ITERS = 5
+
+
+def run(n_rows: int = N_ROWS, iters: int = ITERS) -> List[Dict]:
+    import jax
+
+    out: List[Dict] = []
+    x = np.arange(n_rows, dtype=np.float64)
+    df = tft.frame({"x": x}, num_partitions=8)
+    df.cache()
+
+    def host_pipeline():
+        df2 = tft.map_blocks(lambda x: {"z": x + x}, df)
+        return engine_ops.reduce_blocks(
+            lambda z_input: {"z": z_input.sum(0)}, df2.select(["z"]))
+
+    r = host_pipeline()  # warm + correctness
+    expected = float(x.sum() * 2.0)
+    # double computes as f32 on TPU: tolerance covers the representation loss
+    assert abs(float(r["z"]) - expected) / expected < 1e-5
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        host_pipeline()
+    sec = (time.perf_counter() - t0) / iters
+    out.append({"metric": "e2e_map_agg_host", "value": sec, "unit": "s/iter",
+                "rows": n_rows, "rows_per_s": n_rows / sec})
+
+    from tensorframes_tpu.parallel.distributed import (distribute,
+                                                       dmap_blocks,
+                                                       dreduce_blocks)
+    from tensorframes_tpu.parallel.mesh import local_mesh
+
+    dist = distribute(df, local_mesh())
+    from tensorframes_tpu.computation import Computation, TensorSpec
+    from tensorframes_tpu import dtypes as _dt
+    from tensorframes_tpu.shape import Shape, Unknown
+
+    comp = Computation.trace(lambda x: {"z": x + x},
+                             [TensorSpec("x", _dt.double, Shape(Unknown))])
+
+    def device_pipeline():
+        d2 = dmap_blocks(comp, dist, trim=True)
+        return dreduce_blocks({"z": "sum"}, d2)
+
+    r = device_pipeline()
+    got = float(np.asarray(r["z"]))
+    assert abs(got - expected) / expected < 1e-5, (got, expected)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        jax.block_until_ready(device_pipeline()["z"])
+    sec = (time.perf_counter() - t0) / iters
+    out.append({"metric": "e2e_map_agg_device", "value": sec,
+                "unit": "s/iter", "rows": n_rows, "rows_per_s": n_rows / sec})
+    return out
+
+
+if __name__ == "__main__":
+    import json
+
+    for rec in run():
+        print(json.dumps(rec))

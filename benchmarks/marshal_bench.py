@@ -1,0 +1,80 @@
+"""Marshalling micro-benchmarks — the reference's convert/convertBack suites.
+
+Mirrors the four ``ignore``d configs of
+``perf/ConvertPerformanceSuite.scala:36-76`` and
+``perf/ConvertBackPerformanceSuite.scala:35-79``: rows->columnar ("convert")
+and columnar->rows ("convertBack"), for (a) 10M scalar-int rows and (b) one
+row holding a 10M-element int vector. The reference timed Row boxing into
+C++ tensor buffers over JNI; here the measured path is the framework's
+actual host marshalling (``marshal.rows_to_columns`` / ``columns_to_rows``
+with the native fast path when ``libtfruntime.so`` is built).
+
+Iteration counts are scaled down from the reference's 100/1000 (its suites
+never ran in CI anyway); wall-per-call is what's recorded.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List
+
+import numpy as np
+
+from tensorframes_tpu import dtypes as _dt
+from tensorframes_tpu.marshal import columns_to_rows, rows_to_columns
+from tensorframes_tpu.schema import Field, Schema
+from tensorframes_tpu.shape import Shape, Unknown
+
+N_SCALAR = 10_000_000
+N_VECTOR = 10_000_000
+ITERS = 5
+
+
+def _time_per_call(fn, iters: int = ITERS) -> float:
+    fn()  # warm
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        fn()
+    return (time.perf_counter() - t0) / iters
+
+
+def run(n_scalar: int = N_SCALAR, n_vector: int = N_VECTOR,
+        iters: int = ITERS) -> List[Dict]:
+    out: List[Dict] = []
+
+    scalar_schema = Schema([
+        Field("x", _dt.int32, block_shape=Shape(Unknown), sql_rank=0)])
+    scalar_rows = [(i,) for i in range(n_scalar)]
+    sec = _time_per_call(
+        lambda: rows_to_columns(scalar_rows, scalar_schema), iters)
+    out.append({"metric": "convert_scalar_rows", "value": sec, "unit":
+                "s/call", "rows": n_scalar,
+                "rows_per_s": n_scalar / sec})
+
+    scalar_cols = rows_to_columns(scalar_rows, scalar_schema)
+    sec = _time_per_call(
+        lambda: columns_to_rows(scalar_cols, scalar_schema), iters)
+    out.append({"metric": "convertBack_scalar_rows", "value": sec,
+                "unit": "s/call", "rows": n_scalar,
+                "rows_per_s": n_scalar / sec})
+
+    vec_schema = Schema([
+        Field("x", _dt.int32, block_shape=Shape(Unknown, n_vector),
+              sql_rank=1)])
+    vec_rows = [(np.arange(n_vector, dtype=np.int32),)]
+    sec = _time_per_call(lambda: rows_to_columns(vec_rows, vec_schema), iters)
+    out.append({"metric": "convert_1row_vector", "value": sec,
+                "unit": "s/call", "elements": n_vector})
+
+    vec_cols = rows_to_columns(vec_rows, vec_schema)
+    sec = _time_per_call(lambda: columns_to_rows(vec_cols, vec_schema), iters)
+    out.append({"metric": "convertBack_1row_vector", "value": sec,
+                "unit": "s/call", "elements": n_vector})
+    return out
+
+
+if __name__ == "__main__":
+    import json
+
+    for rec in run():
+        print(json.dumps(rec))

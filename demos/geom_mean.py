@@ -24,8 +24,8 @@ import tensorframes_tpu as tft
 
 
 def harmonic_mean_per_key(df: tft.TensorFrame,
-                          col_name: str = "x",
                           col_key: str = "key") -> tft.TensorFrame:
+    """Value column is ``x`` (the traced functions bind it by name)."""
     import jax.numpy as jnp
 
     def invs_and_count(x):
@@ -47,8 +47,8 @@ def harmonic_mean_per_key(df: tft.TensorFrame,
 
 
 def geometric_mean_per_key(df: tft.TensorFrame,
-                           col_name: str = "x",
                            col_key: str = "key") -> tft.TensorFrame:
+    """Value column is ``x`` (the traced functions bind it by name)."""
     import jax.numpy as jnp
 
     def logs_and_count(x):

@@ -141,8 +141,7 @@ def step_preaggregate(df: tft.TensorFrame,
 
 # -- variant C: device-resident frame, centroids-only traffic ---------------
 
-def step_device_resident(dist, centers: np.ndarray,
-                         k: int) -> Tuple[np.ndarray, float]:
+def step_device_resident(dist, centers: np.ndarray) -> Tuple[np.ndarray, float]:
     """One step on a ``distribute``d frame (see ``parallel.distributed``).
 
     ``dist`` stays in HBM; per-step host traffic is just the k x m centroid
@@ -157,7 +156,7 @@ def step_device_resident(dist, centers: np.ndarray,
     comp = Computation.trace(
         _preagg_computation(centers, n_valid=dist.num_rows),
         [TensorSpec("features", _dt.double, Shape(Unknown, m))])
-    out = dmap_blocks(comp, dist, trim=True)
+    out = dmap_blocks(comp, dist, trim=True, row_aligned=False)
     return _combine_partials(np.asarray(out.columns["agg_points"]),
                              np.asarray(out.columns["agg_counts"]),
                              np.asarray(out.columns["agg_distances"]),

@@ -121,7 +121,11 @@ def _dtype_from_np(np_dtype) -> _dt.DType:
     s = str(np.dtype(np_dtype)) if str(np_dtype) != "bfloat16" else "bfloat16"
     if s == "bfloat16":
         return _dt.bfloat16
-    return _dt.from_numpy(np_dtype)
+    dt = _dt.from_numpy(np_dtype)
+    if not dt.tensor:
+        raise ValueError(
+            f"Computation outputs must be numeric tensors, got {dt.name}")
+    return dt
 
 
 def _output_framework_dtype(np_dtype, input_specs: Sequence[TensorSpec]) -> _dt.DType:

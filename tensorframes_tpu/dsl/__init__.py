@@ -57,6 +57,9 @@ def constant(value, dtype: Optional[_dt.DType] = None,
     arr = np.asarray(value)
     if dtype is None:
         dtype = _dt.from_numpy(arr.dtype)
+    if not dtype.tensor:
+        raise ValueError(
+            f"constant() requires a numeric tensor dtype, got {dtype.name}")
     arr = arr.astype(dtype.np_storage)
     return Node("Const", [], dtype, Shape(arr.shape),
                 impl=None, value=arr, name=name)

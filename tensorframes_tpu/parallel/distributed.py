@@ -118,8 +118,8 @@ def distribute(df: TensorFrame, mesh: DeviceMesh) -> DistributedFrame:
     return DistributedFrame(mesh, df.schema, cols, n)
 
 
-def dmap_blocks(fetches, dist: DistributedFrame,
-                trim: bool = False) -> DistributedFrame:
+def dmap_blocks(fetches, dist: DistributedFrame, trim: bool = False,
+                row_aligned: Optional[bool] = None) -> DistributedFrame:
     """Mesh-parallel map: one jit dispatch, all shards in parallel.
 
     Without ``trim``, outputs ride alongside the inputs and must be
@@ -130,12 +130,14 @@ def dmap_blocks(fetches, dist: DistributedFrame,
     global row — the ``kmeans_demo.py:128-140`` pattern at mesh scale);
     XLA/GSPMD inserts whatever cross-shard collectives the program needs.
     Such computations must mask pad rows themselves (``dist.num_rows`` is
-    the true count; ``padded_rows`` what they will see). Contract: a trim
-    output whose row count equals ``padded_rows`` is interpreted as
-    row-aligned with the input (the pad structure survives and is dropped
-    at collect) — a global computation must therefore emit a row count
-    different from ``padded_rows`` (its results would otherwise be
-    truncated to ``num_rows``).
+    the true count; ``padded_rows`` what they will see).
+
+    ``row_aligned`` declares how a trim output relates to the input rows:
+    ``True`` — output rows correspond 1:1 to input rows (pad structure
+    survives, dropped at collect); ``False`` — the output is a fresh global
+    result (every emitted row is real). Default ``None`` infers from the
+    row count (equal to ``padded_rows`` -> aligned); pass the flag
+    explicitly when the sizes could coincide.
     """
     schema = dist.schema
     comp = _ops._map_computation(fetches, schema, block_level=True)
@@ -155,10 +157,16 @@ def dmap_blocks(fetches, dist: DistributedFrame,
             f"Distributed map output changed the row count ({n_out} vs "
             f"{dist.padded_rows}); use trim=True for row-count-changing "
             f"(global) computations")
+    if row_aligned is None:
+        row_aligned = n_out == dist.padded_rows
+    elif row_aligned and n_out != dist.padded_rows:
+        raise ValueError(
+            f"row_aligned=True but the output has {n_out} rows and the "
+            f"input {dist.padded_rows}")
     cols = {} if trim else dict(dist.columns)
     for spec in comp.outputs:
         cols[spec.name] = out[spec.name]
-    num_rows = dist.num_rows if n_out == dist.padded_rows else n_out
+    num_rows = dist.num_rows if row_aligned else n_out
     return DistributedFrame(mesh, out_schema, cols, num_rows)
 
 

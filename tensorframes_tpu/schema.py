@@ -264,6 +264,10 @@ class Schema:
                 dt = _dt.string
             else:
                 dt = _dt.from_numpy(arr.dtype)
+            if not dt.tensor and arr.ndim != 1:
+                raise ValueError(
+                    f"Column {name!r}: string columns must be scalar "
+                    f"(1-D), got array of rank {arr.ndim}")
             if not dt.tensor:
                 fields.append(Field(name, dt, sql_rank=0))
                 continue

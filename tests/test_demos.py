@@ -54,7 +54,7 @@ def test_kmeans_device_resident_step_matches(km_data):
     df, init, _ = km_data
     pts = np.concatenate([b.dense("features") for b in df.blocks()])
     dist = distribute(df, local_mesh())
-    got_c, got_d = km.step_device_resident(dist, init, k=init.shape[0])
+    got_c, got_d = km.step_device_resident(dist, init)
     want_c, want_d = _numpy_step(pts, init)
     np.testing.assert_allclose(got_c, want_c, rtol=1e-5)
     assert got_d == pytest.approx(want_d, rel=1e-5)
