@@ -46,6 +46,11 @@ class TransformerConfig:
     d_ff: int = 2048
     rope_base: float = 10000.0
     dtype: Any = jnp.float32
+    # MoE: >0 replaces every layer's dense FFN with a Switch top-1 MoE of
+    # this many experts (expert-parallel over a mesh axis when given)
+    num_experts: int = 0
+    expert_capacity_factor: float = 1.25
+    aux_loss_weight: float = 0.01
 
     @property
     def head_dim(self) -> int:
@@ -93,16 +98,22 @@ class TransformerLM:
         H, D, Dh, F = c.n_heads, c.d_model, c.head_dim, c.d_ff
         layers = []
         for _ in range(c.n_layers):
-            layers.append({
+            lp = {
                 "ln1": jnp.ones((D,), c.dtype),
                 "wq": dense((D, H, Dh), D),
                 "wk": dense((D, H, Dh), D),
                 "wv": dense((D, H, Dh), D),
                 "wo": dense((H, Dh, D), D),
                 "ln2": jnp.ones((D,), c.dtype),
-                "w1": dense((D, F), D),
-                "w2": dense((F, D), F),
-            })
+            }
+            if c.num_experts > 0:
+                from ..parallel.moe import init_switch_ffn
+                lp["moe"] = init_switch_ffn(next(keys), D, F,
+                                            c.num_experts, c.dtype)
+            else:
+                lp["w1"] = dense((D, F), D)
+                lp["w2"] = dense((F, D), F)
+            layers.append(lp)
         return {
             "embed": dense((c.vocab_size, D), D) * np.float32(np.sqrt(D)),
             "layers": layers,
@@ -123,47 +134,76 @@ class TransformerLM:
         from ..ops import flash_attention
         return flash_attention(q, k, v, causal=True)
 
-    def apply(self, params: Params, tokens: jax.Array,
-              mesh: Optional[DeviceMesh] = None,
-              seq_axis: Optional[str] = None,
-              data_axis: Optional[str] = None,
-              model_axis: Optional[str] = None) -> jax.Array:
-        """Forward pass. With ``mesh`` + ``seq_axis``, attention runs as a
-        sequence-parallel ring; positions are global, so rotary phases are
-        correct on every shard."""
+    def _block(self, lp, x, positions, *, mesh, seq_axis, data_axis,
+               model_axis, expert_axis):
+        """One transformer block: attention + (dense | MoE) FFN.
+        Returns (x, aux) — aux is the MoE load-balance term (0 for dense)."""
         c = self.config
+        h = _rms_norm(x, lp["ln1"])
+        q = jnp.einsum("bsd,dhk->bshk", h, lp["wq"])
+        k = jnp.einsum("bsd,dhk->bshk", h, lp["wk"])
+        v = jnp.einsum("bsd,dhk->bshk", h, lp["wv"])
+        q = _rope(q, positions, c.rope_base)
+        k = _rope(k, positions, c.rope_base)
+        attn = self._attention(q, k, v, mesh=mesh, seq_axis=seq_axis,
+                               data_axis=data_axis, model_axis=model_axis)
+        x = x + jnp.einsum("bshk,hkd->bsd", attn, lp["wo"])
+        h = _rms_norm(x, lp["ln2"])
+        if "moe" in lp:
+            from ..parallel.moe import switch_ffn
+            B, S, D = h.shape
+            y, aux = switch_ffn(h.reshape(B * S, D), lp["moe"],
+                                capacity_factor=c.expert_capacity_factor,
+                                mesh=mesh, expert_axis=expert_axis)
+            return x + y.reshape(B, S, D), aux
+        return x + jax.nn.gelu(h @ lp["w1"]) @ lp["w2"], jnp.float32(0.0)
+
+    def apply_with_aux(self, params: Params, tokens: jax.Array,
+                       mesh: Optional[DeviceMesh] = None,
+                       seq_axis: Optional[str] = None,
+                       data_axis: Optional[str] = None,
+                       model_axis: Optional[str] = None,
+                       expert_axis: Optional[str] = None,
+                       ) -> Tuple[jax.Array, jax.Array]:
+        """Forward pass -> (logits, moe_aux_loss). With ``mesh`` +
+        ``seq_axis``, attention runs as a sequence-parallel ring; positions
+        are global, so rotary phases are correct on every shard."""
         S = tokens.shape[1]
         x = params["embed"][tokens]  # [B, S, D]
         positions = jnp.arange(S)
+        aux_total = jnp.float32(0.0)
         for lp in params["layers"]:
-            h = _rms_norm(x, lp["ln1"])
-            q = jnp.einsum("bsd,dhk->bshk", h, lp["wq"])
-            k = jnp.einsum("bsd,dhk->bshk", h, lp["wk"])
-            v = jnp.einsum("bsd,dhk->bshk", h, lp["wv"])
-            q = _rope(q, positions, c.rope_base)
-            k = _rope(k, positions, c.rope_base)
-            attn = self._attention(q, k, v, mesh=mesh, seq_axis=seq_axis,
-                                   data_axis=data_axis,
-                                   model_axis=model_axis)
-            x = x + jnp.einsum("bshk,hkd->bsd", attn, lp["wo"])
-            h = _rms_norm(x, lp["ln2"])
-            x = x + jax.nn.gelu(h @ lp["w1"]) @ lp["w2"]
+            x, aux = self._block(lp, x, positions, mesh=mesh,
+                                 seq_axis=seq_axis, data_axis=data_axis,
+                                 model_axis=model_axis,
+                                 expert_axis=expert_axis)
+            aux_total = aux_total + aux
         x = _rms_norm(x, params["ln_f"])
-        return x @ params["head"]
+        return x @ params["head"], aux_total
 
-    def loss(self, params: Params, tokens: jax.Array, targets: jax.Array,
-             **apply_kw) -> jax.Array:
-        """Mean next-token cross-entropy; ``targets[b, s]`` is the label
-        for position ``s`` (caller pre-shifts)."""
-        logits = self.apply(params, tokens, **apply_kw)
+    def apply(self, params: Params, tokens: jax.Array, **kw) -> jax.Array:
+        return self.apply_with_aux(params, tokens, **kw)[0]
+
+    @staticmethod
+    def _xent(logits: jax.Array, targets: jax.Array) -> jax.Array:
         logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
         ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
         return -jnp.mean(ll)
 
+    def loss(self, params: Params, tokens: jax.Array, targets: jax.Array,
+             **apply_kw) -> jax.Array:
+        """Mean next-token cross-entropy (+ weighted MoE aux when
+        experts are on); ``targets[b, s]`` is the label for position ``s``
+        (caller pre-shifts)."""
+        logits, aux = self.apply_with_aux(params, tokens, **apply_kw)
+        return self._xent(logits, targets) \
+            + self.config.aux_loss_weight * aux
+
     # -- sharding -----------------------------------------------------------
-    def param_shardings(self, mesh: DeviceMesh, model_axis: str = "model"
-                        ) -> Params:
-        """Megatron-style tensor-parallel placement over ``model_axis``."""
+    def param_shardings(self, mesh: DeviceMesh, model_axis: str = "model",
+                        expert_axis: Optional[str] = None) -> Params:
+        """Megatron-style tensor-parallel placement over ``model_axis``;
+        expert weights sharded over ``expert_axis`` when MoE is on."""
         m = mesh.mesh
 
         def s(*spec):
@@ -175,12 +215,22 @@ class TransformerLM:
             "wk": s(None, model_axis, None),
             "wv": s(None, model_axis, None),
             "wo": s(model_axis, None, None),
-            "w1": s(None, model_axis),
-            "w2": s(model_axis, None),
         }
+        if self.config.num_experts > 0:
+            layer["moe"] = {
+                "router": s(),
+                "w1": s(expert_axis, None, model_axis),
+                "w2": s(expert_axis, model_axis, None),
+            }
+        else:
+            layer["w1"] = s(None, model_axis)
+            layer["w2"] = s(model_axis, None)
         return {
             "embed": s(None, None),
-            "layers": [dict(layer) for _ in range(self.config.n_layers)],
+            "layers": [jax.tree_util.tree_map(
+                lambda x: x, layer,
+                is_leaf=lambda l: isinstance(l, NamedSharding))
+                for _ in range(self.config.n_layers)],
             "ln_f": s(),
             "head": s(None, model_axis),
         }
@@ -189,21 +239,25 @@ class TransformerLM:
                                 data_axis: str = "data",
                                 model_axis: Optional[str] = "model",
                                 seq_axis: Optional[str] = None,
+                                expert_axis: Optional[str] = None,
                                 learning_rate: float = 1e-3):
         """One compiled SPMD training step (adam) over the mesh.
 
         Returns ``(step, init_state)`` factories: ``state = init_state(rng)``
         then ``state, loss = step(state, tokens, targets)``. Shardings:
         params tensor-parallel over ``model_axis`` (replicated if the axis is
-        absent/None), batch over ``data_axis``, and — when ``seq_axis`` is
-        given — activations sequence-sharded with ring attention.
+        absent/None), batch over ``data_axis``, activations sequence-sharded
+        with ring attention when ``seq_axis`` is given, and — with MoE on —
+        expert weights and the dispatched token buffer over ``expert_axis``
+        (the all_to_all pair is XLA-inserted).
         """
         import optax
 
         axes = mesh.axis_names
         ma = model_axis if model_axis in axes else None
         sa = seq_axis if seq_axis in axes else None
-        p_shard = (self.param_shardings(mesh, ma) if ma
+        ea = expert_axis if expert_axis in axes else None
+        p_shard = (self.param_shardings(mesh, ma, ea) if (ma or ea)
                    else jax.tree_util.tree_map(
                        lambda _: NamedSharding(mesh.mesh, P()),
                        jax.eval_shape(self.init)))
@@ -221,7 +275,109 @@ class TransformerLM:
             def loss_fn(p):
                 return self.loss(p, tokens, targets, mesh=mesh,
                                  seq_axis=sa, data_axis=data_axis,
-                                 model_axis=ma)
+                                 model_axis=ma, expert_axis=ea)
+
+            loss, grads = jax.value_and_grad(loss_fn)(state["params"])
+            updates, new_opt = opt.update(grads, state["opt"],
+                                          state["params"])
+            new_params = optax.apply_updates(state["params"], updates)
+            return {"params": new_params, "opt": new_opt}, loss
+
+        jstep = jax.jit(step,
+                        in_shardings=(None, tok_shard, tok_shard),
+                        donate_argnums=(0,))
+        return jstep, init_state
+
+    # -- pipeline parallelism ------------------------------------------------
+    def stacked_layer_params(self, params: Params):
+        """Stack the per-layer pytrees into leading-dim-``L`` leaves (the
+        layout :func:`~tensorframes_tpu.parallel.pipeline.pipeline_apply`
+        wants, with L = stages when one layer per stage)."""
+        layers = params["layers"]
+        return jax.tree_util.tree_map(
+            lambda *ls: jnp.stack(ls), *layers)
+
+    def make_pipelined_train_step(self, mesh: DeviceMesh,
+                                  pipe_axis: str = "pipe",
+                                  data_axis: str = "data",
+                                  num_microbatches: Optional[int] = None,
+                                  learning_rate: float = 1e-3):
+        """Training step with the layer stack run as a GPipe pipeline over
+        ``pipe_axis`` (one or more layers per stage; ``n_layers`` must be a
+        multiple of the axis size). Embed/head/final-norm are replicated and
+        run outside the pipeline; batch rows are sharded over ``data_axis``
+        and split into microbatches inside the pipeline schedule.
+
+        The train state keeps the layer stack in stage-major layout
+        ``[P, per_stage, ...]`` sharded over ``pipe_axis`` — each device
+        holds (and adam tracks) only its own stage's parameters, the O(L/P)
+        memory scaling pipelining exists for. Dense models only: the MoE
+        aux loss cannot cross the pipeline boundary (use
+        ``make_sharded_train_step`` with ``expert_axis`` for MoE).
+        """
+        import optax
+        from ..parallel.pipeline import pipeline_apply
+
+        c = self.config
+        if c.num_experts > 0:
+            raise ValueError(
+                "make_pipelined_train_step supports dense FFN models only: "
+                "the MoE load-balance aux loss would be silently dropped "
+                "across the pipeline; use make_sharded_train_step with "
+                "expert_axis for MoE")
+        pipe_size = mesh.mesh.shape[pipe_axis]
+        if c.n_layers % pipe_size:
+            raise ValueError(
+                f"n_layers={c.n_layers} not divisible by pipe={pipe_size}")
+        per_stage = c.n_layers // pipe_size
+
+        def stage_fn(stage_params, act):
+            # act: [mb, S, D]; rope positions are just arange(S) — S is
+            # static, so each stage recomputes them (nothing to smuggle)
+            positions = jnp.arange(act.shape[1])
+            x = act
+            for i in range(per_stage):
+                lp = jax.tree_util.tree_map(lambda a: a[i], stage_params)
+                x, _ = self._block(lp, x, positions, mesh=None,
+                                   seq_axis=None, data_axis=None,
+                                   model_axis=None, expert_axis=None)
+            return x
+
+        def forward(params, tokens):
+            x = params["outer"]["embed"][tokens]
+            out = pipeline_apply(stage_fn, params["stages"], x, mesh,
+                                 pipe_axis=pipe_axis,
+                                 num_microbatches=num_microbatches,
+                                 data_axis=data_axis)
+            x = _rms_norm(out, params["outer"]["ln_f"])
+            return x @ params["outer"]["head"]
+
+        stage_shard = NamedSharding(mesh.mesh, P(pipe_axis))
+        repl = NamedSharding(mesh.mesh, P())
+        tok_shard = NamedSharding(mesh.mesh, P(data_axis, None))
+        opt = optax.adam(learning_rate)
+
+        def init_state(rng=None):
+            flat = self.init(rng)
+            # stage-major [P, per, ...] leaves, each sharded over the pipe
+            # axis: device p holds exactly its own stage's slice
+            stages = jax.tree_util.tree_map(
+                lambda a: a.reshape((pipe_size, per_stage) + a.shape[1:]),
+                self.stacked_layer_params(flat))
+            params = {
+                "outer": jax.device_put(
+                    {"embed": flat["embed"], "ln_f": flat["ln_f"],
+                     "head": flat["head"]}, repl),
+                "stages": jax.tree_util.tree_map(
+                    lambda a: jax.device_put(a, stage_shard), stages),
+            }
+            # adam moments inherit each leaf's sharding through jit
+            opt_state = jax.jit(opt.init)(params)
+            return {"params": params, "opt": opt_state}
+
+        def step(state, tokens, targets):
+            def loss_fn(p):
+                return self._xent(forward(p, tokens), targets)
 
             loss, grads = jax.value_and_grad(loss_fn)(state["params"])
             updates, new_opt = opt.update(grads, state["opt"],

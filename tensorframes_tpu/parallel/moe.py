@@ -1,0 +1,99 @@
+"""Expert parallelism: a Switch-style top-1 MoE FFN, GSPMD-sharded.
+
+The reference has no notion of experts (its only parallelism is Spark
+partitions, SURVEY.md §2.3); this module exists because expert parallelism
+is a first-class mesh axis in the TPU design. It is written the idiomatic
+GSPMD way: the dispatch/combine are one-hot einsums (MXU work, no scatter),
+the expert weights and the dispatched token buffer carry ``expert``-axis
+sharding constraints, and **XLA inserts the all_to_all pair** between the
+token-sharded and expert-sharded layouts — no hand-written collective, the
+same lay-out-then-let-XLA recipe the rest of the framework uses.
+
+Capacity semantics: each expert processes at most
+``capacity = ceil(tokens/experts * capacity_factor)`` tokens; overflow
+tokens are dropped (their FFN delta is zero — the residual connection
+carries them through unchanged), the standard Switch-Transformer contract.
+
+Router details: softmax gate, top-1 expert, position-in-expert by cumsum,
+auxiliary load-balancing loss (mean gate mass x mean assignment share per
+expert, scaled by E) returned alongside.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from .mesh import DeviceMesh
+
+__all__ = ["init_switch_ffn", "switch_ffn"]
+
+
+def init_switch_ffn(rng: jax.Array, d_model: int, d_ff: int,
+                    num_experts: int, dtype=jnp.float32) -> Dict:
+    kr, k1, k2 = jax.random.split(rng, 3)
+    scale_in = np.sqrt(1.0 / d_model).astype(np.float32)
+    scale_out = np.sqrt(1.0 / d_ff).astype(np.float32)
+    return {
+        "router": jax.random.normal(kr, (d_model, num_experts),
+                                    jnp.float32) * scale_in,
+        "w1": jax.random.normal(k1, (num_experts, d_model, d_ff),
+                                dtype) * scale_in,
+        "w2": jax.random.normal(k2, (num_experts, d_ff, d_model),
+                                dtype) * scale_out,
+    }
+
+
+def switch_ffn(x: jax.Array, params: Dict,
+               capacity_factor: float = 1.25,
+               mesh: Optional[DeviceMesh] = None,
+               expert_axis: Optional[str] = None,
+               ) -> Tuple[jax.Array, jax.Array]:
+    """Top-1 MoE FFN. ``x``: [T, D] tokens -> ([T, D], aux_loss).
+
+    With ``mesh``+``expert_axis``, the [E, C, D] dispatched buffer and the
+    [E, D, F]/[E, F, D] expert weights are constrained to the expert axis;
+    tokens stay wherever their activations live (typically data-sharded).
+    """
+    T, D = x.shape
+    E = params["w1"].shape[0]
+    capacity = max(1, int(np.ceil(T / E * capacity_factor)))
+
+    def c(a, *spec):
+        if mesh is not None and expert_axis is not None:
+            return jax.lax.with_sharding_constraint(
+                a, jax.sharding.NamedSharding(mesh.mesh, P(*spec)))
+        return a
+
+    logits = x.astype(jnp.float32) @ params["router"]        # [T, E]
+    gates = jax.nn.softmax(logits, axis=-1)
+    expert = jnp.argmax(gates, axis=-1)                      # [T]
+    gate = jnp.max(gates, axis=-1)                           # [T]
+
+    onehot = jax.nn.one_hot(expert, E, dtype=jnp.float32)    # [T, E]
+    # position of each token within its expert's queue (0-based)
+    pos = jnp.cumsum(onehot, axis=0) * onehot - onehot       # [T, E]
+    keep = (pos < capacity).astype(jnp.float32) * onehot
+    pos_oh = jax.nn.one_hot(jnp.sum(pos, axis=-1).astype(jnp.int32),
+                            capacity, dtype=jnp.float32)     # [T, C]
+    dispatch = jnp.einsum("te,tc->tec", keep, pos_oh)        # [T, E, C]
+    combine = dispatch * gate[:, None, None]                 # [T, E, C]
+
+    xs = jnp.einsum("td,tec->ecd", x.astype(jnp.float32), dispatch)
+    xs = c(xs, expert_axis, None, None)                      # all_to_all in
+    w1 = c(params["w1"], expert_axis, None, None)
+    w2 = c(params["w2"], expert_axis, None, None)
+    h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", xs, w1.astype(jnp.float32)))
+    ys = jnp.einsum("ecf,efd->ecd", h, w2.astype(jnp.float32))
+    ys = c(ys, expert_axis, None, None)
+    out = jnp.einsum("ecd,tec->td", ys, combine)             # all_to_all out
+
+    # load-balancing auxiliary (Switch eq. 4): E * sum_e f_e * P_e
+    density = jnp.mean(onehot, axis=0)                       # f_e
+    gate_mass = jnp.mean(gates, axis=0)                      # P_e
+    aux = E * jnp.sum(density * gate_mass)
+    return out.astype(x.dtype), aux

@@ -1,0 +1,196 @@
+"""Pipeline (pp) and expert (ep) parallelism on the 8-virtual-device mesh.
+
+These axes have no reference analogue (SURVEY.md §2.3: Spark partitions
+only); correctness is defined against the unsharded single-device math.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from tensorframes_tpu.models.transformer import (TransformerConfig,
+                                                 TransformerLM)
+from tensorframes_tpu.parallel.mesh import DeviceMesh
+from tensorframes_tpu.parallel.moe import init_switch_ffn, switch_ffn
+from tensorframes_tpu.parallel.pipeline import pipeline_apply
+
+
+def _mesh(shape, names):
+    devs = np.array(jax.devices()[: int(np.prod(shape))]).reshape(shape)
+    return DeviceMesh(Mesh(devs, names), data_axis=names[0])
+
+
+# -- switch_ffn -------------------------------------------------------------
+
+def _ref_switch(x, params, capacity):
+    """Token-at-a-time top-1 routing with capacity drops (same gelu as the
+    kernel: jax.nn.gelu's default tanh approximation)."""
+    logits = x @ params["router"]
+    e = np.exp(logits - logits.max(-1, keepdims=True))
+    gates = e / e.sum(-1, keepdims=True)
+    top = gates.argmax(-1)
+    out = np.zeros_like(x)
+    counts = {}
+    for t in range(x.shape[0]):
+        ex = int(top[t])
+        k = counts.get(ex, 0)
+        if k < capacity:
+            counts[ex] = k + 1
+            h = np.asarray(jax.nn.gelu(x[t] @ params["w1"][ex]))
+            out[t] = (h @ params["w2"][ex]) * gates[t, ex]
+    return out
+
+
+def test_switch_ffn_routes_and_drops():
+    rng = jax.random.PRNGKey(0)
+    T, D, F, E = 32, 8, 16, 4
+    params = init_switch_ffn(rng, D, F, E)
+    x = jax.random.normal(jax.random.PRNGKey(1), (T, D))
+    out, aux = switch_ffn(x, params, capacity_factor=1.0)
+    np_params = jax.tree_util.tree_map(np.asarray, params)
+    ref = _ref_switch(np.asarray(x), np_params, capacity=T // E)
+    np.testing.assert_allclose(np.asarray(out), ref, atol=1e-5)
+    assert float(aux) > 0.0
+
+
+def test_switch_ffn_sharded_matches_unsharded():
+    mesh = _mesh((2, 4), ("data", "expert"))
+    rng = jax.random.PRNGKey(0)
+    T, D, F, E = 64, 8, 16, 4
+    params = init_switch_ffn(rng, D, F, E)
+    x = jax.random.normal(jax.random.PRNGKey(1), (T, D))
+    ref, _ = switch_ffn(x, params, capacity_factor=1.25)
+    out, _ = jax.jit(lambda x, p: switch_ffn(
+        x, p, capacity_factor=1.25, mesh=mesh, expert_axis="expert"))(
+            x, params)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+# -- pipeline_apply ---------------------------------------------------------
+
+def test_pipeline_matches_sequential():
+    mesh = _mesh((2, 4), ("data", "pipe"))
+    P_, per = 4, 3
+    D = 6
+    rng = np.random.default_rng(0)
+    ws = jnp.asarray(rng.normal(0, 0.3, (P_, D, D)), jnp.float32)
+
+    def stage_fn(w, act):
+        return jnp.tanh(act @ w[0])
+
+    x = jnp.asarray(rng.normal(size=(8, D)), jnp.float32)
+    got = pipeline_apply(stage_fn, ws[:, None], x, mesh, pipe_axis="pipe",
+                         data_axis="data")
+    want = x
+    for i in range(P_):
+        want = jnp.tanh(want @ ws[i])
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_pipeline_microbatches_more_than_stages():
+    mesh = _mesh((1, 4), ("data", "pipe"))
+    D = 4
+    rng = np.random.default_rng(1)
+    ws = jnp.asarray(rng.normal(0, 0.3, (4, D, D)), jnp.float32)
+
+    def stage_fn(w, act):
+        return act @ w[0]
+
+    x = jnp.asarray(rng.normal(size=(16, D)), jnp.float32)
+    got = pipeline_apply(stage_fn, ws[:, None], x, mesh, pipe_axis="pipe",
+                         num_microbatches=8)
+    want = x @ ws[0] @ ws[1] @ ws[2] @ ws[3]
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_pipeline_is_differentiable():
+    mesh = _mesh((1, 4), ("data", "pipe"))
+    D = 4
+    ws = jnp.ones((4, 1, D, D), jnp.float32) * 0.1
+    x = jnp.ones((4, D), jnp.float32)
+
+    def loss(w):
+        return pipeline_apply(lambda wp, a: a @ wp[0], w, x, mesh,
+                              pipe_axis="pipe").sum()
+
+    g = jax.grad(loss)(ws)
+    assert np.isfinite(np.asarray(g)).all()
+    assert float(jnp.abs(g).sum()) > 0
+
+
+# -- transformer integration ------------------------------------------------
+
+@pytest.fixture(scope="module")
+def moe_model():
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=4,
+                            n_layers=2, d_ff=64, num_experts=4)
+    return TransformerLM(cfg)
+
+
+def test_moe_transformer_forward_and_loss(moe_model):
+    params = moe_model.init(jax.random.PRNGKey(0))
+    toks = jnp.zeros((2, 8), jnp.int32)
+    logits, aux = moe_model.apply_with_aux(params, toks)
+    assert logits.shape == (2, 8, 64)
+    assert float(aux) > 0.0  # 2 MoE layers contribute
+    loss = moe_model.loss(params, toks, jnp.ones((2, 8), jnp.int32))
+    assert np.isfinite(float(loss))
+
+
+def test_moe_expert_parallel_train_step(moe_model):
+    mesh = _mesh((2, 2, 2), ("data", "model", "expert"))
+    step, init_state = moe_model.make_sharded_train_step(
+        mesh, data_axis="data", model_axis="model", expert_axis="expert")
+    state = init_state(jax.random.PRNGKey(0))
+    toks = jax.random.randint(jax.random.PRNGKey(1), (4, 8), 0, 64,
+                              jnp.int32)
+    state, loss = step(state, toks, jnp.roll(toks, -1, 1))
+    assert np.isfinite(float(loss))
+
+
+def test_pipelined_train_step_runs_and_learns():
+    cfg = TransformerConfig(vocab_size=32, d_model=16, n_heads=2,
+                            n_layers=4, d_ff=32)
+    model = TransformerLM(cfg)
+    mesh = _mesh((2, 4), ("data", "pipe"))
+    step, init_state = model.make_pipelined_train_step(
+        mesh, pipe_axis="pipe", data_axis="data", learning_rate=1e-2)
+    state = init_state(jax.random.PRNGKey(0))
+    toks = jax.random.randint(jax.random.PRNGKey(1), (8, 8), 0, 32,
+                              jnp.int32)
+    tgts = jnp.roll(toks, -1, 1)
+    state, l0 = step(state, toks, tgts)  # state is donated: use the return
+    for _ in range(5):
+        state, l = step(state, toks, tgts)
+    assert float(l) < float(l0)  # the pipelined grads actually descend
+
+
+def test_pipelined_forward_matches_unpipelined():
+    cfg = TransformerConfig(vocab_size=32, d_model=16, n_heads=2,
+                            n_layers=4, d_ff=32)
+    model = TransformerLM(cfg)
+    mesh = _mesh((1, 4), ("data", "pipe"))
+    params = model.init(jax.random.PRNGKey(0))
+    toks = jax.random.randint(jax.random.PRNGKey(1), (4, 8), 0, 32,
+                              jnp.int32)
+    want = model.apply(params, toks)
+
+    # rebuild the pipelined forward exactly as the train step does
+    step, init_state = model.make_pipelined_train_step(
+        mesh, pipe_axis="pipe", data_axis="data")
+    state = init_state(jax.random.PRNGKey(0))
+    # loss equality is the cleanest observable: same params, same tokens
+    tgts = jnp.roll(toks, -1, 1)
+    _, pipel = step(state, toks, tgts)
+    ref_loss = model.loss(params, toks, tgts)
+    assert float(pipel) == pytest.approx(float(ref_loss), rel=2e-4)
+
+
+def test_pipelined_step_rejects_moe(moe_model):
+    mesh = _mesh((1, 4), ("data", "pipe"))
+    with pytest.raises(ValueError, match="dense FFN models only"):
+        moe_model.make_pipelined_train_step(mesh, pipe_axis="pipe")
