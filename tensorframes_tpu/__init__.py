@@ -38,6 +38,7 @@ from .api import (
     aggregate, analyze, block, explain, frame, map_blocks, map_rows,
     print_schema, reduce_blocks, reduce_rows, row,
 )
+from . import builder
 
 __all__ = [
     "Shape",
@@ -64,6 +65,7 @@ __all__ = [
     "row",
     "frame",
     "utils",
+    "builder",
     "initialize_logging",
     "__version__",
 ]

@@ -1,0 +1,154 @@
+"""Op builder: run SERIALIZED computations against frames.
+
+The reference's Python↔JVM surface is a builder
+(``PythonInterface.scala:83-139``): ``api.map_blocks(df, trim)`` returns a
+``PythonOpBuilder`` on which the driver sets ``.graph(bytes)`` (the
+serialized GraphDef), ``.shape(names, shapes)`` (the ShapeDescription
+side-channel) and ``.fetches(names)``, then calls ``buildDF()`` /
+``buildRow()``. This module is the same contract for this framework: the
+"graph bytes" are a serialized :class:`~tensorframes_tpu.computation.
+Computation` (StableHLO + spec header, self-describing — shape hints are
+optional overrides rather than required), and the builder dispatches into
+the six-op engine. It is how a computation produced by ANOTHER process or
+host (the reference's driver→executor ship) enters this one.
+
+``save_computation`` / ``load_computation`` are the ``graph.pb``-fixture
+analogue (reference ``dsl/TestUtilities.scala:20-23``, ``test/dsl.scala:
+109-112``): computations as files on disk.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Mapping, Optional, Sequence
+
+from . import dtypes as _dt
+from .computation import Computation, TensorSpec
+from .engine import ops as _ops
+from .frame import GroupedFrame, TensorFrame
+from .shape import Shape
+
+__all__ = ["OpBuilder", "load_computation", "save_computation",
+           "map_blocks_builder", "map_rows_builder",
+           "reduce_blocks_builder", "reduce_rows_builder",
+           "aggregate_builder"]
+
+
+def save_computation(comp: Computation, path: str) -> None:
+    with open(path, "wb") as f:
+        f.write(comp.serialize())
+
+
+def load_computation(path: str) -> Computation:
+    with open(path, "rb") as f:
+        return Computation.deserialize(f.read())
+
+
+class OpBuilder:
+    """Builder for one op invocation from a serialized computation.
+
+    Usage (mirrors ``PythonOpBuilder``)::
+
+        out = (map_blocks_builder(df, trim=True)
+               .graph(blob)              # serialized Computation bytes
+               .fetches(["z"])           # optional output subset
+               .build())
+    """
+
+    def __init__(self, op: str, df: TensorFrame,
+                 grouped: Optional[GroupedFrame] = None, trim: bool = False):
+        self._op = op
+        self._df = df
+        self._grouped = grouped
+        self._trim = trim
+        self._comp: Optional[Computation] = None
+        self._fetches: Optional[Sequence[str]] = None
+        self._shapes: Dict[str, Shape] = {}
+
+    # -- configuration -----------------------------------------------------
+    def graph(self, data: bytes) -> "OpBuilder":
+        """Attach the serialized computation (the ``.graph(bytes)`` leg)."""
+        self._comp = Computation.deserialize(data)
+        return self
+
+    def computation(self, comp: Computation) -> "OpBuilder":
+        """Attach a live computation (same slot, no round-trip)."""
+        self._comp = comp
+        return self
+
+    def shape(self, shapes: Mapping[str, Shape]) -> "OpBuilder":
+        """Override output shapes (the ShapeDescription hint side-channel;
+        normally unnecessary — serialized computations are self-describing).
+        """
+        self._shapes.update(
+            {n: s if isinstance(s, Shape) else Shape(s)
+             for n, s in shapes.items()})
+        return self
+
+    def fetches(self, names: Sequence[str]) -> "OpBuilder":
+        """Restrict the outputs to ``names`` (the requested-fetch list)."""
+        self._fetches = list(names)
+        return self
+
+    # -- build -------------------------------------------------------------
+    def _resolved(self) -> Computation:
+        if self._comp is None:
+            raise ValueError("No computation attached; call .graph(bytes) "
+                             "or .computation(comp) first")
+        comp = self._comp
+        if self._shapes:
+            outs = [TensorSpec(s.name, s.dtype,
+                               self._shapes.get(s.name, s.shape))
+                    for s in comp.outputs]
+            comp = Computation(comp.fn, list(comp.inputs), outs)
+        if self._fetches is not None:
+            missing = [f for f in self._fetches
+                       if f not in comp.output_names]
+            if missing:
+                raise ValueError(
+                    f"Requested fetches {missing} not among computation "
+                    f"outputs {comp.output_names}")
+            keep = set(self._fetches)
+            inner = comp.fn
+            outs = [s for s in comp.outputs if s.name in keep]
+
+            def filtered(d):
+                return {k: v for k, v in inner(d).items() if k in keep}
+
+            comp = Computation(filtered, list(comp.inputs), outs)
+        return comp
+
+    def build(self):
+        """Dispatch. Frame-shaped ops return a TensorFrame (`buildDF`);
+        reduces return the one-row result (`buildRow`)."""
+        comp = self._resolved()
+        if self._op == "map_blocks":
+            return _ops.map_blocks(comp, self._df, trim=self._trim)
+        if self._op == "map_rows":
+            return _ops.map_rows(comp, self._df)
+        if self._op == "reduce_blocks":
+            return _ops.reduce_blocks(comp, self._df)
+        if self._op == "reduce_rows":
+            return _ops.reduce_rows(comp, self._df)
+        if self._op == "aggregate":
+            return _ops.aggregate(comp, self._grouped)
+        raise AssertionError(f"unknown op {self._op}")
+
+
+def map_blocks_builder(df: TensorFrame, trim: bool = False) -> OpBuilder:
+    return OpBuilder("map_blocks", df, trim=trim)
+
+
+def map_rows_builder(df: TensorFrame) -> OpBuilder:
+    return OpBuilder("map_rows", df)
+
+
+def reduce_blocks_builder(df: TensorFrame) -> OpBuilder:
+    return OpBuilder("reduce_blocks", df)
+
+
+def reduce_rows_builder(df: TensorFrame) -> OpBuilder:
+    return OpBuilder("reduce_rows", df)
+
+
+def aggregate_builder(grouped: GroupedFrame) -> OpBuilder:
+    return OpBuilder("aggregate", grouped.frame, grouped=grouped)

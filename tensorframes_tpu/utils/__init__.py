@@ -6,11 +6,13 @@ in for a tracer (SURVEY.md §5). Here logging and tracing are first-class
 modules the engine imports.
 """
 
+from . import checkpoint
 from .logging import TRACE, get_logger, initialize_logging, set_level
 from .tracing import (Timings, disable, enable, enabled, profile, span,
                       timings)
 
 __all__ = [
+    "checkpoint",
     "TRACE",
     "get_logger",
     "initialize_logging",

@@ -1,0 +1,98 @@
+"""Checkpoint / resume for train states and parameter pytrees.
+
+The reference has NO checkpointing (SURVEY.md §5: iterative state lived in
+driver numpy arrays re-embedded as constants each round — the k-means
+pattern). This framework trains real models over meshes, so durable state
+is part of the runtime: a thin wrapper over Orbax that
+
+ - saves any pytree of (possibly sharded) jax Arrays / numpy arrays;
+ - restores either to host numpy (no template) or to the exact shardings of
+   a template state (resume-on-mesh — each host reads only its shards);
+ - keeps the call surface to two functions, so driver loops stay as simple
+   as the reference's numpy round-tripping.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+from .logging import get_logger
+
+__all__ = ["save", "restore", "latest_step", "save_step", "restore_step"]
+
+_log = get_logger("utils.checkpoint")
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+
+    return ocp.StandardCheckpointer()
+
+
+def save(path: str, state: Any) -> None:
+    """Save a pytree of arrays to ``path`` (a directory, created fresh)."""
+    import jax
+
+    path = os.path.abspath(path)
+    ckpt = _checkpointer()
+    ckpt.save(path, jax.tree_util.tree_map(lambda x: x, state), force=True)
+    ckpt.wait_until_finished()
+    _log.debug("checkpoint saved to %s", path)
+
+
+def restore(path: str, like: Optional[Any] = None) -> Any:
+    """Restore a pytree from ``path``.
+
+    With ``like`` (a matching pytree of arrays — e.g. a freshly built train
+    state), every leaf is restored with that leaf's sharding/dtype: resuming
+    a sharded state puts each shard straight on its device. Without it,
+    leaves come back as host numpy arrays.
+    """
+    import jax
+
+    path = os.path.abspath(path)
+    ckpt = _checkpointer()
+    if like is None:
+        return ckpt.restore(path)
+    abstract = jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
+        if isinstance(x, jax.Array) else x, like)
+    return ckpt.restore(path, abstract)
+
+
+# -- stepped checkpoints (train loops) --------------------------------------
+
+def save_step(root: str, step: int, state: Any) -> str:
+    """Save under ``root/step_<n>``; returns the checkpoint path."""
+    path = os.path.join(os.path.abspath(root), f"step_{step:08d}")
+    save(path, state)
+    return path
+
+
+def latest_step(root: str) -> Optional[int]:
+    """Highest step saved under ``root``, or None."""
+    root = os.path.abspath(root)
+    if not os.path.isdir(root):
+        return None
+    steps = []
+    for name in os.listdir(root):
+        if name.startswith("step_"):
+            try:
+                steps.append(int(name[5:]))
+            except ValueError:
+                pass
+    return max(steps) if steps else None
+
+
+def restore_step(root: str, state_like: Optional[Any] = None,
+                 step: Optional[int] = None):
+    """Restore ``(state, step)`` from ``root`` (latest step by default);
+    returns ``(None, None)`` when nothing is saved — the cold-start case a
+    resume-capable driver loop checks first."""
+    if step is None:
+        step = latest_step(root)
+    if step is None:
+        return None, None
+    path = os.path.join(os.path.abspath(root), f"step_{step:08d}")
+    return restore(path, like=state_like), step
