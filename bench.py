@@ -17,41 +17,55 @@ weakness, ``DataOps.scala:30-33``), with the arithmetic vectorized in its
 favor. Ratio > 1 means the columnar TPU-resident path beats the
 row-marshalling design at equal scale.
 
-Prints exactly ONE JSON line on stdout.
+Robustness contract (the driver runs this unattended): the parent process
+NEVER runs jax itself. It launches the measurement in a subprocess with a
+hard timeout — first on the default (TPU) backend, then forced-CPU if the
+TPU attempt fails or hangs (a wedged TPU grant blocks indefinitely rather
+than erroring). Exactly ONE JSON line is printed on stdout in every case,
+with ``platform`` and (on failure) ``error`` fields.
 """
 
 import json
+import subprocess
 import sys
 import time
-
-import numpy as np
-
-import tensorframes_tpu as tft
-from tensorframes_tpu import dtypes as _dt
-from tensorframes_tpu.computation import Computation, TensorSpec
-from tensorframes_tpu.marshal import columns_to_rows, rows_to_columns
-from tensorframes_tpu.parallel.distributed import distribute, dmap_blocks
-from tensorframes_tpu.parallel.mesh import local_mesh
-from tensorframes_tpu.shape import Shape, Unknown
 
 N_ROWS = 1_000_000
 WARMUP = 3
 ITERS = 20
+TPU_TIMEOUT_S = 420   # first TPU compile is 20-40s; a wedged grant hangs
+CPU_TIMEOUT_S = 300
 
 
-def build_frame():
+# --------------------------------------------------------------------------
+# child: the actual measurement (runs in a subprocess with a timeout)
+# --------------------------------------------------------------------------
+
+def _child(platform: str) -> None:
+    if platform == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    import tensorframes_tpu as tft
+    from tensorframes_tpu import dtypes as _dt
+    from tensorframes_tpu.computation import Computation, TensorSpec
+    from tensorframes_tpu.marshal import columns_to_rows, rows_to_columns
+    from tensorframes_tpu.parallel.distributed import distribute, dmap_blocks
+    from tensorframes_tpu.parallel.mesh import local_mesh
+    from tensorframes_tpu.shape import Shape, Unknown
+
+    import jax
+
     x = np.arange(N_ROWS, dtype=np.float64)
     df = tft.frame({"x": x}, num_partitions=1)
     df.cache()
-    return df
 
-
-def bench_dmap_blocks(df) -> float:
-    import jax
-
+    # ours: device-resident columnar path, one dispatch per iteration
     mesh = local_mesh()
     dist = distribute(df, mesh)
-    # one Computation object -> one jit trace across all iterations
     comp = Computation.trace(
         lambda x: {"z": x + 3.0},
         [TensorSpec("x", _dt.double, Shape(Unknown))])
@@ -62,40 +76,96 @@ def bench_dmap_blocks(df) -> float:
     for _ in range(ITERS):
         out = dmap_blocks(comp, dist, trim=True)
         jax.block_until_ready(out.columns["z"])
-    dt = (time.perf_counter() - t0) / ITERS
-    return N_ROWS / dt
+    ours = N_ROWS / ((time.perf_counter() - t0) / ITERS)
 
-
-def bench_reference_rowpath(df) -> float:
-    """The reference's structure: Rows materialized in and out per block."""
+    # reference structure: Rows materialized in and out per block
     schema = df.schema
     t0 = time.perf_counter()
     for b in df.blocks():
         rows = columns_to_rows(b.columns, schema)          # convert
         mapped = [(r[0] + 3.0,) for r in rows]             # the computation
         rows_to_columns(mapped, schema)                    # convertBack
-    dt = time.perf_counter() - t0
-    return N_ROWS / dt
+    ref = N_ROWS / (time.perf_counter() - t0)
 
-
-def main():
-    df = build_frame()
-    ours = bench_dmap_blocks(df)
-    ref = bench_reference_rowpath(df)
-    n_chips = max(1, local_chips())
+    n_chips = max(1, len(jax.devices()))
     print(json.dumps({
         "metric": "map_blocks_add_const_1M_rows",
         "value": round(ours / n_chips, 1),
         "unit": "rows/sec/chip",
         "vs_baseline": round(ours / ref, 2),
+        "platform": jax.default_backend(),
+        "n_chips": n_chips,
     }))
 
 
-def local_chips() -> int:
-    import jax
+# --------------------------------------------------------------------------
+# parent: orchestrate attempts, guarantee one JSON line
+# --------------------------------------------------------------------------
 
-    return len(jax.devices())
+def _attempt(platform: str, timeout_s: int):
+    """Run the child; return (record|None, error string|None).
+
+    The child runs in its own process group; on timeout the whole group
+    gets SIGKILL and the parent waits only a bounded grace period — a child
+    stuck in an uninterruptible TPU-driver syscall (wedged grant) must not
+    keep the parent from its CPU fallback and final JSON line.
+    """
+    import os
+    import signal
+
+    proc = subprocess.Popen(
+        [sys.executable, __file__, "--child", platform],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        start_new_session=True)
+    try:
+        stdout, stderr = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+        try:
+            proc.communicate(timeout=10)
+        except subprocess.TimeoutExpired:
+            pass  # unreapable (D state); abandon the corpse and move on
+        return None, f"{platform}: timed out after {timeout_s}s"
+    if proc.returncode != 0:
+        tail = (stderr or "").strip().splitlines()[-1:] or ["no output"]
+        return None, f"{platform}: rc={proc.returncode} ({tail[0][:300]})"
+    for line in reversed((stdout or "").strip().splitlines()):
+        try:
+            rec = json.loads(line)
+            if isinstance(rec, dict) and "value" in rec:
+                return rec, None
+        except json.JSONDecodeError:
+            continue
+    return None, f"{platform}: produced no JSON line"
+
+
+def main() -> int:
+    errors = []
+    rec, err = _attempt("tpu", TPU_TIMEOUT_S)
+    if rec is None:
+        errors.append(err)
+        rec, err = _attempt("cpu", CPU_TIMEOUT_S)
+        if rec is not None:
+            rec["error"] = f"tpu attempt failed, cpu fallback ({errors[0]})"
+    if rec is None:
+        errors.append(err)
+        rec = {
+            "metric": "map_blocks_add_const_1M_rows",
+            "value": 0.0,
+            "unit": "rows/sec/chip",
+            "vs_baseline": 0.0,
+            "platform": "none",
+            "error": "; ".join(errors),
+        }
+    print(json.dumps(rec))
+    return 0
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    if len(sys.argv) >= 3 and sys.argv[1] == "--child":
+        _child(sys.argv[2])
+    else:
+        sys.exit(main())

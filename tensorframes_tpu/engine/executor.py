@@ -28,7 +28,7 @@ from ..computation import Computation
 from ..utils.logging import get_logger
 from ..utils.tracing import enabled as _tracing_enabled, span
 
-__all__ = ["BlockExecutor", "default_executor"]
+__all__ = ["BlockExecutor", "default_executor", "default_padding_executor"]
 
 _log = get_logger("engine.executor")
 
@@ -143,13 +143,29 @@ class BlockExecutor:
 
 
 _default: Optional[BlockExecutor] = None
+_default_padding: Optional[BlockExecutor] = None
 _default_lock = threading.Lock()
 
 
 def default_executor() -> BlockExecutor:
+    """Exact-shape executor: block-level computations may be cross-row
+    (e.g. ``z = x - mean(x)``), so padding would corrupt them."""
     global _default
     if _default is None:
         with _default_lock:
             if _default is None:
                 _default = BlockExecutor()
     return _default
+
+
+def default_padding_executor() -> BlockExecutor:
+    """Bucketed-padding executor for row-local computations (``map_rows``:
+    rows are independent under vmap, so padding the row dim to power-of-two
+    buckets is safe and bounds compile signatures to O(log max_rows) for
+    streams of odd-sized blocks — SURVEY.md §7 hard part #1)."""
+    global _default_padding
+    if _default_padding is None:
+        with _default_lock:
+            if _default_padding is None:
+                _default_padding = BlockExecutor(pad_rows=True)
+    return _default_padding

@@ -25,7 +25,8 @@ Validation errors mirror ``Operations.scala:7-15``'s exception taxonomy.
 from __future__ import annotations
 
 import inspect
-from typing import Callable, Dict, List, Mapping, Optional, Sequence, Tuple, Union
+from typing import (Callable, Dict, List, Mapping, NamedTuple, Optional,
+                    Sequence, Tuple, Union)
 
 import jax
 import numpy as np
@@ -39,7 +40,8 @@ from ..shape import Shape, Unknown
 from ..utils.logging import get_logger
 from ..utils.tracing import span
 from .compaction import CompactionBuffer, DEFAULT_BUFFER_SIZE
-from .executor import BlockExecutor, default_executor
+from .executor import (BlockExecutor, default_executor,
+                       default_padding_executor)
 
 _log = get_logger("engine.ops")
 
@@ -330,7 +332,10 @@ def map_rows(fetches: Fetches, df: TensorFrame,
     fall back to genuine per-row execution, which is what makes
     variable-length cells work.
     """
-    ex = executor or default_executor()
+    # rows are independent by construction here, so the bucketed-padding
+    # executor is safe: streams of odd-sized blocks (and ragged group
+    # sizes) share O(log) compile signatures instead of one per size
+    ex = executor or default_padding_executor()
     comp = _map_computation(fetches, df.schema, block_level=False)
     out_schema = _validate_map(comp, df.schema, block_level=False, trim=False)
     in_names = comp.input_names
@@ -503,17 +508,144 @@ def reduce_rows(fetches: Fetches, df: TensorFrame,
 # aggregate
 # ---------------------------------------------------------------------------
 
+class KeyFactorization(NamedTuple):
+    """Dense-id view of (possibly multiple) scalar key columns: the
+    shuffle's key→partition mapping of the reference (Catalyst groupBy)
+    reduced to a host factorization — per-row VALUES never come through."""
+
+    ids: np.ndarray            # [n] group index per input row
+    uniques: List[np.ndarray]  # per key column: each group's key value
+    num_groups: int
+    order: np.ndarray          # [n] lexsort permutation (sorted-by-key)
+    seg_starts: np.ndarray     # [num_groups] group start offsets in `order`
+
+
+def _factorize_keys(key_arrays: Sequence[np.ndarray]) -> KeyFactorization:
+    n = len(key_arrays[0])
+    order = np.lexsort(tuple(reversed(tuple(key_arrays))))
+    sorted_keys = [a[order] for a in key_arrays]
+    changed = np.zeros(n, dtype=bool)
+    changed[0] = True
+    for a in sorted_keys:
+        changed[1:] |= a[1:] != a[:-1]
+    gidx_sorted = np.cumsum(changed) - 1
+    ids = np.empty(n, np.int64)
+    ids[order] = gidx_sorted
+    uniques = [a[changed] for a in sorted_keys]
+    return KeyFactorization(ids, uniques, int(gidx_sorted[-1]) + 1,
+                            order, np.flatnonzero(changed))
+
+
+def _validate_monoid_fetches(col_combiners: Mapping[str, str],
+                             value_names: Sequence[str],
+                             drop_hint: str) -> None:
+    """Shared checks for the {column: combiner-name} aggregate form (host
+    and mesh paths raise identical exceptions)."""
+    from ..parallel.collectives import COMBINERS as _known
+    unknown = sorted(set(col_combiners) - set(value_names))
+    if unknown:
+        raise InputNotFoundError(
+            f"Aggregate fetches {unknown} match no value column; value "
+            f"columns: {list(value_names)}")
+    unused = [n for n in value_names if n not in col_combiners]
+    if unused:
+        raise InputNotFoundError(
+            f"Columns {unused} are not consumed by the aggregation; drop "
+            f"them {drop_hint} (every column must back a fetch)")
+    for name, cname in col_combiners.items():
+        if cname not in _known:
+            raise ValueError(
+                f"Unknown combiner {cname!r} for {name!r}; known: "
+                f"{sorted(_known)}")
+
+
+# Segment-reduce implementations for the monoid combiner names (the same
+# names COMBINERS serves for dreduce_blocks). "sum" routes through the
+# one-hot-matmul Pallas kernel on TPU (ops/segment_reduce.py); the others
+# through XLA's segment primitives.
+def _segment_reduce(cname: str, values, ids, num_segments: int):
+    import jax.numpy as jnp
+
+    from ..ops.segment_reduce import segment_sum as _segsum
+    if cname == "sum":
+        return _segsum(values, ids, num_segments)
+    fn = {"min": jax.ops.segment_min, "max": jax.ops.segment_max,
+          "prod": jax.ops.segment_prod}[cname]
+    return fn(jnp.asarray(values), jnp.asarray(ids),
+              num_segments=num_segments)
+
+
+def _monoid_aggregate(col_combiners: Mapping[str, str],
+                      grouped: GroupedFrame) -> TensorFrame:
+    """Keyed aggregation for the associative monoids: key→dense-id
+    factorization on the host, then ONE segment-reduce launch per fetch
+    column — O(1) device dispatches regardless of the number of groups,
+    where the generic compaction path pays O(groups)."""
+    df = grouped.frame
+    keys = grouped.keys
+    value_names = [n for n in df.schema.names if n not in keys]
+    _validate_monoid_fetches(col_combiners, value_names,
+                             "with select() first")
+
+    merged = Block.concat(df.blocks(), df.schema)
+    for k in keys:
+        if merged.is_ragged(k) or merged.dense(k).ndim != 1:
+            raise InvalidTypeError(f"Key column {k!r} must be scalar-typed")
+    fetch_names = sorted(col_combiners)
+    out_fields = [df.schema[k] for k in keys] + [
+        Field(f, df.schema[f].dtype,
+              block_shape=_field_spec(df.schema[f], True, "aggregate")
+              .with_lead(Unknown),
+              sql_rank=df.schema[f].sql_rank)
+        for f in fetch_names]
+    n = merged.num_rows
+    if n == 0:
+        return TensorFrame.from_blocks(
+            [Block({f.name: np.empty((0,), f.dtype.np_storage)
+                    for f in out_fields}, 0)], Schema(out_fields))
+
+    fact = _factorize_keys([merged.dense(k) for k in keys])
+    ids, uniques, num_groups = fact.ids, fact.uniques, fact.num_groups
+    cols: Dict[str, np.ndarray] = {k: u for k, u in zip(keys, uniques)}
+    with span("aggregate.segment_reduce"):
+        for f in fetch_names:
+            field = df.schema[f]
+            vals = merged.dense(f)
+            dd = _dt.device_dtype(field.dtype)
+            if vals.dtype != dd:
+                from .. import native as _native
+                vals = _native.convert(vals, dd)
+            out = np.asarray(_segment_reduce(
+                col_combiners[f], vals, ids, num_groups))
+            if out.dtype != field.dtype.np_storage \
+                    and field.dtype is not _dt.bfloat16:
+                out = out.astype(field.dtype.np_storage)
+            cols[f] = out
+    return TensorFrame.from_blocks([Block(cols, num_groups)],
+                                   Schema(out_fields))
+
+
 def aggregate(fetches: Fetches, grouped: GroupedFrame,
               buffer_size: int = DEFAULT_BUFFER_SIZE,
               executor: Optional[BlockExecutor] = None) -> TensorFrame:
     """Algebraic keyed aggregation: for each distinct key, reduce the
     group's rows with the fetch computation (reduce_blocks contract).
 
-    The shuffle is a host-side sort-by-key (the Catalyst groupBy shuffle of
-    the reference, ``DebugRowOps.scala:533-578``); each group then reduces
-    through a :class:`CompactionBuffer` honoring the UDAF buffered-compaction
-    contract (buffer_size=10 by default, ``DebugRowOps.scala:559``).
+    Two paths:
+
+    - ``fetches`` is a mapping ``{column: combiner-name}`` (sum/min/max/
+      prod): host key factorization + ONE segment-reduce device launch per
+      column (the Pallas one-hot-matmul kernel for float sums on TPU) —
+      O(1) dispatches for any number of groups;
+    - ``fetches`` is a computation: host-side sort-by-key (the Catalyst
+      groupBy shuffle of the reference, ``DebugRowOps.scala:533-578``),
+      then each group reduces through a :class:`CompactionBuffer` honoring
+      the UDAF buffered-compaction contract (buffer_size=10 by default,
+      ``DebugRowOps.scala:559``).
     """
+    if isinstance(fetches, Mapping) and fetches and all(
+            isinstance(v, str) for v in fetches.values()):
+        return _monoid_aggregate(fetches, grouped)
     ex = executor or default_executor()
     df = grouped.frame
     keys = grouped.keys
@@ -549,20 +681,14 @@ def aggregate(fetches: Fetches, grouped: GroupedFrame,
                     for f in out_fields}, 0)], Schema(out_fields))
 
     # sort-by-key "shuffle", then contiguous segments per distinct key
-    order = np.lexsort(tuple(reversed(key_arrays)))
-    sorted_keys = [a[order] for a in key_arrays]
-    changed = np.zeros(n, dtype=bool)
-    changed[0] = True
-    for a in sorted_keys:
-        changed[1:] |= a[1:] != a[:-1]
-    seg_starts = np.flatnonzero(changed)
+    fact = _factorize_keys(key_arrays)
+    order, seg_starts = fact.order, fact.seg_starts
     seg_ends = np.append(seg_starts[1:], n)
 
     from .. import native as _native
     fetch_blocks = {f: _native.gather_rows(merged.dense(f), order)
                     for f in fetch_names}
     out_rows: Dict[str, List[np.ndarray]] = {f: [] for f in fetch_names}
-    key_rows: Dict[str, List] = {k: [] for k in keys}
     # Ingest each segment in power-of-two-sized chunks (capped): any length
     # decomposes into <= log2(cap) + n/cap chunks, so the whole aggregation
     # touches only O(log) distinct compile signatures, shared across groups,
@@ -590,12 +716,10 @@ def aggregate(fetches: Fetches, grouped: GroupedFrame,
         result = buf.evaluate()
         for f in fetch_names:
             out_rows[f].append(result[f])
-        for k, arr in zip(keys, sorted_keys):
-            key_rows[k].append(arr[a])
 
     cols: Dict[str, np.ndarray] = {}
-    for k in keys:
-        cols[k] = np.asarray(key_rows[k])
+    for k, u in zip(keys, fact.uniques):
+        cols[k] = u
     for f in fetch_names:
         cols[f] = np.stack(out_rows[f])
     out_fields = [df.schema[k] for k in keys] + [

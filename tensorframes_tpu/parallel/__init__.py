@@ -11,13 +11,14 @@ first-class citizen of the same mesh.
 
 from .mesh import DeviceMesh, local_mesh
 from .distributed import (
-    DistributedFrame, distribute, dmap_blocks, dreduce_blocks)
+    DistributedFrame, daggregate, distribute, dmap_blocks, dreduce_blocks)
 from .collectives import COMBINERS
 from .ring import ring_attention, ring_allreduce
 
 __all__ = [
     "DeviceMesh", "local_mesh",
-    "DistributedFrame", "distribute", "dmap_blocks", "dreduce_blocks",
+    "DistributedFrame", "daggregate", "distribute", "dmap_blocks",
+    "dreduce_blocks",
     "COMBINERS",
     "ring_attention", "ring_allreduce",
 ]

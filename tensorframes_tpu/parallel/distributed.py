@@ -40,7 +40,7 @@ from .collectives import COMBINERS
 from .mesh import DeviceMesh
 
 __all__ = ["DistributedFrame", "distribute", "dmap_blocks",
-           "dreduce_blocks"]
+           "dreduce_blocks", "daggregate"]
 
 def _jitted(comp):
     """One jitted wrapper per live Computation, stored on the object so it
@@ -140,6 +140,12 @@ def dmap_blocks(fetches, dist: DistributedFrame, trim: bool = False,
     explicitly when the sizes could coincide.
     """
     schema = dist.schema
+    if row_aligned is False and not trim:
+        raise ValueError(
+            "row_aligned=False only makes sense for trim=True outputs: "
+            "without trim the untrimmed input columns ride along and still "
+            "contain pad rows, which declaring every output row real would "
+            "surface as data")
     comp = _ops._map_computation(fetches, schema, block_level=True)
     out_schema = _ops._validate_map(comp, schema, block_level=True, trim=trim)
     mesh = dist.mesh
@@ -258,42 +264,189 @@ def _collective_reduce(col_combiners: Mapping[str, str],
     return result
 
 
+def daggregate(col_combiners: Mapping[str, str], dist: DistributedFrame,
+               keys) -> TensorFrame:
+    """Mesh-distributed keyed aggregation over the monoid combiners.
+
+    The reference's Catalyst shuffle + UDAF (``DebugRowOps.scala:533-681``)
+    re-expressed TPU-first: instead of moving rows between workers by key,
+    each shard segment-reduces its LOCAL rows into a dense ``[groups, ...]``
+    table (one one-hot-matmul/segment kernel launch) and the tables are
+    combined with a single ``psum``-family collective over the data axis —
+    the shuffle becomes an ICI all-reduce of a small table. Only the scalar
+    KEY columns visit the host (to build dense group ids); the values never
+    leave their shards.
+
+    ``keys``: key column name or list of names. Returns a host
+    :class:`TensorFrame` of one row per group (keys + fetches, fetches
+    sorted by name), like :func:`~tensorframes_tpu.api.aggregate`.
+    """
+    from ..engine.ops import (InvalidTypeError, _factorize_keys,
+                              _validate_monoid_fetches)
+    from ..ops.segment_reduce import segment_sum as _segsum
+
+    if isinstance(keys, str):
+        keys = [keys]
+    keys = list(keys)
+    mesh = dist.mesh
+    axis = mesh.data_axis
+    schema = dist.schema
+    for k in keys:
+        if k not in schema:
+            raise KeyError(f"No key column {k!r}; columns: {schema.names}")
+    value_names = [n for n in schema.names if n not in keys]
+    _validate_monoid_fetches(col_combiners, value_names,
+                             "before distribute()")
+    n = dist.num_rows
+    if n == 0:
+        raise ValueError("aggregate on an empty distributed frame")
+
+    key_host = []
+    for k in keys:
+        fld = schema[k]
+        a = np.asarray(dist.columns[k])[:n]
+        if a.ndim != 1:
+            raise InvalidTypeError(f"Key column {k!r} must be scalar-typed")
+        if a.dtype != fld.dtype.np_storage and fld.dtype is not _dt.bfloat16:
+            # distribute() stored this column in its device dtype; if that
+            # narrowed the storage type (long->int / double->float with x64
+            # off), distinct keys may already have collapsed on device —
+            # group identity is unrecoverable, so fail loudly instead of
+            # silently merging groups
+            if np.dtype(a.dtype).itemsize < np.dtype(fld.dtype.np_storage).itemsize:
+                raise InvalidTypeError(
+                    f"Key column {k!r} ({fld.dtype.name}) was narrowed to "
+                    f"{a.dtype} on device, which can merge distinct keys; "
+                    f"cast the key to a device-exact type (e.g. int) before "
+                    f"distribute(), or enable x64")
+            a = a.astype(fld.dtype.np_storage)
+        key_host.append(a)
+    fact = _factorize_keys(key_host)
+    ids, uniques, num_groups = fact.ids, fact.uniques, fact.num_groups
+    ids_padded = np.full(dist.padded_rows, -1, np.int32)  # -1: pad, dropped
+    ids_padded[:n] = ids
+    ids_dev = jax.device_put(ids_padded, mesh.row_sharding(1))
+
+    fetch_names = sorted(col_combiners)
+    arrays = [dist.columns[f] for f in fetch_names]
+    in_specs = (P(axis),) + tuple(
+        P(axis, *([None] * (a.ndim - 1))) for a in arrays)
+    out_specs = tuple(P() for _ in fetch_names)
+
+    def shard_fn(ids_local, *vals_local):
+        outs = []
+        for f, v in zip(fetch_names, vals_local):
+            cname = col_combiners[f]
+            if cname == "sum":
+                local = _segsum(v, ids_local, num_groups)
+            else:
+                # mask pad/out-of-range rows to the combiner's neutral and
+                # clamp their id to 0 so XLA's segment primitive sees only
+                # in-range indices
+                c = COMBINERS[cname]
+                valid = ids_local >= 0
+                vmask = valid.reshape((-1,) + (1,) * (v.ndim - 1))
+                neutral = jnp.asarray(c.neutral(v.dtype))
+                masked = jnp.where(vmask, v, neutral)
+                safe_ids = jnp.where(valid, ids_local, 0)
+                seg = {"min": jax.ops.segment_min,
+                       "max": jax.ops.segment_max,
+                       "prod": jax.ops.segment_prod}[cname]
+                local = seg(masked, safe_ids, num_segments=num_groups)
+                # a group absent from this shard holds the identity; for
+                # min/max that identity is +-inf, which the cross-shard
+                # collective absorbs (every group exists somewhere)
+            outs.append(COMBINERS[cname].collective(local, axis))
+        return tuple(outs)
+
+    fn = jax.jit(shard_map(shard_fn, mesh=mesh.mesh,
+                           in_specs=in_specs, out_specs=out_specs))
+    tables = fn(ids_dev, *arrays)
+
+    cols: Dict[str, np.ndarray] = {k: u for k, u in zip(keys, uniques)}
+    for f, t in zip(fetch_names, tables):
+        v = np.asarray(t)
+        fld = schema[f]
+        if v.dtype != fld.dtype.np_storage and fld.dtype is not _dt.bfloat16:
+            v = v.astype(fld.dtype.np_storage)
+        cols[f] = v
+    from ..schema import Field
+    from ..shape import Unknown
+    out_fields = [schema[k] for k in keys] + [
+        Field(f, schema[f].dtype,
+              block_shape=(schema[f].block_shape.with_lead(Unknown)
+                           if schema[f].block_shape is not None else None),
+              sql_rank=schema[f].sql_rank)
+        for f in fetch_names]
+    return TensorFrame.from_blocks([Block(cols, num_groups)],
+                                   Schema(out_fields))
+
+
 def _generic_reduce(fetches, dist: DistributedFrame) -> Dict[str, np.ndarray]:
+    """Generic (arbitrary-computation) mesh reduce, entirely on device.
+
+    One compiled program: a ``shard_map`` stage runs the user block-reduce
+    on every shard's local rows in parallel (SPMD — pad-only shards compute
+    a garbage partial that is statically sliced away), the ragged tail
+    shard's valid prefix is re-reduced on its own, and the partials are
+    combined with one final stacked block-reduce. The only host transfer is
+    the final one-cell result — the reference's driver-collect analogue
+    (``DebugRowOps.scala:511-512``), with the per-shard data never leaving
+    its device.
+    """
     schema = dist.schema
     comp = _ops._reduce_computation(fetches, schema, ("_input",),
                                     block_level=True)
     _ops._validate_reduce(comp, schema, ("_input",), rank_delta=1)
     fetch_names = comp.output_names
     mesh = dist.mesh
+    axis = mesh.data_axis
     shards = mesh.num_data_shards
     n = dist.num_rows
     if n == 0:
         raise ValueError("reduce on an empty distributed frame")
     rows_per = dist.padded_rows // shards
+    full = n // rows_per          # shards whose rows are all valid
+    tail = n - full * rows_per    # valid rows in the boundary shard
 
-    # Per-device async dispatch: each device reduces its own (unpadded
-    # portion of its) shard; dispatches overlap via JAX async execution.
-    devices = [d for d in mesh.mesh.devices.flatten()][:shards]
-    # inputs are committed per device; the jitted computation follows the
-    # data, and jax.jit's own shape-keyed cache handles the ragged tail
-    jf = _jitted(comp)
-    partials = []
-    for s in range(shards):
-        a0 = s * rows_per
-        b0 = min((s + 1) * rows_per, n)
-        if b0 <= a0:
-            continue
-        dev = devices[s % len(devices)]
-        feeds = {f + "_input": jax.device_put(dist.columns[f][a0:b0], dev)
-                 for f in fetch_names}
-        partials.append(jf(feeds))
-    # partials live on distinct devices; gather them to host (tiny — one
-    # cell each, the reference's driver-side combine did the same) and run
-    # the final combine as one stacked block-reduce
-    stacked = {
-        f + "_input": np.stack([np.asarray(p[f]) for p in partials])
-        for f in fetch_names}
-    final = _jitted(comp)(stacked)
+    names = sorted(fetch_names)
+    arrays = [dist.columns[f] for f in names]
+    cache = getattr(comp, "_tft_dreduce_cache", None)
+    if cache is None:
+        cache = comp._tft_dreduce_cache = {}
+    key = (mesh.mesh, axis, n,
+           tuple((f, a.shape, str(a.dtype)) for f, a in zip(names, arrays)))
+    fn = cache.get(key)
+    if fn is None:
+        in_specs = tuple(P(axis, *([None] * (a.ndim - 1))) for a in arrays)
+        # each shard emits its partial with a unit lead axis; stacking over
+        # the data axis yields a (shards, *cell) global array
+        out_specs = tuple(P(axis) for _ in names)
+
+        def shard_fn(*local):
+            out = comp.fn(
+                {f + "_input": s for f, s in zip(names, local)})
+            return tuple(out[f][None] for f in names)
+
+        def program(*cols):
+            stacked = shard_map(shard_fn, mesh=mesh.mesh,
+                                in_specs=in_specs,
+                                out_specs=out_specs)(*cols)
+            parts = {f: st[:full] for f, st in zip(names, stacked)}
+            if tail:
+                t = comp.fn({
+                    f + "_input":
+                        jax.lax.slice_in_dim(c, full * rows_per,
+                                             full * rows_per + tail, axis=0)
+                    for f, c in zip(names, cols)})
+                parts = ({f: t[f][None] for f in names} if full == 0 else
+                         {f: jnp.concatenate([parts[f], t[f][None]])
+                          for f in names})
+            return comp.fn({f + "_input": parts[f] for f in names})
+
+        fn = jax.jit(program)
+        cache[key] = fn
+    final = fn(*arrays)
     out = {}
     for f in fetch_names:
         v = np.asarray(final[f])

@@ -81,16 +81,29 @@ def switch_ffn(x: jax.Array, params: Dict,
     pos_oh = jax.nn.one_hot(jnp.sum(pos, axis=-1).astype(jnp.int32),
                             capacity, dtype=jnp.float32)     # [T, C]
     dispatch = jnp.einsum("te,tc->tec", keep, pos_oh)        # [T, E, C]
-    combine = dispatch * gate[:, None, None]                 # [T, E, C]
 
-    xs = jnp.einsum("td,tec->ecd", x.astype(jnp.float32), dispatch)
+    # Routing math stays f32 (cumsum counts, gate probabilities); the
+    # expert matmuls run in the model dtype so bf16 keeps MXU throughput,
+    # with f32 accumulation via preferred_element_type. The dispatch and
+    # un-dispatch einsums are pure 0/1 token permutations (each
+    # expert-capacity slot holds at most one token), so the model dtype is
+    # exact for them; the continuous gate factor is applied afterwards per
+    # token in f32 to avoid rounding the routing weights to bf16.
+    cdt = x.dtype
+    xs = jnp.einsum("td,tec->ecd", x, dispatch.astype(cdt))
     xs = c(xs, expert_axis, None, None)                      # all_to_all in
     w1 = c(params["w1"], expert_axis, None, None)
     w2 = c(params["w2"], expert_axis, None, None)
-    h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", xs, w1.astype(jnp.float32)))
-    ys = jnp.einsum("ecf,efd->ecd", h, w2.astype(jnp.float32))
+    h = jax.nn.gelu(jnp.einsum(
+        "ecd,edf->ecf", xs, w1.astype(cdt),
+        preferred_element_type=jnp.float32)).astype(cdt)
+    ys = jnp.einsum("ecf,efd->ecd", h, w2.astype(cdt),
+                    preferred_element_type=jnp.float32).astype(cdt)
     ys = c(ys, expert_axis, None, None)
-    out = jnp.einsum("ecd,tec->td", ys, combine)             # all_to_all out
+    routed = jnp.einsum("ecd,tec->td", ys,
+                        dispatch.astype(cdt))                # all_to_all out
+    kept_gate = gate * jnp.sum(keep, axis=-1)  # 0 for dropped tokens
+    out = routed.astype(jnp.float32) * kept_gate[:, None]
 
     # load-balancing auxiliary (Switch eq. 4): E * sum_e f_e * P_e
     density = jnp.mean(onehot, axis=0)                       # f_e

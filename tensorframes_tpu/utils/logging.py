@@ -111,6 +111,13 @@ def initialize_logging(level: Optional[int] = None,
 def set_level(level) -> None:
     """Set the framework log level (accepts names, including "TRACE")."""
     if isinstance(level, str):
-        level = TRACE if level.upper() == "TRACE" else \
-            getattr(logging, level.upper())
+        name = level.upper()
+        if name == "TRACE":
+            level = TRACE
+        else:
+            level = getattr(logging, name, None)
+            if not isinstance(level, int):
+                raise ValueError(
+                    f"Unknown log level {name!r}; expected one of "
+                    f"TRACE, DEBUG, INFO, WARNING, ERROR, CRITICAL")
     _root_logger.setLevel(level)

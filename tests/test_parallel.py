@@ -132,3 +132,57 @@ def test_ring_attention_matches_full(mesh8, causal):
     qs, ks, vs = (jax.device_put(a, sharding) for a in (q, k, v))
     out = np.asarray(par.ring_attention(qs, ks, vs, mesh8, causal=causal))
     np.testing.assert_allclose(out, expected, rtol=2e-4, atol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# daggregate
+# ---------------------------------------------------------------------------
+
+def test_daggregate_matches_host_aggregate(mesh8):
+    rng = np.random.default_rng(11)
+    n, g = 10_000, 1_000
+    keys = rng.integers(0, g, n)
+    vals = rng.normal(size=n)
+    df = tft.frame({"key": keys, "x": vals}, num_partitions=4)
+    host = tft.aggregate({"x": "sum"}, df.group_by("key"))
+    dist = par.distribute(df, mesh8)
+    mesh_out = par.daggregate({"x": "sum"}, dist, "key")
+    h = {r["key"]: r["x"] for r in host.collect()}
+    m = {r["key"]: r["x"] for r in mesh_out.collect()}
+    assert set(h) == set(m)
+    for k in h:
+        assert np.isclose(h[k], m[k], rtol=1e-9), k
+
+
+def test_daggregate_min_max_vector_multi_key(mesh8):
+    rng = np.random.default_rng(12)
+    k1 = rng.integers(0, 4, 50)
+    k2 = rng.integers(0, 3, 50)
+    v = rng.normal(size=(50, 2))
+    df = tft.frame({"k1": k1, "k2": k2, "v": v})
+    dist = par.distribute(df, mesh8)
+    out = par.daggregate({"v": "max"}, dist, ["k1", "k2"])
+    rows = out.collect()
+    for r in rows:
+        sel = (k1 == r["k1"]) & (k2 == r["k2"])
+        np.testing.assert_allclose(r["v"], v[sel].max(axis=0), rtol=1e-6)
+
+
+def test_daggregate_pad_rows_excluded(mesh8):
+    # 10 rows pad to 16 on an 8-shard mesh; pad rows must not contribute
+    df = tft.frame({"key": np.zeros(10, np.int64),
+                    "x": np.ones(10)})
+    dist = par.distribute(df, mesh8)
+    assert dist.padded_rows == 16
+    out = par.daggregate({"x": "sum"}, dist, "key")
+    rows = out.collect()
+    assert len(rows) == 1 and rows[0]["x"] == 10.0
+
+
+def test_daggregate_validation(mesh8):
+    from tensorframes_tpu.engine.ops import InputNotFoundError
+    df = tft.frame({"key": np.zeros(4, np.int64), "x": np.arange(4.0),
+                    "extra": np.arange(4.0)})
+    dist = par.distribute(df, mesh8)
+    with pytest.raises(InputNotFoundError, match="not consumed"):
+        par.daggregate({"x": "sum"}, dist, "key")
