@@ -1,0 +1,17 @@
+// Minimal stand-in for mlir/IR/BuiltinOps.h: the real LLVM/MLIR headers are
+// not shipped in this environment. PJRT headers use mlir::ModuleOp only as a
+// by-value parameter of virtual-method overloads this project never calls;
+// an opaque single-pointer class keeps declarations (and mangled names)
+// identical without the LLVM header tree.
+#ifndef MLIR_IR_BUILTINOPS_STUB_H_
+#define MLIR_IR_BUILTINOPS_STUB_H_
+namespace mlir {
+class Operation;
+class ModuleOp {
+ public:
+  ModuleOp() = default;
+ private:
+  Operation* state_ = nullptr;
+};
+}  // namespace mlir
+#endif

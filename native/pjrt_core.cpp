@@ -1,0 +1,690 @@
+// Native PJRT execution core (see tfrpjrt.h for the interface contract).
+//
+// The reference executes every graph in C++ through libtensorflow sessions
+// (TensorFlowOps.scala:46-64, DebugRowOps.scala:776-788); this is the
+// TPU-native equivalent: serialized StableHLO in, XLA compile + execute in
+// C++, results written straight into caller-owned host memory.
+//
+//   backend "cpu"           — XLA:CPU via the PJRT C++ API, linked from
+//                             libtensorflow_cc (local tests; same compiler
+//                             stack XLA uses everywhere);
+//   backend "plugin:<path>" — any PJRT C API plugin via dlopen, e.g.
+//                             /...//libtpu.so on TPU hosts. Pure C ABI.
+//
+// LLVM/MLIR headers are not shipped in this environment, so mlir-typed
+// PJRT entry points are declared through a one-pointer stub (mlir_stub/)
+// and the module parse goes through the exported
+// ParseMlirModuleStringAndConvertToXlaComputation symbol instead of
+// mlir_to_hlo.h. NDEBUG is required: tsl AsyncValue type-ids are assigned
+// per-DSO, so its DCHECK-only accessor checks cannot pass across the
+// library boundary (the data accesses themselves are layout-stable).
+
+#include "tfrpjrt.h"
+
+#include <dlfcn.h>
+
+#include <cstring>
+#include <memory>
+#include <string>
+#include <string_view>
+#include <vector>
+
+#include "xla/pjrt/pjrt_client.h"
+#include "xla/pjrt/pjrt_executable.h"
+#include "xla/pjrt/plugin/xla_cpu/xla_cpu_pjrt_client.h"
+#include "xla/hlo/builder/xla_computation.h"
+#include "xla/pjrt/c/pjrt_c_api.h"
+
+namespace xla {
+// Declared here to avoid mlir_to_hlo.h's LLVM header dependency; resolved
+// against the exported symbol in libtensorflow_cc.
+absl::Status ParseMlirModuleStringAndConvertToXlaComputation(
+    std::string_view mlir_module_str, XlaComputation& xla_computation,
+    bool use_tuple_args, bool return_tuple);
+}  // namespace xla
+
+namespace {
+
+void set_err(char* err, int errlen, const std::string& msg) {
+  if (err && errlen > 0) {
+    std::snprintf(err, static_cast<size_t>(errlen), "%s", msg.c_str());
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Backend interface
+// ---------------------------------------------------------------------------
+
+struct ResultsIface {
+  virtual ~ResultsIface() = default;
+  virtual int count() const = 0;
+  virtual int meta(int i, int* dtype, int* ndim, long long* dims) const = 0;
+  virtual int read(int i, void* dst, long long nbytes, std::string* err) = 0;
+};
+
+struct ExeIface {
+  virtual ~ExeIface() = default;
+};
+
+struct ClientIface {
+  virtual ~ClientIface() = default;
+  virtual int device_count() const = 0;
+  virtual std::string platform() const = 0;
+  virtual ExeIface* compile(std::string_view module, std::string* err) = 0;
+  virtual ResultsIface* execute(ExeIface* exe, int nargs, const int* dtypes,
+                                const int* ndims, const long long* dims,
+                                const void* const* data,
+                                std::string* err) = 0;
+};
+
+long long dense_elems(int ndim, const long long* dims) {
+  long long n = 1;
+  for (int i = 0; i < ndim; ++i) n *= dims[i];
+  return n;
+}
+
+int dtype_size(int dt) {
+  switch (dt) {
+    case TFR_F32: case TFR_I32: return 4;
+    case TFR_F64: case TFR_I64: return 8;
+    case TFR_BF16: return 2;
+    case TFR_PRED: return 1;
+  }
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// C++-API backend (XLA:CPU from libtensorflow_cc)
+// ---------------------------------------------------------------------------
+
+xla::PrimitiveType to_xla_type(int dt) {
+  switch (dt) {
+    case TFR_F32: return xla::PrimitiveType::F32;
+    case TFR_F64: return xla::PrimitiveType::F64;
+    case TFR_I32: return xla::PrimitiveType::S32;
+    case TFR_I64: return xla::PrimitiveType::S64;
+    case TFR_BF16: return xla::PrimitiveType::BF16;
+    case TFR_PRED: return xla::PrimitiveType::PRED;
+  }
+  return xla::PrimitiveType::PRIMITIVE_TYPE_INVALID;
+}
+
+int from_xla_type(xla::PrimitiveType t) {
+  switch (t) {
+    case xla::PrimitiveType::F32: return TFR_F32;
+    case xla::PrimitiveType::F64: return TFR_F64;
+    case xla::PrimitiveType::S32: return TFR_I32;
+    case xla::PrimitiveType::S64: return TFR_I64;
+    case xla::PrimitiveType::BF16: return TFR_BF16;
+    case xla::PrimitiveType::PRED: return TFR_PRED;
+    default: return 0;
+  }
+}
+
+struct CppExe : ExeIface {
+  std::unique_ptr<xla::PjRtLoadedExecutable> exe;
+};
+
+struct CppResults : ResultsIface {
+  std::vector<std::unique_ptr<xla::PjRtBuffer>> bufs;
+
+  int count() const override { return static_cast<int>(bufs.size()); }
+
+  int meta(int i, int* dtype, int* ndim, long long* dims) const override {
+    if (i < 0 || i >= count()) return 1;
+    const auto& b = bufs[i];
+    *dtype = from_xla_type(b->element_type());
+    auto d = b->dimensions();
+    if (d.size() > 8) return 2;
+    *ndim = static_cast<int>(d.size());
+    for (size_t k = 0; k < d.size(); ++k) dims[k] = d[k];
+    return 0;
+  }
+
+  int read(int i, void* dst, long long nbytes, std::string* err) override {
+    if (i < 0 || i >= count()) { *err = "result index out of range"; return 1; }
+    auto& b = bufs[i];
+    auto sz = b->GetOnDeviceSizeInBytes();
+    if (!sz.ok()) { *err = sz.status().ToString(); return 1; }
+    if (static_cast<long long>(*sz) != nbytes) {
+      *err = "size mismatch: device has " + std::to_string(*sz) +
+             " bytes, caller expects " + std::to_string(nbytes) +
+             " (non-dense layout?)";
+      return 1;
+    }
+    auto st = b->CopyRawToHost(dst, 0, *sz).Await();
+    if (!st.ok()) { *err = st.ToString(); return 1; }
+    return 0;
+  }
+};
+
+struct CppClient : ClientIface {
+  std::unique_ptr<xla::PjRtClient> client;
+
+  int device_count() const override { return client->device_count(); }
+
+  std::string platform() const override {
+    return std::string(client->platform_name());
+  }
+
+  ExeIface* compile(std::string_view module, std::string* err) override {
+    xla::XlaComputation xc;
+    auto st = xla::ParseMlirModuleStringAndConvertToXlaComputation(
+        module, xc, /*use_tuple_args=*/false, /*return_tuple=*/false);
+    if (!st.ok()) { *err = st.ToString(); return nullptr; }
+    xla::CompileOptions opts;
+    auto exe_or = client->CompileAndLoad(xc, opts);
+    if (!exe_or.ok()) { *err = exe_or.status().ToString(); return nullptr; }
+    auto* e = new CppExe();
+    e->exe = std::move(exe_or).value();
+    return e;
+  }
+
+  ResultsIface* execute(ExeIface* exe_i, int nargs, const int* dtypes,
+                        const int* ndims, const long long* dims,
+                        const void* const* data, std::string* err) override {
+    auto* exe = static_cast<CppExe*>(exe_i);
+    auto* device = client->addressable_devices()[0];
+    auto ms_or = device->default_memory_space();
+    if (!ms_or.ok()) { *err = ms_or.status().ToString(); return nullptr; }
+
+    std::vector<std::unique_ptr<xla::PjRtBuffer>> in_bufs;
+    std::vector<xla::PjRtBuffer*> in_ptrs;
+    const long long* d = dims;
+    for (int a = 0; a < nargs; ++a) {
+      std::vector<int64_t> shape(d, d + ndims[a]);
+      d += ndims[a];
+      auto buf_or = client->BufferFromHostBuffer(
+          data[a], to_xla_type(dtypes[a]), shape, std::nullopt,
+          xla::PjRtClient::HostBufferSemantics::kImmutableOnlyDuringCall,
+          nullptr, ms_or.value(), nullptr);
+      if (!buf_or.ok()) { *err = buf_or.status().ToString(); return nullptr; }
+      in_bufs.push_back(std::move(buf_or).value());
+      in_ptrs.push_back(in_bufs.back().get());
+    }
+    std::vector<std::vector<xla::PjRtBuffer*>> arg_lists = {in_ptrs};
+    auto out_or = exe->exe->Execute(absl::MakeSpan(arg_lists),
+                                    xla::ExecuteOptions());
+    if (!out_or.ok()) { *err = out_or.status().ToString(); return nullptr; }
+    auto* r = new CppResults();
+    r->bufs = std::move(out_or.value()[0]);
+    return r;
+  }
+};
+
+// ---------------------------------------------------------------------------
+// PJRT C API backend (dlopen'd plugin, e.g. libtpu.so)
+// ---------------------------------------------------------------------------
+
+std::string capi_err(const PJRT_Api* api, PJRT_Error* e) {
+  if (!e) return "";
+  PJRT_Error_Message_Args m;
+  std::memset(&m, 0, sizeof(m));
+  m.struct_size = PJRT_Error_Message_Args_STRUCT_SIZE;
+  m.error = e;
+  api->PJRT_Error_Message(&m);
+  std::string msg(m.message, m.message_size);
+  PJRT_Error_Destroy_Args dd;
+  std::memset(&dd, 0, sizeof(dd));
+  dd.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+  dd.error = e;
+  api->PJRT_Error_Destroy(&dd);
+  return msg;
+}
+
+// Awaits and destroys the event; returns error message or "".
+std::string capi_await(const PJRT_Api* api, PJRT_Event* ev) {
+  if (!ev) return "";
+  PJRT_Event_Await_Args aw;
+  std::memset(&aw, 0, sizeof(aw));
+  aw.struct_size = PJRT_Event_Await_Args_STRUCT_SIZE;
+  aw.event = ev;
+  std::string msg = capi_err(api, api->PJRT_Event_Await(&aw));
+  PJRT_Event_Destroy_Args dd;
+  std::memset(&dd, 0, sizeof(dd));
+  dd.struct_size = PJRT_Event_Destroy_Args_STRUCT_SIZE;
+  dd.event = ev;
+  api->PJRT_Event_Destroy(&dd);
+  return msg;
+}
+
+PJRT_Buffer_Type to_capi_type(int dt) {
+  switch (dt) {
+    case TFR_F32: return PJRT_Buffer_Type_F32;
+    case TFR_F64: return PJRT_Buffer_Type_F64;
+    case TFR_I32: return PJRT_Buffer_Type_S32;
+    case TFR_I64: return PJRT_Buffer_Type_S64;
+    case TFR_BF16: return PJRT_Buffer_Type_BF16;
+    case TFR_PRED: return PJRT_Buffer_Type_PRED;
+  }
+  return PJRT_Buffer_Type_INVALID;
+}
+
+int from_capi_type(PJRT_Buffer_Type t) {
+  switch (t) {
+    case PJRT_Buffer_Type_F32: return TFR_F32;
+    case PJRT_Buffer_Type_F64: return TFR_F64;
+    case PJRT_Buffer_Type_S32: return TFR_I32;
+    case PJRT_Buffer_Type_S64: return TFR_I64;
+    case PJRT_Buffer_Type_BF16: return TFR_BF16;
+    case PJRT_Buffer_Type_PRED: return TFR_PRED;
+    default: return 0;
+  }
+}
+
+// Minimal serialized xla.CompileOptionsProto:
+//   executable_build_options (field 3) {
+//     num_replicas (field 4) = 1; num_partitions (field 5) = 1; }
+const char kCompileOptionsProto[] = {0x1a, 0x04, 0x20, 0x01, 0x28, 0x01};
+
+struct CApiExe : ExeIface {
+  const PJRT_Api* api = nullptr;
+  PJRT_LoadedExecutable* exe = nullptr;
+  ~CApiExe() override {
+    if (exe) {
+      PJRT_LoadedExecutable_Destroy_Args dd;
+      std::memset(&dd, 0, sizeof(dd));
+      dd.struct_size = PJRT_LoadedExecutable_Destroy_Args_STRUCT_SIZE;
+      dd.executable = exe;
+      capi_err(api, api->PJRT_LoadedExecutable_Destroy(&dd));
+    }
+  }
+};
+
+struct CApiResults : ResultsIface {
+  const PJRT_Api* api = nullptr;
+  std::vector<PJRT_Buffer*> bufs;
+
+  ~CApiResults() override {
+    for (auto* b : bufs) {
+      PJRT_Buffer_Destroy_Args dd;
+      std::memset(&dd, 0, sizeof(dd));
+      dd.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
+      dd.buffer = b;
+      capi_err(api, api->PJRT_Buffer_Destroy(&dd));
+    }
+  }
+
+  int count() const override { return static_cast<int>(bufs.size()); }
+
+  int meta(int i, int* dtype, int* ndim, long long* dims) const override {
+    if (i < 0 || i >= count()) return 1;
+    PJRT_Buffer_ElementType_Args et;
+    std::memset(&et, 0, sizeof(et));
+    et.struct_size = PJRT_Buffer_ElementType_Args_STRUCT_SIZE;
+    et.buffer = bufs[i];
+    if (api->PJRT_Buffer_ElementType(&et)) return 2;
+    *dtype = from_capi_type(et.type);
+    PJRT_Buffer_Dimensions_Args dm;
+    std::memset(&dm, 0, sizeof(dm));
+    dm.struct_size = PJRT_Buffer_Dimensions_Args_STRUCT_SIZE;
+    dm.buffer = bufs[i];
+    if (api->PJRT_Buffer_Dimensions(&dm)) return 2;
+    if (dm.num_dims > 8) return 2;
+    *ndim = static_cast<int>(dm.num_dims);
+    for (size_t k = 0; k < dm.num_dims; ++k) dims[k] = dm.dims[k];
+    return 0;
+  }
+
+  int read(int i, void* dst, long long nbytes, std::string* err) override {
+    if (i < 0 || i >= count()) { *err = "result index out of range"; return 1; }
+    PJRT_Buffer_ToHostBuffer_Args th;
+    std::memset(&th, 0, sizeof(th));
+    th.struct_size = PJRT_Buffer_ToHostBuffer_Args_STRUCT_SIZE;
+    th.src = bufs[i];
+    th.dst = nullptr;  // query size
+    if (auto* e = api->PJRT_Buffer_ToHostBuffer(&th)) {
+      *err = capi_err(api, e);
+      return 1;
+    }
+    if (static_cast<long long>(th.dst_size) != nbytes) {
+      *err = "size mismatch: host needs " + std::to_string(th.dst_size) +
+             " bytes, caller expects " + std::to_string(nbytes);
+      return 1;
+    }
+    th.dst = dst;
+    if (auto* e = api->PJRT_Buffer_ToHostBuffer(&th)) {
+      *err = capi_err(api, e);
+      return 1;
+    }
+    std::string msg = capi_await(api, th.event);
+    if (!msg.empty()) { *err = msg; return 1; }
+    return 0;
+  }
+};
+
+struct CApiClient : ClientIface {
+  void* dl = nullptr;
+  const PJRT_Api* api = nullptr;
+  PJRT_Client* client = nullptr;
+
+  ~CApiClient() override {
+    if (client) {
+      PJRT_Client_Destroy_Args dd;
+      std::memset(&dd, 0, sizeof(dd));
+      dd.struct_size = PJRT_Client_Destroy_Args_STRUCT_SIZE;
+      dd.client = client;
+      capi_err(api, api->PJRT_Client_Destroy(&dd));
+    }
+    // The plugin stays loaded (dlclose of live XLA runtimes is unsafe).
+  }
+
+  std::string init(const std::string& path) {
+    dl = dlopen(path.c_str(), RTLD_NOW | RTLD_LOCAL);
+    if (!dl) return std::string("dlopen failed: ") + dlerror();
+    using GetApiFn = const PJRT_Api* (*)();
+    auto get_api = reinterpret_cast<GetApiFn>(dlsym(dl, "GetPjrtApi"));
+    if (!get_api) return "plugin has no GetPjrtApi symbol";
+    api = get_api();
+    if (!api) return "GetPjrtApi returned null";
+    PJRT_Plugin_Initialize_Args pi;
+    std::memset(&pi, 0, sizeof(pi));
+    pi.struct_size = PJRT_Plugin_Initialize_Args_STRUCT_SIZE;
+    if (auto* e = api->PJRT_Plugin_Initialize(&pi)) {
+      return "plugin init failed: " + capi_err(api, e);
+    }
+    PJRT_Client_Create_Args cc;
+    std::memset(&cc, 0, sizeof(cc));
+    cc.struct_size = PJRT_Client_Create_Args_STRUCT_SIZE;
+    if (auto* e = api->PJRT_Client_Create(&cc)) {
+      return "client create failed: " + capi_err(api, e);
+    }
+    client = cc.client;
+    return "";
+  }
+
+  int device_count() const override {
+    PJRT_Client_AddressableDevices_Args ad;
+    std::memset(&ad, 0, sizeof(ad));
+    ad.struct_size = PJRT_Client_AddressableDevices_Args_STRUCT_SIZE;
+    ad.client = client;
+    if (api->PJRT_Client_AddressableDevices(&ad)) return -1;
+    return static_cast<int>(ad.num_addressable_devices);
+  }
+
+  std::string platform() const override {
+    PJRT_Client_PlatformName_Args pn;
+    std::memset(&pn, 0, sizeof(pn));
+    pn.struct_size = PJRT_Client_PlatformName_Args_STRUCT_SIZE;
+    pn.client = client;
+    if (api->PJRT_Client_PlatformName(&pn)) return "?";
+    return std::string(pn.platform_name, pn.platform_name_size);
+  }
+
+  ExeIface* compile(std::string_view module, std::string* err) override {
+    PJRT_Program prog;
+    std::memset(&prog, 0, sizeof(prog));
+    prog.struct_size = PJRT_Program_STRUCT_SIZE;
+    prog.code = const_cast<char*>(module.data());
+    prog.code_size = module.size();
+    static const char kFormat[] = "mlir";
+    prog.format = kFormat;
+    prog.format_size = sizeof(kFormat) - 1;
+
+    PJRT_Client_Compile_Args ca;
+    std::memset(&ca, 0, sizeof(ca));
+    ca.struct_size = PJRT_Client_Compile_Args_STRUCT_SIZE;
+    ca.client = client;
+    ca.program = &prog;
+    ca.compile_options = kCompileOptionsProto;
+    ca.compile_options_size = sizeof(kCompileOptionsProto);
+    if (auto* e = api->PJRT_Client_Compile(&ca)) {
+      *err = capi_err(api, e);
+      return nullptr;
+    }
+    auto* ex = new CApiExe();
+    ex->api = api;
+    ex->exe = ca.executable;
+    return ex;
+  }
+
+  ResultsIface* execute(ExeIface* exe_i, int nargs, const int* dtypes,
+                        const int* ndims, const long long* dims,
+                        const void* const* data, std::string* err) override {
+    auto* exe = static_cast<CApiExe*>(exe_i);
+
+    PJRT_Client_AddressableDevices_Args ad;
+    std::memset(&ad, 0, sizeof(ad));
+    ad.struct_size = PJRT_Client_AddressableDevices_Args_STRUCT_SIZE;
+    ad.client = client;
+    if (auto* e = api->PJRT_Client_AddressableDevices(&ad)) {
+      *err = capi_err(api, e);
+      return nullptr;
+    }
+    if (ad.num_addressable_devices == 0) {
+      *err = "no addressable devices";
+      return nullptr;
+    }
+    PJRT_Device* device = ad.addressable_devices[0];
+
+    std::vector<PJRT_Buffer*> in_bufs;
+    auto destroy_inputs = [&]() {
+      for (auto* b : in_bufs) {
+        PJRT_Buffer_Destroy_Args dd;
+        std::memset(&dd, 0, sizeof(dd));
+        dd.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
+        dd.buffer = b;
+        capi_err(api, api->PJRT_Buffer_Destroy(&dd));
+      }
+    };
+    const long long* d = dims;
+    for (int a = 0; a < nargs; ++a) {
+      std::vector<int64_t> shape(d, d + ndims[a]);
+      d += ndims[a];
+      PJRT_Client_BufferFromHostBuffer_Args bh;
+      std::memset(&bh, 0, sizeof(bh));
+      bh.struct_size = PJRT_Client_BufferFromHostBuffer_Args_STRUCT_SIZE;
+      bh.client = client;
+      bh.data = data[a];
+      bh.type = to_capi_type(dtypes[a]);
+      bh.dims = shape.data();
+      bh.num_dims = shape.size();
+      bh.host_buffer_semantics =
+          PJRT_HostBufferSemantics_kImmutableOnlyDuringCall;
+      bh.device = device;
+      if (auto* e = api->PJRT_Client_BufferFromHostBuffer(&bh)) {
+        *err = capi_err(api, e);
+        destroy_inputs();
+        return nullptr;
+      }
+      std::string msg = capi_await(api, bh.done_with_host_buffer);
+      in_bufs.push_back(bh.buffer);
+      if (!msg.empty()) {
+        *err = msg;
+        destroy_inputs();
+        return nullptr;
+      }
+    }
+
+    // number of outputs
+    PJRT_LoadedExecutable_GetExecutable_Args ge;
+    std::memset(&ge, 0, sizeof(ge));
+    ge.struct_size = PJRT_LoadedExecutable_GetExecutable_Args_STRUCT_SIZE;
+    ge.loaded_executable = exe->exe;
+    if (auto* e = api->PJRT_LoadedExecutable_GetExecutable(&ge)) {
+      *err = capi_err(api, e);
+      destroy_inputs();
+      return nullptr;
+    }
+    PJRT_Executable_NumOutputs_Args no;
+    std::memset(&no, 0, sizeof(no));
+    no.struct_size = PJRT_Executable_NumOutputs_Args_STRUCT_SIZE;
+    no.executable = ge.executable;
+    if (auto* e = api->PJRT_Executable_NumOutputs(&no)) {
+      *err = capi_err(api, e);
+      destroy_inputs();
+      return nullptr;
+    }
+
+    PJRT_ExecuteOptions opts;
+    std::memset(&opts, 0, sizeof(opts));
+    opts.struct_size = PJRT_ExecuteOptions_STRUCT_SIZE;
+
+    std::vector<PJRT_Buffer*> outs(no.num_outputs, nullptr);
+    PJRT_Buffer* const* arg_list = in_bufs.data();
+    PJRT_Buffer** out_list = outs.data();
+    PJRT_Event* done = nullptr;
+
+    PJRT_LoadedExecutable_Execute_Args ex;
+    std::memset(&ex, 0, sizeof(ex));
+    ex.struct_size = PJRT_LoadedExecutable_Execute_Args_STRUCT_SIZE;
+    ex.executable = exe->exe;
+    ex.options = &opts;
+    ex.argument_lists = &arg_list;
+    ex.num_devices = 1;
+    ex.num_args = static_cast<size_t>(nargs);
+    ex.output_lists = &out_list;
+    ex.device_complete_events = &done;
+    ex.execute_device = device;
+    if (auto* e = api->PJRT_LoadedExecutable_Execute(&ex)) {
+      *err = capi_err(api, e);
+      destroy_inputs();
+      return nullptr;
+    }
+    std::string msg = capi_await(api, done);
+    destroy_inputs();
+    auto* r = new CApiResults();
+    r->api = api;
+    r->bufs = std::move(outs);
+    if (!msg.empty()) {
+      *err = msg;
+      delete r;  // destroys any produced output buffers
+      return nullptr;
+    }
+    return r;
+  }
+};
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// C interface
+// ---------------------------------------------------------------------------
+
+struct tfr_pjrt_client {
+  std::unique_ptr<ClientIface> impl;
+};
+struct tfr_pjrt_exe {
+  std::unique_ptr<ExeIface> impl;
+};
+struct tfr_pjrt_results {
+  std::unique_ptr<ResultsIface> impl;
+};
+
+extern "C" {
+
+tfr_pjrt_client* tfr_pjrt_client_create(const char* spec, char* err,
+                                        int errlen) {
+  std::string s(spec ? spec : "");
+  try {
+    if (s == "cpu" || s.rfind("cpu:", 0) == 0) {
+      xla::CpuClientOptions opts;
+      opts.cpu_device_count = 1;
+      if (s.size() > 4) opts.cpu_device_count = std::stoi(s.substr(4));
+      auto c_or = xla::GetXlaPjrtCpuClient(opts);
+      if (!c_or.ok()) {
+        set_err(err, errlen, c_or.status().ToString());
+        return nullptr;
+      }
+      auto* c = new CppClient();
+      c->client = std::move(c_or).value();
+      auto* out = new tfr_pjrt_client();
+      out->impl.reset(c);
+      return out;
+    }
+    if (s.rfind("plugin:", 0) == 0) {
+      auto* c = new CApiClient();
+      std::string msg = c->init(s.substr(7));
+      if (!msg.empty()) {
+        set_err(err, errlen, msg);
+        delete c;
+        return nullptr;
+      }
+      auto* out = new tfr_pjrt_client();
+      out->impl.reset(c);
+      return out;
+    }
+  } catch (const std::exception& e) {
+    set_err(err, errlen, e.what());
+    return nullptr;
+  }
+  set_err(err, errlen, "unknown backend spec: " + s +
+                       " (expected cpu[:n] or plugin:<path>)");
+  return nullptr;
+}
+
+void tfr_pjrt_client_destroy(tfr_pjrt_client* c) { delete c; }
+
+int tfr_pjrt_client_device_count(tfr_pjrt_client* c) {
+  return c->impl->device_count();
+}
+
+int tfr_pjrt_client_platform(tfr_pjrt_client* c, char* out, int outlen) {
+  std::string p = c->impl->platform();
+  int n = static_cast<int>(p.size());
+  if (out && outlen > 0) {
+    std::snprintf(out, static_cast<size_t>(outlen), "%s", p.c_str());
+  }
+  return n;
+}
+
+tfr_pjrt_exe* tfr_pjrt_compile(tfr_pjrt_client* c, const char* module_bytes,
+                               long module_len, char* err, int errlen) {
+  std::string errmsg;
+  ExeIface* e = c->impl->compile(
+      std::string_view(module_bytes, static_cast<size_t>(module_len)),
+      &errmsg);
+  if (!e) {
+    set_err(err, errlen, errmsg);
+    return nullptr;
+  }
+  auto* out = new tfr_pjrt_exe();
+  out->impl.reset(e);
+  return out;
+}
+
+void tfr_pjrt_exe_destroy(tfr_pjrt_exe* e) { delete e; }
+
+tfr_pjrt_results* tfr_pjrt_execute(tfr_pjrt_client* c, tfr_pjrt_exe* e,
+                                   int nargs, const int* dtypes,
+                                   const int* ndims, const long long* dims,
+                                   const void* const* data, char* err,
+                                   int errlen) {
+  for (int a = 0; a < nargs; ++a) {
+    if (dtype_size(dtypes[a]) == 0) {
+      set_err(err, errlen,
+              "unsupported dtype code " + std::to_string(dtypes[a]));
+      return nullptr;
+    }
+  }
+  std::string errmsg;
+  ResultsIface* r =
+      c->impl->execute(e->impl.get(), nargs, dtypes, ndims, dims, data,
+                       &errmsg);
+  if (!r) {
+    set_err(err, errlen, errmsg);
+    return nullptr;
+  }
+  auto* out = new tfr_pjrt_results();
+  out->impl.reset(r);
+  return out;
+}
+
+int tfr_pjrt_results_count(tfr_pjrt_results* r) { return r->impl->count(); }
+
+int tfr_pjrt_result_meta(tfr_pjrt_results* r, int i, int* dtype, int* ndim,
+                         long long* dims) {
+  return r->impl->meta(i, dtype, ndim, dims);
+}
+
+int tfr_pjrt_result_read(tfr_pjrt_results* r, int i, void* dst,
+                         long long nbytes, char* err, int errlen) {
+  std::string errmsg;
+  int rc = r->impl->read(i, dst, nbytes, &errmsg);
+  if (rc) set_err(err, errlen, errmsg);
+  return rc;
+}
+
+void tfr_pjrt_results_destroy(tfr_pjrt_results* r) { delete r; }
+
+}  // extern "C"

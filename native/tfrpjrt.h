@@ -1,0 +1,79 @@
+/* tfrpjrt: C interface of the native PJRT execution core.
+ *
+ * The TPU-native analogue of the reference's libtensorflow C++ session
+ * layer (TensorFlowOps.scala:46-64 readGraph/withSession + session.Run):
+ * a serialized StableHLO computation is loaded, compiled and executed
+ * entirely in C++, with host buffers exposed to the caller for zero-copy
+ * reads (results are written straight into caller-provided memory).
+ *
+ * Two backends behind one interface:
+ *   - "cpu" / "cpu:<n>"  — XLA:CPU hosted in-process via the PJRT C++ API
+ *     (linked from libtensorflow_cc; the local-test backend);
+ *   - "plugin:<path>"    — any PJRT C API plugin loaded with dlopen;
+ *     on TPU hosts, libtpu.so (the production backend).
+ *
+ * All functions are thread-compatible; a client may be shared across
+ * threads (PJRT clients are thread-safe; no tfLock analogue is needed,
+ * unlike the reference's global lock, DebugRowOps.scala:718-719).
+ */
+#ifndef TFRPJRT_H_
+#define TFRPJRT_H_
+
+#include <stddef.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct tfr_pjrt_client tfr_pjrt_client;
+typedef struct tfr_pjrt_exe tfr_pjrt_exe;
+typedef struct tfr_pjrt_results tfr_pjrt_results;
+
+/* dtype codes (stable across backends; mapped internally) */
+enum tfr_dtype {
+  TFR_F32 = 1,
+  TFR_F64 = 2,
+  TFR_I32 = 3,
+  TFR_I64 = 4,
+  TFR_BF16 = 5,
+  TFR_PRED = 6,
+};
+
+/* Create a client. spec: "cpu", "cpu:<ndevices>", or "plugin:<path.so>".
+ * Returns NULL on failure with a message in err. */
+tfr_pjrt_client* tfr_pjrt_client_create(const char* spec, char* err,
+                                        int errlen);
+void tfr_pjrt_client_destroy(tfr_pjrt_client* c);
+int tfr_pjrt_client_device_count(tfr_pjrt_client* c);
+/* Writes the platform name into out; returns its length. */
+int tfr_pjrt_client_platform(tfr_pjrt_client* c, char* out, int outlen);
+
+/* Compile a StableHLO module (text or MLIR bytecode). */
+tfr_pjrt_exe* tfr_pjrt_compile(tfr_pjrt_client* c, const char* module_bytes,
+                               long module_len, char* err, int errlen);
+void tfr_pjrt_exe_destroy(tfr_pjrt_exe* e);
+
+/* Execute on device 0. Inputs are dense row-major host buffers.
+ * dims is one flat array; ndims[i] gives each argument's rank and the
+ * dims of argument i follow those of i-1. Returns NULL on failure. */
+tfr_pjrt_results* tfr_pjrt_execute(tfr_pjrt_client* c, tfr_pjrt_exe* e,
+                                   int nargs, const int* dtypes,
+                                   const int* ndims, const long long* dims,
+                                   const void* const* data, char* err,
+                                   int errlen);
+
+int tfr_pjrt_results_count(tfr_pjrt_results* r);
+/* dims must have room for 8 entries; returns 0 on success. */
+int tfr_pjrt_result_meta(tfr_pjrt_results* r, int i, int* dtype, int* ndim,
+                         long long* dims);
+/* Copy result i (dense row-major) into dst; nbytes must match exactly.
+ * Returns 0 on success. */
+int tfr_pjrt_result_read(tfr_pjrt_results* r, int i, void* dst,
+                         long long nbytes, char* err, int errlen);
+void tfr_pjrt_results_destroy(tfr_pjrt_results* r);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* TFRPJRT_H_ */

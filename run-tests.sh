@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# Test entry point (the reference's python/run-tests.sh analogue):
+# builds the native runtime from source FIRST — a broken native build fails
+# the run loudly instead of silently exercising only the numpy fallbacks —
+# then runs the suite on the CPU backend with 8 virtual devices.
+set -euo pipefail
+cd "$(dirname "$0")"
+
+echo "== building native runtime (libtfruntime.so) =="
+make -C native
+
+if python -c "import tensorflow" >/dev/null 2>&1; then
+  echo "== building native PJRT core (libtfrpjrt.so) =="
+  make -C native pjrt
+else
+  echo "== tensorflow C++ libs not present; skipping libtfrpjrt.so =="
+fi
+
+echo "== running test suite =="
+exec python -m pytest tests/ -q "$@"

@@ -149,12 +149,29 @@ _default_lock = threading.Lock()
 
 def default_executor() -> BlockExecutor:
     """Exact-shape executor: block-level computations may be cross-row
-    (e.g. ``z = x - mean(x)``), so padding would corrupt them."""
+    (e.g. ``z = x - mean(x)``), so padding would corrupt them.
+
+    ``TFT_EXECUTOR=pjrt`` routes the process default through the native
+    C++ PJRT core (``native_pjrt.PjrtBlockExecutor``) with the jax
+    in-process path as fallback if the native library is unavailable.
+    """
     global _default
     if _default is None:
         with _default_lock:
             if _default is None:
-                _default = BlockExecutor()
+                import os
+                if os.environ.get("TFT_EXECUTOR") == "pjrt":
+                    try:
+                        from ..native_pjrt import PjrtBlockExecutor
+                        _default = PjrtBlockExecutor()
+                    except Exception as e:  # fall back to the jax path
+                        _log.warning(
+                            "TFT_EXECUTOR=pjrt requested but the native "
+                            "core is unavailable (%s); using the jax "
+                            "executor", e)
+                        _default = BlockExecutor()
+                else:
+                    _default = BlockExecutor()
     return _default
 
 

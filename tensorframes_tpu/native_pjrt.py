@@ -1,0 +1,350 @@
+"""ctypes binding to the C++ PJRT execution core (``native/libtfrpjrt.so``).
+
+The reference bottoms out every graph execution in C++ — a libtensorflow
+``Session.Run`` reached through JNI (``TensorFlowOps.scala:46-64``,
+``DebugRowOps.scala:776-788``). This is the TPU-native equivalent: the
+driver (Python) authors and lowers a computation to StableHLO, and the
+native core compiles + executes it against XLA **in C++** — XLA:CPU linked
+in-process for local runs, or any PJRT C API plugin (``libtpu.so``) on TPU
+hosts. Results are written straight into caller-allocated numpy arrays
+(the ``tensor_data().asBuffer()`` zero-copy read analogue,
+``DataOps.scala:373``).
+
+Routing: :class:`PjrtBlockExecutor` drops into the engine anywhere a
+:class:`~tensorframes_tpu.engine.executor.BlockExecutor` is accepted, or
+set ``TFT_EXECUTOR=pjrt`` to make it the process default. The jax
+in-process path remains the default and the fallback.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from typing import Dict, Mapping, Optional, Tuple
+
+import numpy as np
+
+from . import dtypes as _dt
+from .computation import Computation
+from .utils.logging import get_logger
+
+__all__ = ["available", "PjrtCoreClient", "PjrtBlockExecutor"]
+
+_log = get_logger("native_pjrt")
+
+# tfr_dtype codes from native/tfrpjrt.h
+_CODES = {
+    np.dtype(np.float32): 1,
+    np.dtype(np.float64): 2,
+    np.dtype(np.int32): 3,
+    np.dtype(np.int64): 4,
+    np.dtype(np.bool_): 6,
+}
+_NP_FROM_CODE = {1: np.dtype(np.float32), 2: np.dtype(np.float64),
+                 3: np.dtype(np.int32), 4: np.dtype(np.int64),
+                 6: np.dtype(np.bool_)}
+_BF16_CODE = 5
+
+_lib: Optional[ctypes.CDLL] = None
+_load_attempted = False
+_ERRLEN = 4096
+
+
+def _find_library() -> Optional[str]:
+    cand = os.environ.get("TFT_PJRT_LIB")
+    if cand and os.path.exists(cand):
+        return cand
+    here = os.path.dirname(os.path.abspath(__file__))
+    for rel in (os.path.join(here, "..", "native", "libtfrpjrt.so"),
+                os.path.join(here, "libtfrpjrt.so")):
+        p = os.path.abspath(rel)
+        if os.path.exists(p):
+            return p
+    return None
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _load_attempted
+    if _load_attempted:
+        return _lib
+    _load_attempted = True
+    if os.environ.get("TFT_DISABLE_NATIVE"):
+        return None
+    path = _find_library()
+    if path is None:
+        return None
+    try:
+        lib = ctypes.CDLL(path)
+    except OSError as e:
+        _log.warning("libtfrpjrt.so failed to load: %s", e)
+        return None
+    vp = ctypes.c_void_p
+    ci = ctypes.c_int
+    cll = ctypes.c_longlong
+    lib.tfr_pjrt_client_create.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
+                                           ci]
+    lib.tfr_pjrt_client_create.restype = vp
+    lib.tfr_pjrt_client_destroy.argtypes = [vp]
+    lib.tfr_pjrt_client_device_count.argtypes = [vp]
+    lib.tfr_pjrt_client_device_count.restype = ci
+    lib.tfr_pjrt_client_platform.argtypes = [vp, ctypes.c_char_p, ci]
+    lib.tfr_pjrt_client_platform.restype = ci
+    lib.tfr_pjrt_compile.argtypes = [vp, ctypes.c_char_p, ctypes.c_long,
+                                     ctypes.c_char_p, ci]
+    lib.tfr_pjrt_compile.restype = vp
+    lib.tfr_pjrt_exe_destroy.argtypes = [vp]
+    lib.tfr_pjrt_execute.argtypes = [vp, vp, ci, ctypes.POINTER(ci),
+                                     ctypes.POINTER(ci),
+                                     ctypes.POINTER(cll),
+                                     ctypes.POINTER(vp), ctypes.c_char_p, ci]
+    lib.tfr_pjrt_execute.restype = vp
+    lib.tfr_pjrt_results_count.argtypes = [vp]
+    lib.tfr_pjrt_results_count.restype = ci
+    lib.tfr_pjrt_result_meta.argtypes = [vp, ci, ctypes.POINTER(ci),
+                                         ctypes.POINTER(ci),
+                                         ctypes.POINTER(cll)]
+    lib.tfr_pjrt_result_meta.restype = ci
+    lib.tfr_pjrt_result_read.argtypes = [vp, ci, vp, cll, ctypes.c_char_p,
+                                         ci]
+    lib.tfr_pjrt_result_read.restype = ci
+    lib.tfr_pjrt_results_destroy.argtypes = [vp]
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+class PjrtCoreError(RuntimeError):
+    pass
+
+
+class PjrtCoreClient:
+    """A native PJRT client: the per-host analogue of the reference's
+    per-executor TF C++ session factory (``TensorFlowOps.withSession``).
+
+    ``backend``: ``"cpu"``/``"cpu:<n>"`` for in-process XLA:CPU, or
+    ``"plugin:<path.so>"`` for a PJRT C API plugin (TPU: libtpu.so).
+    """
+
+    def __init__(self, backend: str = "cpu"):
+        lib = _load()
+        if lib is None:
+            raise PjrtCoreError(
+                "libtfrpjrt.so is not available; build it with "
+                "`make -C native pjrt`")
+        self._lib = lib
+        err = ctypes.create_string_buffer(_ERRLEN)
+        self._client = lib.tfr_pjrt_client_create(
+            backend.encode(), err, _ERRLEN)
+        if not self._client:
+            raise PjrtCoreError(
+                f"client create failed: {err.value.decode(errors='replace')}")
+        self.backend = backend
+
+    @property
+    def device_count(self) -> int:
+        return self._lib.tfr_pjrt_client_device_count(self._client)
+
+    @property
+    def platform(self) -> str:
+        buf = ctypes.create_string_buffer(256)
+        self._lib.tfr_pjrt_client_platform(self._client, buf, 256)
+        return buf.value.decode()
+
+    def compile(self, stablehlo: bytes) -> "PjrtExecutable":
+        err = ctypes.create_string_buffer(_ERRLEN)
+        h = self._lib.tfr_pjrt_compile(self._client, stablehlo,
+                                       len(stablehlo), err, _ERRLEN)
+        if not h:
+            raise PjrtCoreError(
+                f"compile failed: {err.value.decode(errors='replace')}")
+        return PjrtExecutable(self, h)
+
+    def close(self):
+        if self._client:
+            self._lib.tfr_pjrt_client_destroy(self._client)
+            self._client = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class PjrtExecutable:
+    """A compiled program held by the native core."""
+
+    def __init__(self, client: PjrtCoreClient, handle):
+        self._client = client
+        self._h = handle
+
+    def execute(self, arrays) -> list:
+        """Run on dense row-major host arrays; returns numpy arrays."""
+        lib = self._client._lib
+        n = len(arrays)
+        arrays = [np.ascontiguousarray(a) for a in arrays]
+        dtypes = (ctypes.c_int * n)()
+        ndims = (ctypes.c_int * n)()
+        flat_dims = []
+        datas = (ctypes.c_void_p * n)()
+        for i, a in enumerate(arrays):
+            code = _CODES.get(a.dtype)
+            if code is None:
+                if a.dtype == _dt.bfloat16.np_storage:
+                    code = _BF16_CODE
+                else:
+                    raise PjrtCoreError(f"unsupported input dtype {a.dtype}")
+            dtypes[i] = code
+            ndims[i] = a.ndim
+            flat_dims.extend(a.shape)
+            datas[i] = a.ctypes.data_as(ctypes.c_void_p)
+        dims = (ctypes.c_longlong * max(1, len(flat_dims)))(*flat_dims)
+        err = ctypes.create_string_buffer(_ERRLEN)
+        res = lib.tfr_pjrt_execute(self._client._client, self._h, n, dtypes,
+                                   ndims, dims, datas, err, _ERRLEN)
+        if not res:
+            raise PjrtCoreError(
+                f"execute failed: {err.value.decode(errors='replace')}")
+        try:
+            outs = []
+            for i in range(lib.tfr_pjrt_results_count(res)):
+                dt = ctypes.c_int()
+                nd = ctypes.c_int()
+                odims = (ctypes.c_longlong * 8)()
+                if lib.tfr_pjrt_result_meta(res, i, ctypes.byref(dt),
+                                            ctypes.byref(nd), odims):
+                    raise PjrtCoreError(f"result {i}: meta query failed")
+                shape = tuple(odims[k] for k in range(nd.value))
+                np_dt = (_dt.bfloat16.np_storage if dt.value == _BF16_CODE
+                         else _NP_FROM_CODE.get(dt.value))
+                if np_dt is None:
+                    raise PjrtCoreError(
+                        f"result {i}: unsupported dtype code {dt.value}")
+                out = np.empty(shape, np_dt)
+                if lib.tfr_pjrt_result_read(
+                        res, i, out.ctypes.data_as(ctypes.c_void_p),
+                        out.nbytes, err, _ERRLEN):
+                    raise PjrtCoreError(
+                        f"result {i}: {err.value.decode(errors='replace')}")
+                outs.append(out)
+            return outs
+        finally:
+            lib.tfr_pjrt_results_destroy(res)
+
+    def close(self):
+        if self._h:
+            self._client._lib.tfr_pjrt_exe_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# Engine integration
+# ---------------------------------------------------------------------------
+
+def _lower_stablehlo(comp: Computation, arrays: Mapping[str, np.ndarray],
+                     in_names, out_names) -> bytes:
+    """Lower the computation at these concrete shapes to StableHLO text.
+
+    The driver-side authoring step (the reference built a GraphDef with real
+    TF in Python, ``core.py:37-40``); jax is used for *tracing only* — the
+    compile and every execution happen in the native core.
+    """
+    import jax
+
+    def flat_fn(*args):
+        out = comp.fn(dict(zip(in_names, args)))
+        return tuple(out[n] for n in out_names)
+
+    avals = [jax.ShapeDtypeStruct(arrays[n].shape, arrays[n].dtype)
+             for n in in_names]
+    lowered = jax.jit(flat_fn).lower(*avals)
+    text = str(lowered.compiler_ir("stablehlo")).encode()
+    if b"?" not in text:
+        return text
+    # Deserialized (jax.export) computations carry symbolic inner dims; the
+    # main function is static here, so the StableHLO refinement pass makes
+    # the whole module static before it reaches the native compiler.
+    from jax._src.lib import _jax as _jaxlib
+
+    return _jaxlib.mlir.refine_polymorphic_shapes(
+        text, enable_shape_assertions=True, validate_static_shapes=True)
+
+
+class PjrtBlockExecutor:
+    """Block executor routing through the native PJRT core.
+
+    Drop-in for :class:`~tensorframes_tpu.engine.executor.BlockExecutor`
+    where an ``executor=`` argument is accepted: same ``run`` contract,
+    same per-signature compile cache, but compilation and execution happen
+    in C++ (per-executor sessions ↔ one native client per executor
+    object). No ``pad_rows`` mode: the native path compiles exact shapes.
+    """
+
+    def __init__(self, backend: Optional[str] = None):
+        import weakref
+
+        backend = backend or os.environ.get("TFT_PJRT_BACKEND", "cpu")
+        self.client = PjrtCoreClient(backend)
+        self.pad_rows = False
+        # weakly keyed by the live Computation (mirrors BlockExecutor):
+        # entries die with it, so id() recycling cannot alias programs
+        self._cache: "weakref.WeakKeyDictionary[Computation, Dict[Tuple, PjrtExecutable]]" = \
+            weakref.WeakKeyDictionary()
+        self._lock = threading.Lock()
+        self.compile_count = 0
+
+    def run(self, comp: Computation, arrays: Mapping[str, np.ndarray],
+            pad_ok: bool = True) -> Dict[str, np.ndarray]:
+        del pad_ok  # exact-shape compiles; padding never applies
+        in_names = [s.name for s in comp.inputs]
+        out_names = [s.name for s in comp.outputs]
+        dev_arrays = {}
+        for spec in comp.inputs:
+            a = np.ascontiguousarray(arrays[spec.name])
+            dd = _dt.device_dtype(spec.dtype)
+            if a.dtype != dd:
+                from . import native as _native
+                a = _native.convert(a, dd)
+            dev_arrays[spec.name] = a
+        sig = tuple((n, dev_arrays[n].shape, str(dev_arrays[n].dtype))
+                    for n in in_names)
+        per_comp = self._cache.get(comp)
+        exe = None if per_comp is None else per_comp.get(sig)
+        if exe is None:
+            with self._lock:
+                per_comp = self._cache.setdefault(comp, {})
+                exe = per_comp.get(sig)
+                if exe is None:
+                    hlo = _lower_stablehlo(comp, dev_arrays, in_names,
+                                           out_names)
+                    exe = self.client.compile(hlo)
+                    per_comp[sig] = exe
+                    self.compile_count += 1
+                    _log.debug("native compile #%d for %s",
+                               self.compile_count, sig)
+        outs = exe.execute([dev_arrays[n] for n in in_names])
+        result: Dict[str, np.ndarray] = {}
+        for spec, a in zip(comp.outputs, outs):
+            storage = spec.dtype.np_storage
+            if a.dtype != storage and spec.dtype is not _dt.bfloat16:
+                from . import native as _native
+                a = _native.convert(a, storage)
+            result[spec.name] = a
+        return result
+
+    def clear(self):
+        with self._lock:
+            for per_comp in self._cache.values():
+                for exe in per_comp.values():
+                    exe.close()
+            self._cache.clear()

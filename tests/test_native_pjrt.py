@@ -1,0 +1,153 @@
+"""Native PJRT execution core tests.
+
+The analogue of the reference's native-runtime smoke tests
+(``TFInitializationSuite.scala:12-34``) plus the engine-parity contract:
+a serialized computation executed through the C++ core must be
+bit-identical to the jax in-process path on the same backend (CPU here;
+the plugin backend runs the same code against libtpu.so on TPU hosts).
+
+The library is built on demand; if the toolchain or the TF C++ libraries
+are present but the build fails, that is a FAILURE, not a skip
+(VERDICT.md round-1 #8: a broken native build must not pass silently).
+"""
+
+import os
+import shutil
+import subprocess
+
+import numpy as np
+import pytest
+
+import tensorframes_tpu as tft
+from tensorframes_tpu import dtypes as dt
+from tensorframes_tpu.computation import Computation, TensorSpec
+from tensorframes_tpu.engine.executor import BlockExecutor
+from tensorframes_tpu.shape import Shape, Unknown
+
+NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "native")
+LIB = os.path.join(NATIVE_DIR, "libtfrpjrt.so")
+
+
+def _tf_available() -> bool:
+    import importlib.util
+
+    return importlib.util.find_spec("tensorflow") is not None
+
+
+@pytest.fixture(scope="module")
+def core():
+    if shutil.which("g++") is None or not _tf_available():
+        pytest.skip("no C++ toolchain / tensorflow C++ libs in this env")
+    if not os.path.exists(LIB):
+        proc = subprocess.run(["make", "-C", NATIVE_DIR, "pjrt"],
+                              capture_output=True, text=True)
+        assert proc.returncode == 0, (
+            f"native PJRT core failed to build:\n{proc.stderr[-2000:]}")
+    from tensorframes_tpu import native_pjrt
+
+    assert native_pjrt.available(), "libtfrpjrt.so built but not loadable"
+    return native_pjrt
+
+
+@pytest.fixture(scope="module")
+def client(core):
+    c = core.PjrtCoreClient("cpu")
+    yield c
+    c.close()
+
+
+def test_client_basics(client):
+    assert client.platform == "cpu"
+    assert client.device_count >= 1
+
+
+def test_raw_stablehlo_compile_execute(core, client):
+    hlo = b"""
+module @jit_f {
+  func.func public @main(%arg0: tensor<4xf32>) -> tensor<4xf32> {
+    %0 = stablehlo.add %arg0, %arg0 : tensor<4xf32>
+    return %0 : tensor<4xf32>
+  }
+}"""
+    exe = client.compile(hlo)
+    (out,) = exe.execute([np.array([1, 2, 3, 4], np.float32)])
+    np.testing.assert_array_equal(out, [2, 4, 6, 8])
+    exe.close()
+
+
+def test_compile_error_surfaces(core, client):
+    with pytest.raises(core.PjrtCoreError, match="compile failed"):
+        client.compile(b"this is not stablehlo")
+
+
+def test_bit_identical_to_jax_path(core):
+    comp = Computation.trace(
+        lambda x: {"z": x * 2.5 + 1.0},
+        [TensorSpec("x", dt.double, Shape(Unknown))])
+    arrays = {"x": np.linspace(-3, 7, 101)}
+    jax_out = BlockExecutor().run(comp, arrays)
+    ex = core.PjrtBlockExecutor("cpu")
+    nat_out = ex.run(comp, arrays)
+    assert jax_out.keys() == nat_out.keys()
+    assert jax_out["z"].dtype == nat_out["z"].dtype
+    np.testing.assert_array_equal(jax_out["z"], nat_out["z"])  # bit-identical
+
+
+def test_multi_io_and_integer_dtypes(core):
+    import jax.numpy as jnp
+
+    comp = Computation.trace(
+        lambda a, b: {"s": a + b, "m": jnp.minimum(a, b)},
+        [TensorSpec("a", dt.int64, Shape(Unknown)),
+         TensorSpec("b", dt.int64, Shape(Unknown))])
+    arrays = {"a": np.arange(10, dtype=np.int64),
+              "b": np.arange(10, dtype=np.int64)[::-1].copy()}
+    jax_out = BlockExecutor().run(comp, arrays)
+    nat_out = core.PjrtBlockExecutor("cpu").run(comp, arrays)
+    for k in ("s", "m"):
+        np.testing.assert_array_equal(jax_out[k], nat_out[k])
+
+
+def test_compile_cache_reused(core):
+    ex = core.PjrtBlockExecutor("cpu")
+    comp = Computation.trace(
+        lambda x: {"z": x + 1.0},
+        [TensorSpec("x", dt.double, Shape(Unknown))])
+    for _ in range(4):
+        ex.run(comp, {"x": np.arange(8.0)})
+    assert ex.compile_count == 1
+    ex.run(comp, {"x": np.arange(9.0)})  # new shape -> one more compile
+    assert ex.compile_count == 2
+
+
+def test_map_blocks_through_native_core(core):
+    from tensorframes_tpu.engine import ops as engine_ops
+
+    df = tft.frame({"x": np.arange(10.0)}, num_partitions=3)
+    ex = core.PjrtBlockExecutor("cpu")
+    out = engine_ops.map_blocks(lambda x: {"z": x + 3.0}, df, executor=ex)
+    assert [r["z"] for r in out.collect()] == [i + 3.0 for i in range(10)]
+
+
+def test_serialized_computation_roundtrip_through_core(core):
+    # serialize on the "driver", deserialize (another process's computation,
+    # builder.py path), execute through the C++ core — the full
+    # graphSerial -> broadcast -> C++ Session.Run analogue
+    comp = Computation.trace(
+        lambda x: {"z": x * x},
+        [TensorSpec("x", dt.double, Shape(Unknown))])
+    blob = comp.serialize()
+    comp2 = Computation.deserialize(blob)
+    arrays = {"x": np.arange(6.0)}
+    nat = core.PjrtBlockExecutor("cpu").run(comp2, arrays)
+    np.testing.assert_array_equal(nat["z"], np.arange(6.0) ** 2)
+
+
+def test_2d_and_f32(core):
+    comp = Computation.trace(
+        lambda m: {"t": m @ m.T},
+        [TensorSpec("m", dt.float32, Shape(3, 4))])
+    m = np.arange(12, dtype=np.float32).reshape(3, 4)
+    jax_out = BlockExecutor().run(comp, {"m": m})
+    nat_out = core.PjrtBlockExecutor("cpu").run(comp, {"m": m})
+    np.testing.assert_array_equal(jax_out["t"], nat_out["t"])
