@@ -23,6 +23,8 @@
 
 #include <dlfcn.h>
 
+#include <cerrno>
+#include <cstdlib>
 #include <cstring>
 #include <memory>
 #include <string>
@@ -49,6 +51,68 @@ void set_err(char* err, int errlen, const std::string& msg) {
   if (err && errlen > 0) {
     std::snprintf(err, static_cast<size_t>(errlen), "%s", msg.c_str());
   }
+}
+
+// "plugin:/x.so?topology=v5e:1x1x1&n_slices=1" -> base "plugin:/x.so" +
+// ordered (key, value) pairs. Only the LAST '?' before the first '&'
+// region is honored as the option separator so .so paths containing '?'
+// (never in practice) don't need escaping.
+struct SpecOption {
+  std::string key;
+  std::string value;
+  bool is_int = false;
+  long long int_value = 0;
+};
+
+std::vector<SpecOption> parse_spec_options(std::string* spec) {
+  std::vector<SpecOption> out;
+  auto q = spec->find('?');
+  if (q == std::string::npos) return out;
+  std::string opts = spec->substr(q + 1);
+  spec->resize(q);
+  size_t pos = 0;
+  while (pos <= opts.size()) {
+    auto amp = opts.find('&', pos);
+    std::string pair = opts.substr(
+        pos, amp == std::string::npos ? std::string::npos : amp - pos);
+    if (!pair.empty()) {
+      SpecOption o;
+      auto eq = pair.find('=');
+      if (eq == std::string::npos) {
+        o.key = pair;
+      } else {
+        o.key = pair.substr(0, eq);
+        o.value = pair.substr(eq + 1);
+      }
+      if (!o.value.empty()) {
+        char* end = nullptr;
+        errno = 0;
+        long long v = std::strtoll(o.value.c_str(), &end, 10);
+        if (errno == 0 && end && *end == '\0') {
+          o.is_int = true;
+          o.int_value = v;
+        }
+      }
+      out.push_back(std::move(o));
+    }
+    if (amp == std::string::npos) break;
+    pos = amp + 1;
+  }
+  return out;
+}
+
+// Pops the reserved "tfr_device" option; returns the ordinal (default 0).
+int take_device_ordinal(std::vector<SpecOption>* opts) {
+  int ordinal = 0;
+  for (auto it = opts->begin(); it != opts->end();) {
+    if (it->key == "tfr_device") {
+      if (it->is_int) ordinal = static_cast<int>(it->int_value);
+      it = opts->erase(it);
+    } else {
+      ++it;
+    }
+  }
+  return ordinal;
 }
 
 // ---------------------------------------------------------------------------
@@ -160,6 +224,7 @@ struct CppResults : ResultsIface {
 
 struct CppClient : ClientIface {
   std::unique_ptr<xla::PjRtClient> client;
+  int device_ordinal = 0;
 
   int device_count() const override { return client->device_count(); }
 
@@ -184,7 +249,15 @@ struct CppClient : ClientIface {
                         const int* ndims, const long long* dims,
                         const void* const* data, std::string* err) override {
     auto* exe = static_cast<CppExe*>(exe_i);
-    auto* device = client->addressable_devices()[0];
+    auto devices = client->addressable_devices();
+    if (device_ordinal < 0 ||
+        device_ordinal >= static_cast<int>(devices.size())) {
+      *err = "device ordinal " + std::to_string(device_ordinal) +
+             " out of range (" + std::to_string(devices.size()) +
+             " addressable devices)";
+      return nullptr;
+    }
+    auto* device = devices[device_ordinal];
     auto ms_or = device->default_memory_space();
     if (!ms_or.ok()) { *err = ms_or.status().ToString(); return nullptr; }
 
@@ -357,6 +430,7 @@ struct CApiClient : ClientIface {
   void* dl = nullptr;
   const PJRT_Api* api = nullptr;
   PJRT_Client* client = nullptr;
+  int device_ordinal = 0;
 
   ~CApiClient() override {
     if (client) {
@@ -369,7 +443,8 @@ struct CApiClient : ClientIface {
     // The plugin stays loaded (dlclose of live XLA runtimes is unsafe).
   }
 
-  std::string init(const std::string& path) {
+  std::string init(const std::string& path,
+                   const std::vector<SpecOption>& options) {
     dl = dlopen(path.c_str(), RTLD_NOW | RTLD_LOCAL);
     if (!dl) return std::string("dlopen failed: ") + dlerror();
     using GetApiFn = const PJRT_Api* (*)();
@@ -383,9 +458,31 @@ struct CApiClient : ClientIface {
     if (auto* e = api->PJRT_Plugin_Initialize(&pi)) {
       return "plugin init failed: " + capi_err(api, e);
     }
+    // Spec options become PJRT NamedValues (int64 when numeric, string
+    // otherwise — proxy plugins like axon reject bools for flags, so the
+    // int encoding matches what jax's register_plugin sends).
+    std::vector<PJRT_NamedValue> nvs(options.size());
+    for (size_t i = 0; i < options.size(); ++i) {
+      auto& nv = nvs[i];
+      std::memset(&nv, 0, sizeof(nv));
+      nv.struct_size = PJRT_NamedValue_STRUCT_SIZE;
+      nv.name = options[i].key.c_str();
+      nv.name_size = options[i].key.size();
+      if (options[i].is_int) {
+        nv.type = PJRT_NamedValue_kInt64;
+        nv.int64_value = options[i].int_value;
+        nv.value_size = 1;
+      } else {
+        nv.type = PJRT_NamedValue_kString;
+        nv.string_value = options[i].value.c_str();
+        nv.value_size = options[i].value.size();
+      }
+    }
     PJRT_Client_Create_Args cc;
     std::memset(&cc, 0, sizeof(cc));
     cc.struct_size = PJRT_Client_Create_Args_STRUCT_SIZE;
+    cc.create_options = nvs.data();
+    cc.num_options = nvs.size();
     if (auto* e = api->PJRT_Client_Create(&cc)) {
       return "client create failed: " + capi_err(api, e);
     }
@@ -451,11 +548,14 @@ struct CApiClient : ClientIface {
       *err = capi_err(api, e);
       return nullptr;
     }
-    if (ad.num_addressable_devices == 0) {
-      *err = "no addressable devices";
+    if (device_ordinal < 0 ||
+        static_cast<size_t>(device_ordinal) >= ad.num_addressable_devices) {
+      *err = "device ordinal " + std::to_string(device_ordinal) +
+             " out of range (" + std::to_string(ad.num_addressable_devices) +
+             " addressable devices)";
       return nullptr;
     }
-    PJRT_Device* device = ad.addressable_devices[0];
+    PJRT_Device* device = ad.addressable_devices[device_ordinal];
 
     std::vector<PJRT_Buffer*> in_bufs;
     auto destroy_inputs = [&]() {
@@ -577,6 +677,8 @@ tfr_pjrt_client* tfr_pjrt_client_create(const char* spec, char* err,
                                         int errlen) {
   std::string s(spec ? spec : "");
   try {
+    std::vector<SpecOption> options = parse_spec_options(&s);
+    int ordinal = take_device_ordinal(&options);
     if (s == "cpu" || s.rfind("cpu:", 0) == 0) {
       xla::CpuClientOptions opts;
       opts.cpu_device_count = 1;
@@ -588,13 +690,15 @@ tfr_pjrt_client* tfr_pjrt_client_create(const char* spec, char* err,
       }
       auto* c = new CppClient();
       c->client = std::move(c_or).value();
+      c->device_ordinal = ordinal;
       auto* out = new tfr_pjrt_client();
       out->impl.reset(c);
       return out;
     }
     if (s.rfind("plugin:", 0) == 0) {
       auto* c = new CApiClient();
-      std::string msg = c->init(s.substr(7));
+      c->device_ordinal = ordinal;
+      std::string msg = c->init(s.substr(7), options);
       if (!msg.empty()) {
         set_err(err, errlen, msg);
         delete c;

@@ -40,6 +40,13 @@ enum tfr_dtype {
 };
 
 /* Create a client. spec: "cpu", "cpu:<ndevices>", or "plugin:<path.so>".
+ * Either form may carry URL-style options: "plugin:<path>?k=v&k2=v2".
+ * Values that parse as integers are passed to the plugin as int64
+ * NamedValues, everything else as strings (PJRT_Client_Create
+ * create_options — how proxied plugins such as axon receive their
+ * topology/session configuration). The reserved key "tfr_device"
+ * selects the addressable-device ordinal this client executes on
+ * (default 0) and is not forwarded to the plugin.
  * Returns NULL on failure with a message in err. */
 tfr_pjrt_client* tfr_pjrt_client_create(const char* spec, char* err,
                                         int errlen);
@@ -53,7 +60,8 @@ tfr_pjrt_exe* tfr_pjrt_compile(tfr_pjrt_client* c, const char* module_bytes,
                                long module_len, char* err, int errlen);
 void tfr_pjrt_exe_destroy(tfr_pjrt_exe* e);
 
-/* Execute on device 0. Inputs are dense row-major host buffers.
+/* Execute on the client's device (ordinal "tfr_device" from the spec;
+ * default 0). Inputs are dense row-major host buffers.
  * dims is one flat array; ndims[i] gives each argument's rank and the
  * dims of argument i follow those of i-1. Returns NULL on failure. */
 tfr_pjrt_results* tfr_pjrt_execute(tfr_pjrt_client* c, tfr_pjrt_exe* e,

@@ -117,6 +117,43 @@ def available() -> bool:
     return _load() is not None
 
 
+def resolve_backend_spec(backend: str) -> str:
+    """Expand backend shorthands into full native-core specs.
+
+    ``"axon"`` / ``"axon:<ordinal>"`` expands to the tunnelled-TPU PJRT
+    plugin (``PJRT_LIBRARY_PATH``) with the NamedValue create options the
+    axon proxy requires — the same option set jax's plugin registration
+    sends (topology/session/compile-mode), so the native core reaches the
+    identical chip jax does. Everything else passes through unchanged
+    (``cpu[:n]``, ``plugin:<path>[?opts]``).
+    """
+    if backend != "axon" and not backend.startswith("axon:"):
+        return backend
+    import uuid
+
+    lib = os.environ.get("PJRT_LIBRARY_PATH")
+    if not lib or not os.path.exists(lib):
+        raise PjrtCoreError(
+            "backend 'axon' needs PJRT_LIBRARY_PATH pointing at the axon "
+            "PJRT plugin (.so)")
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
+    remote = 1 if os.environ.get("PALLAS_AXON_REMOTE_COMPILE") == "1" else 0
+    opts = [
+        ("remote_compile", remote),
+        ("local_only", 0),
+        ("priority", 0),
+        ("topology", f"{gen}:1x1x1"),
+        ("n_slices", 1),
+        ("session_id", str(uuid.uuid4())),
+        # monoclient sentinel rank (axon.register.MULTIHOST_RANK)
+        ("rank", 0xFFFF_FFFF),
+    ]
+    if ":" in backend:
+        opts.append(("tfr_device", int(backend.split(":", 1)[1])))
+    qs = "&".join(f"{k}={v}" for k, v in opts)
+    return f"plugin:{lib}?{qs}"
+
+
 class PjrtCoreError(RuntimeError):
     pass
 
@@ -136,6 +173,7 @@ class PjrtCoreClient:
                 "libtfrpjrt.so is not available; build it with "
                 "`make -C native pjrt`")
         self._lib = lib
+        backend = resolve_backend_spec(backend)
         err = ctypes.create_string_buffer(_ERRLEN)
         self._client = lib.tfr_pjrt_client_create(
             backend.encode(), err, _ERRLEN)
