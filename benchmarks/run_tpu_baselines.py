@@ -1,0 +1,152 @@
+"""Per-config BASELINE runner for the real chip: prints one JSON line per
+config AS IT COMPLETES (a timeout loses only the configs after it, unlike
+``run_all`` which buffers), and adds an MFU estimate for the MXU-heavy
+configs using XLA's own cost model.
+
+MFU convention: ``flops`` is XLA's ``cost_analysis()`` estimate for the
+jitted program (analytic, pre-fusion), wall is the measured steady-state
+iteration, peak is the chip's dense bf16 MXU rate (v5e/v5litepod:
+1.97e14 FLOP/s) — f32 matmuls execute on the MXU through bf16-pass
+decomposition, so this is the honest denominator on this part.
+
+Usage:  python benchmarks/run_tpu_baselines.py [1 2 3 4 5]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+V5E_PEAK_FLOPS = 1.97e14  # dense bf16, one v5e chip
+
+
+def _emit(rec):
+    print(json.dumps(rec), flush=True)
+
+
+def _mfu(flops_per_iter: float, sec_per_iter: float) -> float:
+    return flops_per_iter / sec_per_iter / V5E_PEAK_FLOPS
+
+
+def _jit_flops(fn, *args) -> float:
+    """XLA cost-model FLOPs for one call of the jitted fn."""
+    import jax
+
+    try:
+        comp = jax.jit(fn).lower(*args).compile()
+        ca = comp.cost_analysis()
+        if isinstance(ca, list):
+            ca = ca[0]
+        return float(ca.get("flops", 0.0))
+    except Exception:
+        return 0.0
+
+
+def config4_resnet_mfu(batch: int = 32, image: int = 224,
+                       iters: int = 5):
+    """ResNet-50 batch inference + MFU (BASELINE config 4)."""
+    import jax
+    import numpy as np
+
+    import tensorframes_tpu as tft
+    from tensorframes_tpu.models.resnet import ResNet50
+
+    model = ResNet50(num_classes=1000)
+    params = model.init()
+    imgs = np.random.default_rng(1).normal(
+        size=(batch, image, image, 3)).astype(np.float32)
+    df = tft.analyze(tft.frame({"image": imgs}))
+    df.cache()
+
+    def go():
+        out = model.infer_via_frame(params, df, image_col="image")
+        return out.blocks()
+
+    go()  # compile
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        blocks = go()
+    sec = (time.perf_counter() - t0) / iters
+    assert blocks[0].dense("logits").shape == (batch, 1000)
+
+    flops = _jit_flops(lambda p, x: model.apply(p, x), params, imgs)
+    return {"metric": "resnet50_infer", "value": sec, "unit": "s/batch",
+            "images": batch, "images_per_s": batch / sec,
+            "flops_per_batch": flops,
+            "mfu": round(_mfu(flops, sec), 4) if flops else None,
+            "platform": jax.default_backend()}
+
+
+def config5_logreg_mfu(n: int = 262_144, d: int = 64, iters: int = 5):
+    """Logreg gradient step + MFU (BASELINE config 5)."""
+    import jax
+    import numpy as np
+
+    import tensorframes_tpu as tft
+    from tensorframes_tpu.models.logreg import LogisticRegression
+
+    rng = np.random.default_rng(2)
+    w_true = rng.normal(size=d)
+    x = rng.normal(size=(n, d))
+    y = (x @ w_true + rng.normal(0, 0.1, n) > 0).astype(np.float64)
+    df = tft.analyze(tft.frame({"features": x, "label": y},
+                               num_partitions=8))
+    df.cache()
+    model = LogisticRegression(num_features=d)
+    params = model.init()
+
+    def go():
+        return model.gradient_via_frame(params, df)
+
+    go()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        go()
+    sec = (time.perf_counter() - t0) / iters
+
+    xb = x.astype(np.float32)
+    yb = y.astype(np.float32)
+    flops = _jit_flops(lambda p, xx, yy: model.grads(p, xx, yy),
+                       params, xb, yb)
+    return {"metric": "logreg_grad_step", "value": sec, "unit": "s/step",
+            "rows": n, "rows_per_s": n / sec,
+            "flops_per_step": flops,
+            "mfu": round(_mfu(flops, sec), 6) if flops else None,
+            "platform": jax.default_backend()}
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    which = [int(a) for a in argv] or [1, 2, 3, 4, 5]
+
+    from benchmarks import baseline_configs as bc
+    import jax
+
+    plat = jax.default_backend()
+    runners = {
+        1: bc.config1_readme_x_plus_3,
+        2: bc.config2_reduce_vector,
+        3: bc.config3_dsl_map,
+        4: config4_resnet_mfu,
+        5: config5_logreg_mfu,
+    }
+    rc = 0
+    for i in which:
+        try:
+            rec = runners[i]()
+            rec.setdefault("platform", plat)
+            rec["config"] = i
+            _emit(rec)
+        except Exception as e:  # keep going; a failed config is a line too
+            _emit({"config": i, "error": f"{type(e).__name__}: {e}"[:300],
+                   "platform": plat})
+            rc = 1
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -138,11 +138,14 @@ def resolve_backend_spec(backend: str) -> str:
             "PJRT plugin (.so)")
     gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
     remote = 1 if os.environ.get("PALLAS_AXON_REMOTE_COMPILE") == "1" else 0
+    # TFT_AXON_TOPOLOGY overrides for multi-chip grants — a 1x1x1 grant has
+    # one addressable device, so 'axon:<ordinal>' with ordinal > 0 needs it
+    topology = os.environ.get("TFT_AXON_TOPOLOGY", f"{gen}:1x1x1")
     opts = [
         ("remote_compile", remote),
         ("local_only", 0),
         ("priority", 0),
-        ("topology", f"{gen}:1x1x1"),
+        ("topology", topology),
         ("n_slices", 1),
         ("session_id", str(uuid.uuid4())),
         # monoclient sentinel rank (axon.register.MULTIHOST_RANK)

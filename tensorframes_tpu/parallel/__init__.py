@@ -14,6 +14,7 @@ from .distributed import (
     DistributedFrame, daggregate, distribute, dmap_blocks, dreduce_blocks)
 from .collectives import COMBINERS
 from .ring import ring_attention, ring_allreduce
+from .cluster import cluster_mesh, distribute_local, initialize
 
 __all__ = [
     "DeviceMesh", "local_mesh",
@@ -21,4 +22,5 @@ __all__ = [
     "dreduce_blocks",
     "COMBINERS",
     "ring_attention", "ring_allreduce",
+    "cluster_mesh", "distribute_local", "initialize",
 ]

@@ -42,6 +42,37 @@ from .mesh import DeviceMesh
 __all__ = ["DistributedFrame", "distribute", "dmap_blocks",
            "dreduce_blocks", "daggregate"]
 
+import weakref
+
+# Computation objects rebuilt per call would defeat the per-Computation jit
+# caches below (every daggregate/dreduce with callable fetches would
+# re-trace and re-compile its mesh program); this weak cache makes repeated
+# calls with the SAME fetches object reuse one Computation per schema.
+_fetches_comp_cache: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+def _cached_reduce_computation(fetches, value_schema, suffixes,
+                               block_level: bool):
+    sig = (tuple(suffixes), block_level,
+           tuple((f.name, f.dtype.name,
+                  tuple(f.block_shape.dims) if f.block_shape is not None
+                  else None)
+                 for f in value_schema))
+    try:
+        per = _fetches_comp_cache.setdefault(fetches, {})
+    except TypeError:  # unhashable / not weakref-able (e.g. dsl node lists)
+        per = None
+    if per is not None:
+        comp = per.get(sig)
+        if comp is not None:
+            return comp
+    comp = _ops._reduce_computation(fetches, value_schema, suffixes,
+                                    block_level=block_level)
+    if per is not None:
+        per[sig] = comp
+    return comp
+
+
 def _jitted(comp):
     """One jitted wrapper per live Computation, stored on the object so it
     is collected with it: repeated dmap/dreduce calls on the same
@@ -59,27 +90,85 @@ class DistributedFrame:
     ``num_rows`` is the un-padded row count; rows are padded up to a
     multiple of the data-axis size so every shard is equal (XLA's static
     world), and consumers mask or slice the pad away.
+
+    ``shard_valid`` (multi-host frames, from ``cluster.distribute_local``):
+    per-data-shard valid-row counts, for frames whose pad rows are NOT a
+    global suffix — each process padded its own block. ``None`` means
+    prefix semantics (single-host ``distribute``): the first ``num_rows``
+    rows are the real ones.
     """
 
     def __init__(self, mesh: DeviceMesh, schema: Schema,
-                 columns: Dict[str, jax.Array], num_rows: int):
+                 columns: Dict[str, jax.Array], num_rows: int,
+                 shard_valid: Optional[np.ndarray] = None):
         self.mesh = mesh
         self.schema = schema
         self.columns = columns
         self.num_rows = num_rows
+        self.shard_valid = shard_valid
 
     @property
     def padded_rows(self) -> int:
         first = next(iter(self.columns.values()))
         return first.shape[0]
 
+    def per_shard_valid(self) -> np.ndarray:
+        """Valid-row count of every data shard, [num_data_shards]."""
+        S = self.mesh.num_data_shards
+        if self.shard_valid is not None:
+            return np.asarray(self.shard_valid, np.int64)
+        rows_per = self.padded_rows // S
+        out = np.full(S, rows_per, np.int64)
+        full, tail = divmod(self.num_rows, rows_per)
+        out[full:] = 0
+        if full < S:
+            out[full] = tail
+        return out
+
+    def valid_row_mask(self) -> np.ndarray:
+        """Host bool mask [padded_rows]: True where the row is real."""
+        S = self.mesh.num_data_shards
+        rows_per = self.padded_rows // S
+        idx = np.arange(self.padded_rows) % rows_per
+        return idx < np.repeat(self.per_shard_valid(), rows_per)
+
+    def host_read_padded(self, name: str) -> np.ndarray:
+        """The full padded global column on THIS host.
+
+        Fully-addressable arrays read directly; multi-host arrays gather
+        the process-local blocks (process-contiguous row layout, the
+        ``cluster.distribute_local`` invariant) with one allgather.
+        """
+        a = self.columns[name]
+        if getattr(a, "is_fully_addressable", True):
+            return np.asarray(a)
+        from jax.experimental import multihost_utils
+
+        def start(s):
+            sl = s.index[0]
+            return 0 if sl.start is None else sl.start
+
+        # replication over non-data mesh axes repeats each row block across
+        # devices; keep one shard per distinct row range
+        by_start = {}
+        for s in a.addressable_shards:
+            by_start.setdefault(start(s), s)
+        shards = [by_start[k] for k in sorted(by_start)]
+        local = np.concatenate([np.asarray(s.data) for s in shards])
+        gathered = np.asarray(multihost_utils.process_allgather(local))
+        return gathered.reshape((-1,) + tuple(a.shape[1:]))
+
     def collect_frame(self, num_partitions: Optional[int] = None) -> TensorFrame:
-        """Bring the data back to the host as a TensorFrame (pad dropped)."""
-        cols = {n: np.asarray(a)[: self.num_rows]
-                for n, a in self.columns.items()}
+        """Bring the data back to the host as a TensorFrame (pad dropped).
+
+        Multi-host frames gather every process's rows — each host gets the
+        FULL frame (the driver-collect contract of the reference,
+        ``ExperimentalOperations.scala:91``)."""
+        mask = self.valid_row_mask()
         host_cols = {}
         for f in self.schema:
-            a = cols[f.name]
+            a = self.host_read_padded(f.name)
+            a = a[mask] if self.shard_valid is not None else a[: self.num_rows]
             if a.dtype != f.dtype.np_storage and f.dtype is not _dt.bfloat16:
                 a = a.astype(f.dtype.np_storage)
             host_cols[f.name] = a
@@ -173,7 +262,11 @@ def dmap_blocks(fetches, dist: DistributedFrame, trim: bool = False,
     for spec in comp.outputs:
         cols[spec.name] = out[spec.name]
     num_rows = dist.num_rows if row_aligned else n_out
-    return DistributedFrame(mesh, out_schema, cols, num_rows)
+    # row-aligned outputs keep the input's pad layout; a fresh global
+    # result (row_aligned=False) has no pad rows at all
+    return DistributedFrame(mesh, out_schema, cols, num_rows,
+                            shard_valid=(dist.shard_valid if row_aligned
+                                         else None))
 
 
 def dreduce_blocks(fetches, dist: DistributedFrame):
@@ -230,15 +323,17 @@ def _collective_reduce(col_combiners: Mapping[str, str],
     if fn is not None:
         _collective_cache.move_to_end(key)
     else:
-        in_specs = (P(),) + tuple(
+        # per-shard valid-row counts ride in sharded over the axis: pads are
+        # masked wherever they fall (a multi-host frame pads per process,
+        # not in a global suffix)
+        in_specs = (P(axis),) + tuple(
             P(axis, *([None] * (a.ndim - 1))) for a in arrays)
         out_specs = tuple(P() for _ in arrays)
 
-        def shard_fn(n_valid, *shards):
+        def shard_fn(nv, *shards):
             outs = []
             rows = shards[0].shape[0]
-            idx = jax.lax.axis_index(axis) * rows + jnp.arange(rows)
-            valid = idx < n_valid
+            valid = jnp.arange(rows) < nv[0]
             for name, s in zip(names, shards):
                 c = combs[name]
                 mask = valid.reshape((rows,) + (1,) * (s.ndim - 1))
@@ -253,7 +348,10 @@ def _collective_reduce(col_combiners: Mapping[str, str],
         _collective_cache[key] = fn
         while len(_collective_cache) > _COLLECTIVE_CACHE_CAP:
             _collective_cache.popitem(last=False)
-    outs = fn(jnp.asarray(dist.num_rows, jnp.int32), *arrays)
+    nv_dev = jax.make_array_from_callback(
+        (mesh.num_data_shards,), mesh.row_sharding(1),
+        lambda idx: dist.per_shard_valid().astype(np.int32)[idx])
+    outs = fn(nv_dev, *arrays)
     result = {}
     for name, a in zip(names, outs):
         v = np.asarray(a)
@@ -264,47 +362,23 @@ def _collective_reduce(col_combiners: Mapping[str, str],
     return result
 
 
-def daggregate(col_combiners: Mapping[str, str], dist: DistributedFrame,
-               keys) -> TensorFrame:
-    """Mesh-distributed keyed aggregation over the monoid combiners.
+def _host_group_ids(dist: DistributedFrame, keys):
+    """Key columns → dense group ids on the mesh (host factorization).
 
-    The reference's Catalyst shuffle + UDAF (``DebugRowOps.scala:533-681``)
-    re-expressed TPU-first: instead of moving rows between workers by key,
-    each shard segment-reduces its LOCAL rows into a dense ``[groups, ...]``
-    table (one one-hot-matmul/segment kernel launch) and the tables are
-    combined with a single ``psum``-family collective over the data axis —
-    the shuffle becomes an ICI all-reduce of a small table. Only the scalar
-    KEY columns visit the host (to build dense group ids); the values never
-    leave their shards.
-
-    ``keys``: key column name or list of names. Returns a host
-    :class:`TensorFrame` of one row per group (keys + fetches, fetches
-    sorted by name), like :func:`~tensorframes_tpu.api.aggregate`.
+    Only the scalar KEY columns visit the host; ids come back row-sharded
+    with pad rows marked ``-1`` (dropped by every consumer). Returns
+    ``(ids_dev, uniques, num_groups)``.
     """
-    from ..engine.ops import (InvalidTypeError, _factorize_keys,
-                              _validate_monoid_fetches)
-    from ..ops.segment_reduce import segment_sum as _segsum
+    from ..engine.ops import InvalidTypeError, _factorize_keys
 
-    if isinstance(keys, str):
-        keys = [keys]
-    keys = list(keys)
     mesh = dist.mesh
-    axis = mesh.data_axis
     schema = dist.schema
-    for k in keys:
-        if k not in schema:
-            raise KeyError(f"No key column {k!r}; columns: {schema.names}")
-    value_names = [n for n in schema.names if n not in keys]
-    _validate_monoid_fetches(col_combiners, value_names,
-                             "before distribute()")
-    n = dist.num_rows
-    if n == 0:
-        raise ValueError("aggregate on an empty distributed frame")
-
+    mask = dist.valid_row_mask()
     key_host = []
     for k in keys:
         fld = schema[k]
-        a = np.asarray(dist.columns[k])[:n]
+        a = dist.host_read_padded(k)
+        a = a[mask] if dist.shard_valid is not None else a[: dist.num_rows]
         if a.ndim != 1:
             raise InvalidTypeError(f"Key column {k!r} must be scalar-typed")
         if a.dtype != fld.dtype.np_storage and fld.dtype is not _dt.bfloat16:
@@ -322,10 +396,72 @@ def daggregate(col_combiners: Mapping[str, str], dist: DistributedFrame,
             a = a.astype(fld.dtype.np_storage)
         key_host.append(a)
     fact = _factorize_keys(key_host)
-    ids, uniques, num_groups = fact.ids, fact.uniques, fact.num_groups
     ids_padded = np.full(dist.padded_rows, -1, np.int32)  # -1: pad, dropped
-    ids_padded[:n] = ids
-    ids_dev = jax.device_put(ids_padded, mesh.row_sharding(1))
+    if dist.shard_valid is not None:
+        ids_padded[mask] = fact.ids
+    else:
+        ids_padded[: dist.num_rows] = fact.ids
+    ids_dev = jax.make_array_from_callback(
+        (dist.padded_rows,), mesh.row_sharding(1),
+        lambda idx: ids_padded[idx])
+    return ids_dev, fact.uniques, fact.num_groups
+
+
+def daggregate(fetches, dist: DistributedFrame, keys) -> TensorFrame:
+    """Mesh-distributed keyed aggregation.
+
+    The reference's Catalyst shuffle + UDAF (``DebugRowOps.scala:533-681``)
+    re-expressed TPU-first: instead of moving rows between workers by key,
+    each shard reduces its LOCAL rows into a dense ``[groups, ...]`` table
+    and the tables are combined across the data axis — the shuffle becomes
+    an ICI collective over a small table. Only the scalar KEY columns visit
+    the host (to build dense group ids); the values never leave their
+    shards.
+
+    Two paths, mirroring :func:`~tensorframes_tpu.api.aggregate`:
+
+    - ``fetches`` is a mapping ``{column: combiner-name}`` (sum/min/max/
+      prod): one segment-reduce launch per column (the Pallas one-hot
+      matmul for float sums) + one ``psum``-family collective;
+    - ``fetches`` is a computation (block-level ``<col>_input`` reduce,
+      the UDAF contract): per-shard sort-by-id + segmented
+      ``associative_scan`` whose pair-combiner IS the user computation on
+      two-row blocks, segment tails scattered into a ``[groups, ...]``
+      partial table, then a cross-shard masked fold of the stacked tables
+      with the same combiner. Combine order is contractually unspecified
+      (the compaction contract — the computation must tolerate arbitrary
+      regrouping, ``core.py:96-97``), which is exactly what makes the
+      O(log rows) scan legal.
+
+    ``keys``: key column name or list of names. Returns a host
+    :class:`TensorFrame` of one row per group (keys + fetches, fetches
+    sorted by name), like :func:`~tensorframes_tpu.api.aggregate`.
+    """
+    if isinstance(keys, str):
+        keys = [keys]
+    keys = list(keys)
+    schema = dist.schema
+    for k in keys:
+        if k not in schema:
+            raise KeyError(f"No key column {k!r}; columns: {schema.names}")
+    if not (isinstance(fetches, Mapping) and fetches and all(
+            isinstance(v, str) for v in fetches.values())):
+        return _generic_daggregate(fetches, dist, keys)
+    col_combiners = fetches
+
+    from ..engine.ops import _validate_monoid_fetches
+    from ..ops.segment_reduce import segment_sum as _segsum
+
+    mesh = dist.mesh
+    axis = mesh.data_axis
+    value_names = [n for n in schema.names if n not in keys]
+    _validate_monoid_fetches(col_combiners, value_names,
+                             "before distribute()")
+    n = dist.num_rows
+    if n == 0:
+        raise ValueError("aggregate on an empty distributed frame")
+
+    ids_dev, uniques, num_groups = _host_group_ids(dist, keys)
 
     fetch_names = sorted(col_combiners)
     arrays = [dist.columns[f] for f in fetch_names]
@@ -382,6 +518,173 @@ def daggregate(col_combiners: Mapping[str, str], dist: DistributedFrame,
                                    Schema(out_fields))
 
 
+def _segmented_fold(comp, names, mesh: DeviceMesh, arrays, ids_dev,
+                    G: int) -> Dict[str, jax.Array]:
+    """Per-group fold of an arbitrary reduce computation on the mesh.
+
+    ``ids_dev``: row-sharded dense group ids ([padded_rows] int32, ``-1``
+    for pad rows). Per shard: stable sort by id, segmented
+    ``associative_scan`` whose operator applies ``comp`` to a stacked
+    two-row block when both elements share an id, segment tails scattered
+    into a ``[G, ...]`` table + presence mask; the stacked per-shard
+    tables are folded pairwise with the same combiner, and ``comp`` is
+    applied once more over each group's single-row block (at-least-once
+    parity with the host ``CompactionBuffer.evaluate``). Returns
+    ``{fetch: [G, ...cell]}`` device arrays. The jitted program is cached
+    on ``comp`` keyed by (mesh, G, shapes).
+    """
+    axis = mesh.data_axis
+
+    def pair(av, bv):
+        """User computation over the stacked two-row block {a; b}."""
+        out = comp.fn({f + "_input": jnp.stack([av[f], bv[f]])
+                       for f in names})
+        return {f: out[f] for f in names}
+
+    def single(av):
+        out = comp.fn({f + "_input": av[f][None] for f in names})
+        return {f: out[f] for f in names}
+
+    pair_v = jax.vmap(pair)
+    single_v = jax.vmap(single)
+
+    in_specs = (P(axis),) + tuple(
+        P(axis, *([None] * (a.ndim - 1))) for a in arrays)
+    # each shard emits its [1, G, ...] table slice; stacking over the data
+    # axis yields the global [shards, G, ...] partials
+    out_specs = (tuple(P(axis) for _ in names), P(axis))
+
+    def shard_fn(ids_local, *vals_local):
+        R = ids_local.shape[0]
+        # pad rows (-1) sort to the end as group G and are dropped by the
+        # mode="drop" scatter below
+        sort_ids = jnp.where(ids_local < 0, G, ids_local)
+        order = jnp.argsort(sort_ids, stable=True)
+        sid = sort_ids[order]
+        svals = {f: v[order] for f, v in zip(names, vals_local)}
+
+        def op(a, b):
+            a_id, a_v = a
+            b_id, b_v = b
+            same = a_id == b_id
+            comb = pair_v(a_v, b_v)
+            out_v = {}
+            for f in names:
+                m = same.reshape((-1,) + (1,) * (comb[f].ndim - 1))
+                out_v[f] = jnp.where(m, comb[f], b_v[f])
+            return (b_id, out_v)
+
+        _, scanned = jax.lax.associative_scan(op, (sid, svals), axis=0)
+        tail = jnp.concatenate(
+            [sid[1:] != sid[:-1], jnp.ones((1,), bool)])
+        target = jnp.where(tail & (sid < G), sid, G)  # G → dropped
+        table = {}
+        for f in names:
+            z = jnp.zeros((G,) + scanned[f].shape[1:], scanned[f].dtype)
+            table[f] = z.at[target].set(scanned[f], mode="drop")
+        present = jnp.zeros((G,), bool).at[target].set(
+            jnp.ones((R,), bool), mode="drop")
+        return tuple(table[f][None] for f in names), present[None]
+
+    def program(ids, *cols):
+        stacked, present = shard_map(
+            shard_fn, mesh=mesh.mesh, in_specs=in_specs,
+            out_specs=out_specs)(ids, *cols)
+        tabs = dict(zip(names, stacked))  # each [S, G, ...cell]
+        S = present.shape[0]
+        acc = {f: tabs[f][0] for f in names}
+        acc_p = present[0]
+        for s in range(1, S):
+            comb = pair_v({f: acc[f] for f in names},
+                          {f: tabs[f][s] for f in names})
+            both = acc_p & present[s]
+            for f in names:
+                m_both = both.reshape((-1,) + (1,) * (acc[f].ndim - 1))
+                m_new = present[s].reshape(
+                    (-1,) + (1,) * (acc[f].ndim - 1))
+                acc[f] = jnp.where(m_both, comb[f],
+                                   jnp.where(m_new, tabs[f][s], acc[f]))
+            acc_p = acc_p | present[s]
+        # at-least-once application of the computation (host parity for
+        # single-row groups, where the scan never ran the combiner)
+        return single_v(acc)
+
+    cache = getattr(comp, "_tft_segfold_cache", None)
+    if cache is None:
+        cache = comp._tft_segfold_cache = OrderedDict()
+    key = (mesh.mesh, axis, G,
+           tuple((f, a.shape, str(a.dtype)) for f, a in zip(names, arrays)))
+    fn = cache.get(key)
+    if fn is not None:
+        cache.move_to_end(key)
+    else:
+        fn = cache[key] = jax.jit(program)
+        # G is data-dependent (distinct group counts), so bound the cache
+        # like _collective_cache does
+        while len(cache) > 16:
+            cache.popitem(last=False)
+    return fn(ids_dev, *arrays)
+
+
+def _generic_daggregate(fetches, dist: DistributedFrame,
+                        keys) -> TensorFrame:
+    """Arbitrary-computation keyed aggregation on the mesh.
+
+    The distributed form of the reference's UDAF-inside-the-shuffle
+    (``DebugRowOps.scala:587-681``), built from compiler-friendly pieces
+    instead of a row shuffle:
+
+    1. per shard (SPMD, inside one ``shard_map``): stable-sort local rows
+       by group id (pad rows to the end), then one segmented
+       ``jax.lax.associative_scan`` whose operator applies the user
+       computation to a stacked two-row block when both elements share a
+       group id — the fold of each contiguous segment lands on its last
+       row (O(log rows) combiner applications, all vmapped);
+    2. scatter each segment tail into a dense ``[groups, ...cell]`` partial
+       table (+ a presence mask for groups absent on the shard);
+    3. stack the tables over the data axis and fold them pairwise with the
+       same two-row combiner, masked by presence;
+    4. apply the computation once more over each group's single-row block —
+       the host path's ``CompactionBuffer.evaluate`` always applies the
+       computation at least once, so single-row groups must see it too.
+
+    Legal for exactly the computations the host compaction path accepts:
+    the combine must tolerate arbitrary regrouping of rows and partials
+    (the UDAF contract, ``core.py:96-97``).
+    """
+    from ..schema import Field
+    from ..shape import Unknown
+
+    schema = dist.schema
+    mesh = dist.mesh
+    if dist.num_rows == 0:
+        raise ValueError("aggregate on an empty distributed frame")
+    value_schema = schema.select([m for m in schema.names if m not in keys])
+    comp = _cached_reduce_computation(fetches, value_schema, ("_input",),
+                                      block_level=True)
+    _ops._validate_reduce(comp, value_schema, ("_input",), rank_delta=1)
+    names = sorted(comp.output_names)
+
+    ids_dev, uniques, num_groups = _host_group_ids(dist, keys)
+    final = _segmented_fold(comp, names, mesh,
+                            [dist.columns[f] for f in names],
+                            ids_dev, num_groups)
+
+    cols: Dict[str, np.ndarray] = {k: u for k, u in zip(keys, uniques)}
+    for f in names:
+        v = np.asarray(final[f])
+        fld = schema[f]
+        if v.dtype != fld.dtype.np_storage and fld.dtype is not _dt.bfloat16:
+            v = v.astype(fld.dtype.np_storage)
+        cols[f] = v
+    out_fields = [schema[k] for k in keys] + [
+        Field(s.name, s.dtype, block_shape=s.shape.prepend(Unknown),
+              sql_rank=s.shape.ndim)
+        for s in comp.outputs]
+    return TensorFrame.from_blocks([Block(cols, num_groups)],
+                                   Schema(out_fields))
+
+
 def _generic_reduce(fetches, dist: DistributedFrame) -> Dict[str, np.ndarray]:
     """Generic (arbitrary-computation) mesh reduce, entirely on device.
 
@@ -395,8 +698,8 @@ def _generic_reduce(fetches, dist: DistributedFrame) -> Dict[str, np.ndarray]:
     its device.
     """
     schema = dist.schema
-    comp = _ops._reduce_computation(fetches, schema, ("_input",),
-                                    block_level=True)
+    comp = _cached_reduce_computation(fetches, schema, ("_input",),
+                                      block_level=True)
     _ops._validate_reduce(comp, schema, ("_input",), rank_delta=1)
     fetch_names = comp.output_names
     mesh = dist.mesh
@@ -411,6 +714,25 @@ def _generic_reduce(fetches, dist: DistributedFrame) -> Dict[str, np.ndarray]:
 
     names = sorted(fetch_names)
     arrays = [dist.columns[f] for f in names]
+
+    if dist.shard_valid is not None:
+        # multi-host frames pad per process, not in a global suffix — the
+        # prefix slicing below cannot express that. Fold every valid row
+        # into one group through the segmented-scan machinery instead.
+        ids_host = np.where(dist.valid_row_mask(), 0, -1).astype(np.int32)
+        ids_dev = jax.make_array_from_callback(
+            (dist.padded_rows,), mesh.row_sharding(1),
+            lambda idx: ids_host[idx])
+        final_t = _segmented_fold(comp, names, mesh, arrays, ids_dev, 1)
+        out = {}
+        for f in fetch_names:
+            v = np.asarray(final_t[f][0])
+            fld = schema.get(f)
+            if fld is not None and v.dtype != fld.dtype.np_storage \
+                    and fld.dtype is not _dt.bfloat16:
+                v = v.astype(fld.dtype.np_storage)
+            out[f] = v
+        return out
     cache = getattr(comp, "_tft_dreduce_cache", None)
     if cache is None:
         cache = comp._tft_dreduce_cache = {}

@@ -26,6 +26,7 @@ from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
 from .mesh import DeviceMesh
+from .ring import _varying
 
 __all__ = ["pipeline_apply"]
 
@@ -64,7 +65,11 @@ def pipeline_apply(stage_fn: Callable, stacked_params, x: jax.Array,
                                is_leaf=lambda l: l is None),
         row_spec,  # stage 0 consumes microbatches; rows stay data-sharded
     )
-    out_specs = row_spec
+    # Each device returns ITS outs buffer under a leading pipe-sharded dim;
+    # only the last stage's slice holds real data and the caller reads just
+    # that — no collective inside the schedule (a psum here would move the
+    # full zero buffer of every non-final stage across the ring every call).
+    out_specs = P(pipe_axis, None, data_axis, *([None] * (x.ndim - 1)))
 
     def shard_fn(params, xs_rep):
         p = jax.lax.axis_index(pipe_axis)
@@ -91,21 +96,16 @@ def pipeline_apply(stage_fn: Callable, stacked_params, x: jax.Array,
 
         # the carries become device-varying inside the loop (they depend on
         # axis_index); their initial values must be typed varying too
-        def _varying(a):
-            if hasattr(jax.lax, "pcast"):
-                return jax.lax.pcast(a, (pipe_axis,), to="varying")
-            return a
-
-        buf0 = _varying(jnp.zeros_like(xs_rep[0]))
-        outs0 = _varying(jnp.zeros_like(xs_rep))
+        buf0 = _varying(jnp.zeros_like(xs_rep[0]), pipe_axis, data_axis)
+        outs0 = _varying(jnp.zeros_like(xs_rep), pipe_axis, data_axis)
         (_, outs), _ = jax.lax.scan(tick, (buf0, outs0),
                                     jnp.arange(ticks))
-        # outs is populated only on the last stage (zeros elsewhere); the
-        # psum both shares it ring-wide and restores the replicated type
-        # the out_spec promises (identity when the axis has size 1)
-        return jax.lax.psum(outs, pipe_axis)
+        # outs is populated only on the last stage (zeros elsewhere);
+        # return it under a leading size-1 dim that the out_spec shards
+        # over the pipe axis — the caller slices stage P-1's entry.
+        return outs[None]
 
     fn = shard_map(shard_fn, mesh=mesh.mesh,
                    in_specs=in_specs, out_specs=out_specs)
-    out = fn(stacked_params, xs)
+    out = fn(stacked_params, xs)[pipe_size - 1]
     return out.reshape((B,) + out.shape[2:])

@@ -29,6 +29,23 @@ from .mesh import DeviceMesh
 __all__ = ["ring_attention", "ring_allreduce"]
 
 
+def _varying(a, *axes: Optional[str]):
+    """Type a fresh constant as device-varying over ``axes`` so it can seed
+    a loop carry that becomes varying (shard_map's varying-manual-axes
+    checker rejects unvarying→varying carries; the cast is free). ``None``
+    axes and axes ``a`` already varies over are skipped (pcast rejects
+    both). A carry must be cast over EVERY axis its updates vary on — e.g.
+    ring attention's (m, l, o) vary over the batch/head axes too as soon
+    as they combine with the sharded q block."""
+    if not hasattr(jax.lax, "pcast"):
+        return a
+    have = getattr(jax.typeof(a), "vma", ())
+    need = tuple(ax for ax in axes if ax is not None and ax not in have)
+    if not need:
+        return a
+    return jax.lax.pcast(a, need, to="varying")
+
+
 def _local_attn_update(q, k, v, m, l, o, scale, mask):
     """One flash-attention block update with blockwise softmax rescaling.
 
@@ -81,9 +98,13 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         my = jax.lax.axis_index(axis)
         q_pos = my * S + jnp.arange(S)
 
-        m0 = jnp.full((B, H, S), -jnp.inf, q_blk.dtype)
-        l0 = jnp.zeros((B, H, S), q_blk.dtype)
-        o0 = jnp.zeros_like(q_blk)
+        # carries combine with the sharded q block, so they vary over the
+        # batch/head axes too when those are set — cast over all of them
+        m0 = _varying(jnp.full((B, H, S), -jnp.inf, q_blk.dtype),
+                      axis, batch_axis, head_axis)
+        l0 = _varying(jnp.zeros((B, H, S), q_blk.dtype),
+                      axis, batch_axis, head_axis)
+        o0 = _varying(jnp.zeros_like(q_blk), axis, batch_axis, head_axis)
 
         def step(i, carry):
             k_cur, v_cur, m, l, o = carry
@@ -103,12 +124,8 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         return o / l_safe.transpose(0, 2, 1)[..., None]
 
     spec = P(batch_axis, axis, head_axis, None)
-    # check_vma=False: the (m, l, o) fori_loop carries start as unvarying
-    # constants and become device-varying after the first update — a pattern
-    # the varying-manual-axes checker cannot type without explicit pcasts
     fn = shard_map(shard_fn, mesh=mesh.mesh,
-                   in_specs=(spec, spec, spec), out_specs=spec,
-                   check_vma=False)
+                   in_specs=(spec, spec, spec), out_specs=spec)
     return fn(q, k, v)
 
 
@@ -152,7 +169,7 @@ def ring_allreduce(x: jax.Array, mesh: DeviceMesh,
         owned = (me + 1) % n
 
         # all-gather: rotate each fully-reduced chunk around the ring
-        out = jnp.zeros_like(chunks)
+        out = _varying(jnp.zeros_like(chunks), ax)
         cur, idx = buf, owned
         out = out.at[idx].set(cur)
         for _ in range(n - 1):
@@ -166,5 +183,5 @@ def ring_allreduce(x: jax.Array, mesh: DeviceMesh,
         return full.reshape(blk.shape)
 
     fn = shard_map(shard_fn, mesh=mesh.mesh,
-                   in_specs=P(ax), out_specs=P(ax), check_vma=False)
+                   in_specs=P(ax), out_specs=P(ax))
     return fn(x)

@@ -186,3 +186,82 @@ def test_daggregate_validation(mesh8):
     dist = par.distribute(df, mesh8)
     with pytest.raises(InputNotFoundError, match="not consumed"):
         par.daggregate({"x": "sum"}, dist, "key")
+
+
+def test_daggregate_generic_computation_matches_host(mesh8):
+    # An arbitrary (non-monoid) algebraic reduce — the UDAF-inside-the-
+    # shuffle contract (reference DebugRowOps.scala:587-681) on the mesh:
+    # L2-norm accumulation over scalar and vector columns.
+    import jax.numpy as jnp
+    from tensorframes_tpu.engine import ops as engine_ops
+
+    rng = np.random.default_rng(21)
+    n = 500
+    key = rng.integers(0, 13, n).astype(np.int64)
+    v = rng.normal(size=n)
+    w = rng.normal(size=(n, 3))
+    df = tft.analyze(tft.frame({"k": key, "v": v, "w": w},
+                               num_partitions=4))
+
+    def fetch(v_input, w_input):
+        return {"v": jnp.sqrt((v_input ** 2).sum(0)),
+                "w": jnp.sqrt((w_input ** 2).sum(0))}
+
+    host = engine_ops.aggregate(fetch, df.group_by("k"))
+    dist = par.distribute(df, mesh8)
+    out = par.daggregate(fetch, dist, "k")
+    h = {r["k"]: (r["v"], r["w"]) for r in host.collect()}
+    m = {r["k"]: (r["v"], r["w"]) for r in out.collect()}
+    assert set(h) == set(m)
+    for k in h:
+        np.testing.assert_allclose(h[k][0], m[k][0], rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(h[k][1]),
+                                   np.asarray(m[k][1]), rtol=1e-6)
+
+
+def test_daggregate_generic_single_row_groups(mesh8):
+    # Single-row groups must still see one application of the computation
+    # (host CompactionBuffer.evaluate always applies it): sqrt(x^2) = |x|
+    # distinguishes "raw row passed through" from "computation applied".
+    import jax.numpy as jnp
+    from tensorframes_tpu.engine import ops as engine_ops
+
+    key = np.arange(10, dtype=np.int64)     # every group has exactly 1 row
+    x = np.linspace(-5, 4, 10)
+
+    def fetch(x_input):
+        return {"x": jnp.sqrt((x_input ** 2).sum(0))}
+
+    df = tft.frame({"k": key, "x": x})
+    host = engine_ops.aggregate(fetch, df.group_by("k"))
+    dist = par.distribute(df, mesh8)
+    out = par.daggregate(fetch, dist, "k")
+    h = {r["k"]: r["x"] for r in host.collect()}
+    m = {r["k"]: r["x"] for r in out.collect()}
+    assert h == pytest.approx(m)
+    assert m[0] == pytest.approx(5.0)  # |−5|, not −5
+
+
+def test_daggregate_generic_multi_key_pad_rows(mesh8):
+    import jax.numpy as jnp
+    from tensorframes_tpu.engine import ops as engine_ops
+
+    rng = np.random.default_rng(22)
+    n = 30                                   # pads to 32 on 8 shards
+    k1 = rng.integers(0, 3, n).astype(np.int64)
+    k2 = rng.integers(0, 2, n).astype(np.int64)
+    x = rng.normal(size=n)
+
+    def fetch(x_input):
+        return {"x": jnp.sqrt((x_input ** 2).sum(0))}
+
+    df = tft.frame({"k1": k1, "k2": k2, "x": x})
+    host = engine_ops.aggregate(fetch, df.group_by("k1", "k2"))
+    dist = par.distribute(df, mesh8)
+    assert dist.padded_rows == 32
+    out = par.daggregate(fetch, dist, ["k1", "k2"])
+    h = {(r["k1"], r["k2"]): r["x"] for r in host.collect()}
+    m = {(r["k1"], r["k2"]): r["x"] for r in out.collect()}
+    assert set(h) == set(m)
+    for k in h:
+        np.testing.assert_allclose(h[k], m[k], rtol=1e-6)
