@@ -1,0 +1,84 @@
+"""daggregate at scale: 1M rows x 100k groups (VERDICT round-2 weak #5/#8).
+
+Measures the mesh keyed-aggregation path at a group count where the
+reference's driver-side groupBy (and our host key-factorization path) is
+dominated by key transfer + host sort, and compares the device-side key
+path (``max_groups=``), where keys never leave the mesh.
+
+Prints one JSON line per variant. Runs on whatever backend is live
+(8-virtual-CPU mesh for relative numbers; the real chip for BASELINE.md).
+
+Run:  [JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8]
+      python benchmarks/daggregate_bench.py [n_rows] [n_groups]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    import jax
+
+    # this image's sitecustomize re-registers the TPU platform via
+    # jax.config at interpreter start, overriding the env var — force it
+    # back when the caller asked for CPU
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+    n_rows = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+    n_groups = int(sys.argv[2]) if len(sys.argv) > 2 else 100_000
+
+    import tensorframes_tpu as tft
+    from tensorframes_tpu import parallel as par
+
+    rng = np.random.default_rng(7)
+    # int (device-exact) keys: long would narrow to i32 with x64 off
+    key = rng.integers(0, n_groups, n_rows).astype(np.int32)
+    x = rng.standard_normal(n_rows)
+    df = tft.frame({"k": key, "x": x})
+    mesh = par.local_mesh()
+    dist = par.distribute(df, mesh)
+    platform = jax.devices()[0].platform
+
+    def timed(fn, iters=3):
+        fn()  # compile/warm
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            r = fn()
+        return (time.perf_counter() - t0) / iters, r
+
+    sec_host, out_h = timed(
+        lambda: par.daggregate({"x": "sum"}, dist, "k"))
+    sec_dev, out_d = timed(
+        lambda: par.daggregate({"x": "sum"}, dist, "k",
+                               max_groups=n_groups + 8))
+
+    # parity spot-check between the two paths
+    h = {r["k"]: r["x"] for r in out_h.collect()}
+    d = {r["k"]: r["x"] for r in out_d.collect()}
+    assert set(h) == set(d)
+    some = list(h)[:100]
+    for k in some:
+        assert np.isclose(h[k], d[k], rtol=1e-9), k
+
+    for name, sec in (("host_keys", sec_host), ("device_keys", sec_dev)):
+        print(json.dumps({
+            "metric": f"daggregate_sum_{n_rows}x{n_groups}_{name}",
+            "value": round(sec, 4), "unit": "s/call",
+            "rows_per_s": round(n_rows / sec, 1),
+            "platform": platform,
+            "n_shards": mesh.num_data_shards,
+        }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -31,11 +31,16 @@
 #include <string_view>
 #include <vector>
 
+#include <mutex>
+
 #include "xla/pjrt/pjrt_client.h"
 #include "xla/pjrt/pjrt_executable.h"
 #include "xla/pjrt/plugin/xla_cpu/xla_cpu_pjrt_client.h"
 #include "xla/hlo/builder/xla_computation.h"
 #include "xla/pjrt/c/pjrt_c_api.h"
+#include "xla/shape.h"
+#include "xla/shape_util.h"
+#include "xla/service/hlo.pb.h"
 
 namespace xla {
 // Declared here to avoid mlir_to_hlo.h's LLVM header dependency; resolved
@@ -44,6 +49,58 @@ absl::Status ParseMlirModuleStringAndConvertToXlaComputation(
     std::string_view mlir_module_str, XlaComputation& xla_computation,
     bool use_tuple_args, bool return_tuple);
 }  // namespace xla
+
+// ---------------------------------------------------------------------------
+// ABI declarations for tensorflow::XlaCallModuleLoader (the jax.export /
+// XlaCallModule dynamic-shape loader in libtensorflow_cc) without the
+// LLVM/MLIR headers this environment does not ship. Only layout-stable
+// value types cross the boundary: llvm::StringRef and llvm::ArrayRef are
+// {pointer, size} pairs; mlir::MLIRContext is a single-unique_ptr pimpl
+// constructed through its exported out-of-line constructor.
+// ---------------------------------------------------------------------------
+
+namespace mlir {
+class MLIRContext {
+ public:
+  enum class Threading { DISABLED, ENABLED };
+  explicit MLIRContext(Threading t);
+  ~MLIRContext();
+
+ private:
+  void* impl_;  // stands in for std::unique_ptr<MLIRContextImpl>
+};
+}  // namespace mlir
+
+namespace llvm {
+class StringRef {
+ public:
+  StringRef(const char* d, size_t l) : data_(d), len_(l) {}
+  const char* data_;
+  size_t len_;
+};
+template <typename T>
+class ArrayRef {
+ public:
+  ArrayRef(const T* d, size_t l) : data_(d), len_(l) {}
+  const T* data_;
+  size_t len_;
+};
+}  // namespace llvm
+
+namespace tensorflow {
+class XlaCallModuleLoader {
+ public:
+  static absl::StatusOr<std::unique_ptr<XlaCallModuleLoader>> Create(
+      mlir::MLIRContext* context, int version, llvm::StringRef module_str,
+      std::vector<std::string> disabled_checks,
+      std::vector<std::string> platforms, int num_invocation_args,
+      bool main_has_token_input_output, bool use_shardy_partitioner);
+  absl::Status SetPlatformIndex(std::string_view compilation_platform);
+  absl::Status RefineDynamicShapes(llvm::ArrayRef<xla::Shape> input_shapes);
+  absl::Status ValidateStaticShapes();
+  absl::StatusOr<xla::XlaComputation> ToXlaComputation();
+};
+}  // namespace tensorflow
 
 namespace {
 
@@ -135,6 +192,10 @@ struct ClientIface {
   virtual int device_count() const = 0;
   virtual std::string platform() const = 0;
   virtual ExeIface* compile(std::string_view module, std::string* err) = 0;
+  // Compile a serialized xla.HloModuleProto (the output of the dynamic-
+  // shape refinement below).
+  virtual ExeIface* compile_hlo(const std::string& hlo_proto,
+                                std::string* err) = 0;
   virtual ResultsIface* execute(ExeIface* exe, int nargs, const int* dtypes,
                                 const int* ndims, const long long* dims,
                                 const void* const* data,
@@ -155,6 +216,60 @@ int dtype_size(int dt) {
     case TFR_PRED: return 1;
   }
   return 0;
+}
+
+xla::PrimitiveType to_xla_type(int dt);  // defined below
+
+// Refine a serialized jax.export StableHLO module (symbolic/dynamic dims)
+// at concrete argument shapes and lower it to a serialized HloModuleProto —
+// entirely in C++, no jax on the executing host. This is the executor-side
+// step the reference performed by parsing GraphDef bytes in libtensorflow
+// (TensorFlowOps.scala:46-52); here the shipped program is StableHLO and
+// the shape specialization runs TF's XlaCallModuleLoader refinement.
+absl::StatusOr<std::string> refine_to_hlo_proto(
+    std::string_view module_bytes, int cc_version,
+    const std::vector<std::string>& platforms,
+    const std::string& select_platform, int nargs, const int* dtypes,
+    const int* ndims, const long long* dims) {
+  // one context + one refinement at a time: the loader mutates the module
+  // and MLIR contexts are not cheap; serialize access behind a mutex
+  static std::mutex mu;
+  static mlir::MLIRContext* ctx = new mlir::MLIRContext(
+      mlir::MLIRContext::Threading::DISABLED);
+  std::lock_guard<std::mutex> lock(mu);
+
+  auto loader_or = tensorflow::XlaCallModuleLoader::Create(
+      ctx, cc_version,
+      llvm::StringRef(module_bytes.data(), module_bytes.size()),
+      /*disabled_checks=*/{}, platforms, /*num_invocation_args=*/nargs,
+      /*main_has_token_input_output=*/false,
+      /*use_shardy_partitioner=*/false);
+  if (!loader_or.ok()) return loader_or.status();
+  // Intentionally released, never deleted: the stub declaration above has
+  // no destructor knowledge, and callers cache the compiled executable per
+  // signature, so the leak is one module-sized object per native compile.
+  tensorflow::XlaCallModuleLoader* loader = loader_or.value().release();
+  if (platforms.size() > 1) {
+    auto st = loader->SetPlatformIndex(select_platform);
+    if (!st.ok()) return st;
+  }
+  std::vector<xla::Shape> shapes;
+  const long long* d = dims;
+  for (int a = 0; a < nargs; ++a) {
+    std::vector<int64_t> shp(d, d + ndims[a]);
+    d += ndims[a];
+    shapes.push_back(xla::ShapeUtil::MakeShape(
+        to_xla_type(dtypes[a]),
+        absl::Span<const int64_t>(shp.data(), shp.size())));
+  }
+  auto st = loader->RefineDynamicShapes(
+      llvm::ArrayRef<xla::Shape>(shapes.data(), shapes.size()));
+  if (!st.ok()) return st;
+  st = loader->ValidateStaticShapes();
+  if (!st.ok()) return st;
+  auto xc_or = loader->ToXlaComputation();
+  if (!xc_or.ok()) return xc_or.status();
+  return xc_or.value().proto().SerializeAsString();
 }
 
 // ---------------------------------------------------------------------------
@@ -237,6 +352,20 @@ struct CppClient : ClientIface {
     auto st = xla::ParseMlirModuleStringAndConvertToXlaComputation(
         module, xc, /*use_tuple_args=*/false, /*return_tuple=*/false);
     if (!st.ok()) { *err = st.ToString(); return nullptr; }
+    return compile_xla(std::move(xc), err);
+  }
+
+  ExeIface* compile_hlo(const std::string& hlo_proto,
+                        std::string* err) override {
+    xla::HloModuleProto proto;
+    if (!proto.ParseFromString(hlo_proto)) {
+      *err = "HloModuleProto parse failed";
+      return nullptr;
+    }
+    return compile_xla(xla::XlaComputation(std::move(proto)), err);
+  }
+
+  ExeIface* compile_xla(xla::XlaComputation xc, std::string* err) {
     xla::CompileOptions opts;
     auto exe_or = client->CompileAndLoad(xc, opts);
     if (!exe_or.ok()) { *err = exe_or.status().ToString(); return nullptr; }
@@ -509,14 +638,23 @@ struct CApiClient : ClientIface {
   }
 
   ExeIface* compile(std::string_view module, std::string* err) override {
+    return compile_fmt(module, "mlir", err);
+  }
+
+  ExeIface* compile_hlo(const std::string& hlo_proto,
+                        std::string* err) override {
+    return compile_fmt(hlo_proto, "hlo", err);
+  }
+
+  ExeIface* compile_fmt(std::string_view module, const char* format,
+                        std::string* err) {
     PJRT_Program prog;
     std::memset(&prog, 0, sizeof(prog));
     prog.struct_size = PJRT_Program_STRUCT_SIZE;
     prog.code = const_cast<char*>(module.data());
     prog.code_size = module.size();
-    static const char kFormat[] = "mlir";
-    prog.format = kFormat;
-    prog.format_size = sizeof(kFormat) - 1;
+    prog.format = format;
+    prog.format_size = std::strlen(format);
 
     PJRT_Client_Compile_Args ca;
     std::memset(&ca, 0, sizeof(ca));
@@ -738,6 +876,41 @@ tfr_pjrt_exe* tfr_pjrt_compile(tfr_pjrt_client* c, const char* module_bytes,
   ExeIface* e = c->impl->compile(
       std::string_view(module_bytes, static_cast<size_t>(module_len)),
       &errmsg);
+  if (!e) {
+    set_err(err, errlen, errmsg);
+    return nullptr;
+  }
+  auto* out = new tfr_pjrt_exe();
+  out->impl.reset(e);
+  return out;
+}
+
+tfr_pjrt_exe* tfr_pjrt_compile_dynamic(
+    tfr_pjrt_client* c, const char* module_bytes, long module_len,
+    int cc_version, const char* platforms_csv, const char* select_platform,
+    int nargs, const int* dtypes, const int* ndims, const long long* dims,
+    char* err, int errlen) {
+  std::vector<std::string> platforms;
+  std::string csv(platforms_csv ? platforms_csv : "");
+  size_t pos = 0;
+  while (pos <= csv.size() && !csv.empty()) {
+    auto comma = csv.find(',', pos);
+    platforms.push_back(csv.substr(
+        pos, comma == std::string::npos ? std::string::npos : comma - pos));
+    if (comma == std::string::npos) break;
+    pos = comma + 1;
+  }
+  auto hlo_or = refine_to_hlo_proto(
+      std::string_view(module_bytes, static_cast<size_t>(module_len)),
+      cc_version, platforms,
+      std::string(select_platform ? select_platform : ""), nargs, dtypes,
+      ndims, dims);
+  if (!hlo_or.ok()) {
+    set_err(err, errlen, hlo_or.status().ToString());
+    return nullptr;
+  }
+  std::string errmsg;
+  ExeIface* e = c->impl->compile_hlo(hlo_or.value(), &errmsg);
   if (!e) {
     set_err(err, errlen, errmsg);
     return nullptr;

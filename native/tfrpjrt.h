@@ -58,6 +58,21 @@ int tfr_pjrt_client_platform(tfr_pjrt_client* c, char* out, int outlen);
 /* Compile a StableHLO module (text or MLIR bytecode). */
 tfr_pjrt_exe* tfr_pjrt_compile(tfr_pjrt_client* c, const char* module_bytes,
                                long module_len, char* err, int errlen);
+
+/* Compile a DYNAMIC-shape serialized StableHLO module (the jax.export
+ * wire format with symbolic dims) at the given concrete argument shapes:
+ * shape refinement + lowering to HLO happen natively, so the executing
+ * host needs no jax. cc_version is the module's calling-convention
+ * version; platforms_csv lists the platforms it was lowered for (comma
+ * separated, in order) and select_platform picks this host's entry when
+ * there is more than one. dtypes/ndims/dims describe the argument shapes
+ * exactly as in tfr_pjrt_execute. */
+tfr_pjrt_exe* tfr_pjrt_compile_dynamic(
+    tfr_pjrt_client* c, const char* module_bytes, long module_len,
+    int cc_version, const char* platforms_csv, const char* select_platform,
+    int nargs, const int* dtypes, const int* ndims, const long long* dims,
+    char* err, int errlen);
+
 void tfr_pjrt_exe_destroy(tfr_pjrt_exe* e);
 
 /* Execute on the client's device (ordinal "tfr_device" from the spec;

@@ -241,23 +241,47 @@ class Computation:
 
     # -- serialization (StableHLO via jax.export) --------------------------
     def serialize(self) -> bytes:
-        """Serialize to portable bytes: a JSON header (names/dtypes/shapes)
-        + the StableHLO module from ``jax.export`` with symbolic dims for
-        Unknowns. The analogue of ``GraphDef.SerializeToString`` +
-        ``ShapeDescription`` travelling together."""
+        """Serialize to portable bytes: a JSON header (names/dtypes/shapes
+        + native-execution metadata) + the raw StableHLO module (symbolic
+        dims for Unknowns) + the full ``jax.export`` blob. The analogue of
+        ``GraphDef.SerializeToString`` + ``ShapeDescription`` travelling
+        together.
+
+        The raw module section is what a jax-free executor host needs: the
+        native core refines its symbolic dims at concrete shapes and
+        compiles it without re-entering jax
+        (``native/pjrt_core.cpp:refine_to_hlo_proto``; the reference's
+        executors likewise ran shipped GraphDef bytes with no Python
+        graph-authoring stack, ``TensorFlowOps.scala:46-52``). Lowered for
+        both cpu and tpu so one blob runs on either host kind.
+        """
         avals, _ = _sym_avals(self.inputs, share_lead_symbol=True)
         names = self.input_names
 
         def flat_fn(*args):
             return self._fn(dict(zip(names, args)))
 
-        exported = jax_export.export(jax.jit(flat_fn))(*avals)
+        jitted = jax.jit(flat_fn)
+        try:
+            exported = jax_export.export(
+                jitted, platforms=("cpu", "tpu"))(*avals)
+        except Exception:
+            # a computation that cannot lower for one of the platforms
+            # still serializes for the local one (jax-path only)
+            exported = jax_export.export(jitted)(*avals)
+        module = exported.mlir_module_serialized
         blob = exported.serialize()
         header = json.dumps({
             "inputs": [s.to_json() for s in self.inputs],
             "outputs": [s.to_json() for s in self.outputs],
+            "native": {
+                "cc_version": exported.calling_convention_version,
+                "platforms": list(exported.platforms),
+                "module_len": len(module),
+            },
         }).encode("utf-8")
-        return _MAGIC + struct.pack("<I", len(header)) + header + blob
+        return (_MAGIC + struct.pack("<I", len(header)) + header
+                + module + blob)
 
     @staticmethod
     def deserialize(data: bytes) -> "Computation":
@@ -267,7 +291,19 @@ class Computation:
         (hlen,) = struct.unpack_from("<I", data, off)
         off += 4
         header = json.loads(data[off:off + hlen].decode("utf-8"))
-        blob = data[off + hlen:]
+        payload = data[off + hlen:]
+        native = header.get("native")
+        native_dynamic = None
+        if native:
+            mlen = native["module_len"]
+            native_dynamic = {
+                "module": payload[:mlen],
+                "cc_version": native["cc_version"],
+                "platforms": tuple(native["platforms"]),
+            }
+            blob = payload[mlen:]
+        else:  # pre-native blobs: jax.export payload only
+            blob = payload
         exported = jax_export.deserialize(blob)
         inputs = [TensorSpec.from_json(d) for d in header["inputs"]]
         outputs = [TensorSpec.from_json(d) for d in header["outputs"]]
@@ -284,7 +320,11 @@ class Computation:
                 return dict(zip(out_names, res))
             return {out_names[0]: res}
 
-        return Computation(dict_fn, inputs, outputs)
+        comp = Computation(dict_fn, inputs, outputs)
+        # the raw dynamic module lets the native core compile this
+        # computation per signature without re-entering jax
+        comp._native_dynamic = native_dynamic
+        return comp
 
 
 def _keyword_only_names(fn: Callable) -> frozenset:

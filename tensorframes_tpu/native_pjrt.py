@@ -93,6 +93,11 @@ def _load() -> Optional[ctypes.CDLL]:
     lib.tfr_pjrt_compile.argtypes = [vp, ctypes.c_char_p, ctypes.c_long,
                                      ctypes.c_char_p, ci]
     lib.tfr_pjrt_compile.restype = vp
+    lib.tfr_pjrt_compile_dynamic.argtypes = [
+        vp, ctypes.c_char_p, ctypes.c_long, ci, ctypes.c_char_p,
+        ctypes.c_char_p, ci, ctypes.POINTER(ci), ctypes.POINTER(ci),
+        ctypes.POINTER(cll), ctypes.c_char_p, ci]
+    lib.tfr_pjrt_compile_dynamic.restype = vp
     lib.tfr_pjrt_exe_destroy.argtypes = [vp]
     lib.tfr_pjrt_execute.argtypes = [vp, vp, ci, ctypes.POINTER(ci),
                                      ctypes.POINTER(ci),
@@ -204,6 +209,44 @@ class PjrtCoreClient:
                 f"compile failed: {err.value.decode(errors='replace')}")
         return PjrtExecutable(self, h)
 
+    def compile_dynamic(self, module: bytes, cc_version: int, platforms,
+                        arg_dtypes, arg_shapes) -> "PjrtExecutable":
+        """Compile a serialized dynamic-shape module (jax.export wire
+        format) at concrete shapes: refinement happens in the native core,
+        no jax involved. ``arg_dtypes``: numpy dtypes; ``arg_shapes``:
+        tuples."""
+        n = len(arg_dtypes)
+        dtypes = (ctypes.c_int * n)()
+        ndims = (ctypes.c_int * n)()
+        flat = []
+        for i, (dt, shp) in enumerate(zip(arg_dtypes, arg_shapes)):
+            dt = np.dtype(dt)
+            code = _CODES.get(dt)
+            if code is None:
+                if dt == _dt.bfloat16.np_storage:
+                    code = _BF16_CODE
+                else:
+                    raise PjrtCoreError(f"unsupported input dtype {dt}")
+            dtypes[i] = code
+            ndims[i] = len(shp)
+            flat.extend(shp)
+        dims = (ctypes.c_longlong * max(1, len(flat)))(*flat)
+        select = self.platform
+        if select not in platforms and platforms:
+            raise PjrtCoreError(
+                f"computation was lowered for {platforms}, not for this "
+                f"client's platform {select!r}")
+        err = ctypes.create_string_buffer(_ERRLEN)
+        h = self._lib.tfr_pjrt_compile_dynamic(
+            self._client, module, len(module), cc_version,
+            ",".join(platforms).encode(), select.encode(), n, dtypes,
+            ndims, dims, err, _ERRLEN)
+        if not h:
+            raise PjrtCoreError(
+                f"dynamic compile failed: "
+                f"{err.value.decode(errors='replace')}")
+        return PjrtExecutable(self, h)
+
     def close(self):
         if self._client:
             self._lib.tfr_pjrt_client_destroy(self._client)
@@ -294,11 +337,14 @@ class PjrtExecutable:
 
 def _lower_stablehlo(comp: Computation, arrays: Mapping[str, np.ndarray],
                      in_names, out_names) -> bytes:
-    """Lower the computation at these concrete shapes to StableHLO text.
+    """Lower a LIVE computation at these concrete shapes to StableHLO text.
 
     The driver-side authoring step (the reference built a GraphDef with real
     TF in Python, ``core.py:37-40``); jax is used for *tracing only* — the
-    compile and every execution happen in the native core.
+    compile and every execution happen in the native core. Deserialized
+    computations never come through here: their raw dynamic module is
+    refined and compiled natively (``PjrtCoreClient.compile_dynamic``), so
+    an executing host needs no jax at all.
     """
     import jax
 
@@ -312,13 +358,22 @@ def _lower_stablehlo(comp: Computation, arrays: Mapping[str, np.ndarray],
     text = str(lowered.compiler_ir("stablehlo")).encode()
     if b"?" not in text:
         return text
-    # Deserialized (jax.export) computations carry symbolic inner dims; the
-    # main function is static here, so the StableHLO refinement pass makes
-    # the whole module static before it reaches the native compiler.
-    from jax._src.lib import _jax as _jaxlib
+    # Legacy fallback only: blobs serialized before the raw-module section
+    # existed deserialize with symbolic inner dims and no _native_dynamic;
+    # refine them through jaxlib if it still exposes the pass. New blobs
+    # never reach this (they compile via compile_dynamic, jax-free).
+    try:
+        from jax._src.lib import _jax as _jaxlib
 
-    return _jaxlib.mlir.refine_polymorphic_shapes(
-        text, enable_shape_assertions=True, validate_static_shapes=True)
+        return _jaxlib.mlir.refine_polymorphic_shapes(
+            text, enable_shape_assertions=True,
+            validate_static_shapes=True)
+    except (ImportError, AttributeError) as e:
+        raise PjrtCoreError(
+            "this computation carries symbolic dims but no raw dynamic "
+            "module (a pre-native serialized blob) and this jax exposes "
+            f"no refinement pass ({e}); re-serialize it with a current "
+            "authoring host") from e
 
 
 class PjrtBlockExecutor:
@@ -366,9 +421,18 @@ class PjrtBlockExecutor:
                 per_comp = self._cache.setdefault(comp, {})
                 exe = per_comp.get(sig)
                 if exe is None:
-                    hlo = _lower_stablehlo(comp, dev_arrays, in_names,
-                                           out_names)
-                    exe = self.client.compile(hlo)
+                    dyn = getattr(comp, "_native_dynamic", None)
+                    if dyn:
+                        # shipped computation: refine + compile natively
+                        exe = self.client.compile_dynamic(
+                            dyn["module"], dyn["cc_version"],
+                            dyn["platforms"],
+                            [dev_arrays[n].dtype for n in in_names],
+                            [dev_arrays[n].shape for n in in_names])
+                    else:
+                        hlo = _lower_stablehlo(comp, dev_arrays, in_names,
+                                               out_names)
+                        exe = self.client.compile(hlo)
                     per_comp[sig] = exe
                     self.compile_count += 1
                     _log.debug("native compile #%d for %s",

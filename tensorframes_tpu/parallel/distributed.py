@@ -24,6 +24,7 @@ collectives ride ICI within a slice and DCN across slices.
 
 from __future__ import annotations
 
+import functools
 from typing import Dict, Mapping, Optional
 
 import jax
@@ -407,7 +408,58 @@ def _host_group_ids(dist: DistributedFrame, keys):
     return ids_dev, fact.uniques, fact.num_groups
 
 
-def daggregate(fetches, dist: DistributedFrame, keys) -> TensorFrame:
+def _device_group_ids(dist: DistributedFrame, key: str, max_groups: int):
+    """Dense group ids computed ON DEVICE for a single integer key column.
+
+    The host-factorization path ships the whole key column driver-side per
+    call (the reference's Catalyst groupBy did the same in the JVM,
+    ``DebugRowOps.scala:533-578``); at 100k+ groups that transfer and the
+    host lexsort dominate. Here the key column never leaves the mesh: a
+    device sort-unique (``jnp.unique`` with a static size cap) builds the
+    group table and a ``searchsorted`` maps rows to ids — XLA inserts the
+    cross-shard gather for the sort, which IS the shuffle, on ICI.
+
+    ``max_groups`` caps the static table size (XLA needs static shapes).
+    Returns ``(ids_dev [padded] int32 row-sharded, uniques_dev
+    [max_groups+1], count_dev scalar)`` — ids are ``-1`` for pad rows;
+    overflowing the cap raises at the call site after the count readback.
+    """
+    kcol = dist.columns[key]
+    mesh = dist.mesh
+    if not jnp.issubdtype(kcol.dtype, jnp.integer):
+        raise _ops.InvalidTypeError(
+            f"device-side aggregation needs an integer key column; {key!r} "
+            f"is {kcol.dtype} (use the host path)")
+    valid_host = dist.valid_row_mask()
+    valid = jax.make_array_from_callback(
+        (dist.padded_rows,), mesh.row_sharding(1),
+        lambda idx: valid_host[idx])
+    ids, uniq, count, sentinel_hit = _build_device_ids(kcol, valid,
+                                                       max_groups)
+    if bool(sentinel_hit):
+        raise _ops.InvalidTypeError(
+            f"key column {key!r} contains the dtype's max value, which the "
+            f"device path reserves as its pad sentinel; use the host path "
+            f"(max_groups=None) for such keys")
+    return ids, uniq, count
+
+
+@functools.partial(jax.jit, static_argnums=(2,))
+def _build_device_ids(kc, vm, max_groups: int):
+    """Sort-unique group table + per-row dense ids, one compiled program
+    (module-level jit: re-invocations with the same shapes/cap reuse it)."""
+    sentinel = jnp.iinfo(kc.dtype).max
+    sentinel_hit = jnp.any(vm & (kc == sentinel))
+    masked = jnp.where(vm, kc, sentinel)
+    uniq = jnp.unique(masked, size=max_groups + 1, fill_value=sentinel)
+    count = jnp.sum(uniq != sentinel)
+    ids = jnp.searchsorted(uniq, masked).astype(jnp.int32)
+    ids = jnp.where(vm, ids, -1)
+    return ids, uniq, count, sentinel_hit
+
+
+def daggregate(fetches, dist: DistributedFrame, keys,
+               max_groups: Optional[int] = None) -> TensorFrame:
     """Mesh-distributed keyed aggregation.
 
     The reference's Catalyst shuffle + UDAF (``DebugRowOps.scala:533-681``)
@@ -436,6 +488,12 @@ def daggregate(fetches, dist: DistributedFrame, keys) -> TensorFrame:
     ``keys``: key column name or list of names. Returns a host
     :class:`TensorFrame` of one row per group (keys + fetches, fetches
     sorted by name), like :func:`~tensorframes_tpu.api.aggregate`.
+
+    ``max_groups``: opt into DEVICE-side group ids for a single integer
+    key (``_device_group_ids``): the key column never visits the host —
+    at 100k+ groups the host path's driver-side transfer + lexsort
+    dominate (``benchmarks/daggregate_bench.py`` measures both). The
+    value caps the static group-table size; exceeding it raises.
     """
     if isinstance(keys, str):
         keys = [keys]
@@ -446,6 +504,11 @@ def daggregate(fetches, dist: DistributedFrame, keys) -> TensorFrame:
             raise KeyError(f"No key column {k!r}; columns: {schema.names}")
     if not (isinstance(fetches, Mapping) and fetches and all(
             isinstance(v, str) for v in fetches.values())):
+        if max_groups is not None:
+            raise ValueError(
+                "max_groups= (device-side keys) currently applies to the "
+                "monoid combiner path; arbitrary computations use host "
+                "key factorization")
         return _generic_daggregate(fetches, dist, keys)
     col_combiners = fetches
 
@@ -461,7 +524,18 @@ def daggregate(fetches, dist: DistributedFrame, keys) -> TensorFrame:
     if n == 0:
         raise ValueError("aggregate on an empty distributed frame")
 
-    ids_dev, uniques, num_groups = _host_group_ids(dist, keys)
+    device_keys = max_groups is not None
+    if device_keys:
+        if len(keys) != 1:
+            raise _ops.InvalidTypeError(
+                "device-side aggregation (max_groups=) supports a single "
+                "key column; composite keys take the host path")
+        ids_dev, uniq_dev, count_dev = _device_group_ids(
+            dist, keys[0], max_groups)
+        num_groups = max_groups + 1  # static cap incl the sentinel slot
+        uniques = None
+    else:
+        ids_dev, uniques, num_groups = _host_group_ids(dist, keys)
 
     fetch_names = sorted(col_combiners)
     arrays = [dist.columns[f] for f in fetch_names]
@@ -499,9 +573,24 @@ def daggregate(fetches, dist: DistributedFrame, keys) -> TensorFrame:
                            in_specs=in_specs, out_specs=out_specs))
     tables = fn(ids_dev, *arrays)
 
-    cols: Dict[str, np.ndarray] = {k: u for k, u in zip(keys, uniques)}
+    if device_keys:
+        count = int(count_dev)
+        if count > max_groups:
+            raise ValueError(
+                f"more than max_groups={max_groups} distinct keys in "
+                f"{keys[0]!r}; raise max_groups (the static table cap)")
+        kfld = schema[keys[0]]
+        kvals = np.asarray(uniq_dev)[:count]
+        if kvals.dtype != kfld.dtype.np_storage \
+                and kfld.dtype is not _dt.bfloat16:
+            kvals = kvals.astype(kfld.dtype.np_storage)
+        cols: Dict[str, np.ndarray] = {keys[0]: kvals}
+        num_out = count
+    else:
+        cols = {k: u for k, u in zip(keys, uniques)}
+        num_out = num_groups
     for f, t in zip(fetch_names, tables):
-        v = np.asarray(t)
+        v = np.asarray(t)[:num_out]
         fld = schema[f]
         if v.dtype != fld.dtype.np_storage and fld.dtype is not _dt.bfloat16:
             v = v.astype(fld.dtype.np_storage)
@@ -514,7 +603,7 @@ def daggregate(fetches, dist: DistributedFrame, keys) -> TensorFrame:
                            if schema[f].block_shape is not None else None),
               sql_rank=schema[f].sql_rank)
         for f in fetch_names]
-    return TensorFrame.from_blocks([Block(cols, num_groups)],
+    return TensorFrame.from_blocks([Block(cols, num_out)],
                                    Schema(out_fields))
 
 

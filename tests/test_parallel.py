@@ -265,3 +265,40 @@ def test_daggregate_generic_multi_key_pad_rows(mesh8):
     assert set(h) == set(m)
     for k in h:
         np.testing.assert_allclose(h[k], m[k], rtol=1e-6)
+
+
+def test_daggregate_device_keys_matches_host_path(mesh8):
+    rng = np.random.default_rng(31)
+    n = 400
+    key = rng.integers(0, 37, n).astype(np.int64)
+    x = rng.normal(size=n)
+    v = rng.normal(size=(n, 2))
+    df = tft.frame({"k": key, "x": x, "v": v})
+    dist = par.distribute(df, mesh8)
+    host_out = par.daggregate({"x": "sum", "v": "max"}, dist, "k")
+    dev_out = par.daggregate({"x": "sum", "v": "max"}, dist, "k",
+                             max_groups=64)
+    h = {r["k"]: (r["x"], r["v"]) for r in host_out.collect()}
+    d = {r["k"]: (r["x"], r["v"]) for r in dev_out.collect()}
+    assert set(h) == set(d)
+    for k in h:
+        np.testing.assert_allclose(h[k][0], d[k][0], rtol=1e-9)
+        np.testing.assert_allclose(np.asarray(h[k][1]),
+                                   np.asarray(d[k][1]), rtol=1e-9)
+
+
+def test_daggregate_device_keys_cap_overflow_raises(mesh8):
+    df = tft.frame({"k": np.arange(20, dtype=np.int64),
+                    "x": np.ones(20)})
+    dist = par.distribute(df, mesh8)
+    with pytest.raises(ValueError, match="max_groups"):
+        par.daggregate({"x": "sum"}, dist, "k", max_groups=10)
+
+
+def test_daggregate_device_keys_pad_rows_excluded(mesh8):
+    # 10 rows pad to 16; pad rows must not form a phantom group
+    df = tft.frame({"k": np.zeros(10, np.int64), "x": np.ones(10)})
+    dist = par.distribute(df, mesh8)
+    out = par.daggregate({"x": "sum"}, dist, "k", max_groups=4)
+    rows = out.collect()
+    assert len(rows) == 1 and rows[0]["x"] == 10.0 and rows[0]["k"] == 0
