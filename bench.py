@@ -78,6 +78,21 @@ def _child(platform: str) -> None:
         jax.block_until_ready(out.columns["z"])
     ours = N_ROWS / ((time.perf_counter() - t0) / ITERS)
 
+    # end-to-end including host<->device marshalling each iteration (the
+    # reference's acknowledged weak spot, DataOps.scala:30-33): columnar
+    # host frame -> device -> compute -> back to host
+    t0 = time.perf_counter()
+    for _ in range(3):
+        d2 = distribute(df, mesh)
+        o2 = dmap_blocks(comp, d2, trim=True)
+        np.asarray(o2.columns["z"])
+    e2e = N_ROWS / ((time.perf_counter() - t0) / 3)
+
+    # which executor backs the engine path (native C++ core vs in-process
+    # jax) — evidence for BASELINE.md, not part of the measured loop above
+    from tensorframes_tpu.engine.executor import default_executor
+    executor = type(default_executor()).__name__
+
     # reference structure: Rows materialized in and out per block
     schema = df.schema
     t0 = time.perf_counter()
@@ -95,6 +110,9 @@ def _child(platform: str) -> None:
         "vs_baseline": round(ours / ref, 2),
         "platform": jax.default_backend(),
         "n_chips": n_chips,
+        "e2e_with_marshalling_rows_per_s": round(e2e, 1),
+        "row_path_rows_per_s": round(ref, 1),
+        "executor": executor,
     }))
 
 

@@ -32,6 +32,9 @@ from jax import export as jax_export
 
 from . import dtypes as _dt
 from .shape import Shape, Unknown
+from .utils.logging import get_logger
+
+_log = get_logger("computation")
 
 __all__ = [
     "TensorSpec",
@@ -265,9 +268,15 @@ class Computation:
         try:
             exported = jax_export.export(
                 jitted, platforms=("cpu", "tpu"))(*avals)
-        except Exception:
+        except Exception as e:
             # a computation that cannot lower for one of the platforms
-            # still serializes for the local one (jax-path only)
+            # still serializes for the local one (jax-path only); leave a
+            # breadcrumb — the executor-side error ("lowered for (...)")
+            # is far from this root cause otherwise
+            _log.warning(
+                "dual-platform (cpu,tpu) export failed (%s: %s); "
+                "serializing for the local platform only", type(e).__name__,
+                e)
             exported = jax_export.export(jitted)(*avals)
         module = exported.mlir_module_serialized
         blob = exported.serialize()
@@ -278,6 +287,9 @@ class Computation:
                 "cc_version": exported.calling_convention_version,
                 "platforms": list(exported.platforms),
                 "module_len": len(module),
+                # the TRACED argument dtypes (x64-policy-dependent): what
+                # the module's parameters actually are, for jax-free hosts
+                "arg_dtypes": [str(np.dtype(a.dtype)) for a in avals],
             },
         }).encode("utf-8")
         return (_MAGIC + struct.pack("<I", len(header)) + header
@@ -300,6 +312,7 @@ class Computation:
                 "module": payload[:mlen],
                 "cc_version": native["cc_version"],
                 "platforms": tuple(native["platforms"]),
+                "arg_dtypes": native.get("arg_dtypes"),
             }
             blob = payload[mlen:]
         else:  # pre-native blobs: jax.export payload only

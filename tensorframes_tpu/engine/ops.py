@@ -28,6 +28,8 @@ import inspect
 from typing import (Callable, Dict, List, Mapping, NamedTuple, Optional,
                     Sequence, Tuple, Union)
 
+from collections import OrderedDict
+
 import jax
 import numpy as np
 
@@ -625,6 +627,131 @@ def _monoid_aggregate(col_combiners: Mapping[str, str],
                                    Schema(out_fields))
 
 
+import weakref
+
+# Computation objects rebuilt per call would defeat per-Computation jit
+# caches (every aggregate with callable fetches would re-trace its device
+# program); this weak cache reuses one Computation per (fetches, schema).
+_fetches_comp_cache: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+def cached_reduce_computation(fetches, value_schema, suffixes,
+                              block_level: bool):
+    """`_reduce_computation` with reuse keyed weakly by the fetches object
+    (callables); unhashable/unweakrefable fetches build fresh."""
+    sig = (tuple(suffixes), block_level,
+           tuple((f.name, f.dtype.name,
+                  tuple(f.block_shape.dims) if f.block_shape is not None
+                  else None)
+                 for f in value_schema))
+    try:
+        per = _fetches_comp_cache.setdefault(fetches, {})
+    except TypeError:
+        per = None
+    if per is not None:
+        comp = per.get(sig)
+        if comp is not None:
+            return comp
+    comp = _reduce_computation(fetches, value_schema, suffixes,
+                               block_level=block_level)
+    if per is not None:
+        per[sig] = comp
+    return comp
+
+
+def _aggregate_segmented_fold(comp, fetch_names, fetch_blocks, fact,
+                              schema) -> Dict[str, np.ndarray]:
+    """All-groups fold in one compiled program (rows pre-sorted by key).
+
+    Per group: the fold of the user computation over its contiguous rows
+    via a segmented ``associative_scan`` (pairwise two-row blocks), the
+    segment tail scattered into the ``[G, ...]`` output, then one final
+    application over each group's single-row block — identical semantics
+    to ``CompactionBuffer`` under the algebraic-regrouping contract, at
+    O(log rows) combiner depth instead of O(groups) dispatches.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    names = sorted(fetch_names)
+    G = len(fact.seg_starts)
+    n = len(fact.ids)
+    ids_sorted = np.asarray(fact.ids)[np.asarray(fact.order)].astype(
+        np.int32)
+    dev_blocks = []
+    for f in names:
+        a = fetch_blocks[f]
+        dd = _dt.device_dtype(schema[f].dtype)
+        if a.dtype != dd:
+            from .. import native as _native
+            a = _native.convert(a, dd)
+        dev_blocks.append(a)
+
+    cache = getattr(comp, "_tft_hostfold_cache", None)
+    if cache is None:
+        cache = comp._tft_hostfold_cache = OrderedDict()
+    key = (G, n,
+           tuple((f, a.shape, str(a.dtype))
+                 for f, a in zip(names, dev_blocks)))
+    fn = cache.get(key)
+    if fn is not None:
+        cache.move_to_end(key)
+    else:
+        def pair(av, bv):
+            out = comp.fn({f + "_input": jnp.stack([av[f], bv[f]])
+                           for f in names})
+            return {f: out[f] for f in names}
+
+        def single(av):
+            out = comp.fn({f + "_input": av[f][None] for f in names})
+            return {f: out[f] for f in names}
+
+        pair_v = jax.vmap(pair)
+        single_v = jax.vmap(single)
+
+        def program(sid, *vals):
+            svals = dict(zip(names, vals))
+
+            def op(a, b):
+                a_id, a_v = a
+                b_id, b_v = b
+                same = a_id == b_id
+                comb = pair_v(a_v, b_v)
+                out_v = {}
+                for f in names:
+                    m = same.reshape((-1,) + (1,) * (comb[f].ndim - 1))
+                    out_v[f] = jnp.where(m, comb[f], b_v[f])
+                return (b_id, out_v)
+
+            _, scanned = jax.lax.associative_scan(op, (sid, svals),
+                                                  axis=0)
+            tail = jnp.concatenate(
+                [sid[1:] != sid[:-1], jnp.ones((1,), bool)])
+            target = jnp.where(tail, sid, G)
+            table = {}
+            for f in names:
+                z = jnp.zeros((G,) + scanned[f].shape[1:],
+                              scanned[f].dtype)
+                table[f] = z.at[target].set(scanned[f], mode="drop")
+            return single_v(table)
+
+        fn = jax.jit(program)
+        cache[key] = fn
+        while len(cache) > 64:
+            cache.popitem(last=False)
+
+    with span("aggregate.segmented_fold"):
+        final = fn(ids_sorted, *dev_blocks)
+    cols: Dict[str, np.ndarray] = {}
+    for f in names:
+        v = np.asarray(final[f])
+        fld = schema[f]
+        if v.dtype != fld.dtype.np_storage and fld.dtype is not _dt.bfloat16:
+            v = v.astype(fld.dtype.np_storage)
+        cols[f] = v
+    return cols
+
+
 def aggregate(fetches: Fetches, grouped: GroupedFrame,
               buffer_size: int = DEFAULT_BUFFER_SIZE,
               executor: Optional[BlockExecutor] = None) -> TensorFrame:
@@ -647,12 +774,17 @@ def aggregate(fetches: Fetches, grouped: GroupedFrame,
             isinstance(v, str) for v in fetches.values()):
         return _monoid_aggregate(fetches, grouped)
     ex = executor or default_executor()
+    # the single-program fold runs comp.fn under in-process jax.jit, so it
+    # only replaces the per-group dispatch loop when that IS the effective
+    # executor; an explicit executor= or a TFT_EXECUTOR=pjrt process
+    # default keeps the CompactionBuffer path through that executor
+    use_segmented_fold = type(ex) is BlockExecutor and not ex.pad_rows
     df = grouped.frame
     keys = grouped.keys
     value_schema = df.schema.select(
         [n for n in df.schema.names if n not in keys])
-    comp = _reduce_computation(fetches, value_schema, ("_input",),
-                               block_level=True)
+    comp = cached_reduce_computation(fetches, value_schema, ("_input",),
+                                     block_level=True)
     _validate_reduce(comp, value_schema, ("_input",), rank_delta=1)
     fetch_names = comp.output_names
 
@@ -688,6 +820,29 @@ def aggregate(fetches: Fetches, grouped: GroupedFrame,
     from .. import native as _native
     fetch_blocks = {f: _native.gather_rows(merged.dense(f), order)
                     for f in fetch_names}
+
+    # deserialized computations (exported.call) have no vmap batching rule,
+    # so the vmapped fold cannot run them; they keep the compaction path
+    if use_segmented_fold and getattr(comp, "_native_dynamic", None) is None:
+        # Default path: ONE compiled device program for all groups — a
+        # segmented associative_scan whose operator is the user
+        # computation on two-row blocks (legal under the same
+        # regrouping contract buffered compaction relies on,
+        # ``core.py:96-97``), instead of O(groups) per-group Python
+        # dispatches. A non-default executor (explicit, or
+        # TFT_EXECUTOR=pjrt) keeps the CompactionBuffer path so the
+        # computation runs through that executor.
+        cols = _aggregate_segmented_fold(comp, fetch_names, fetch_blocks,
+                                         fact, df.schema)
+        for k, u in zip(keys, fact.uniques):
+            cols[k] = u
+        out_fields = [df.schema[k] for k in keys] + [
+            Field(s.name, s.dtype, block_shape=s.shape.prepend(Unknown),
+                  sql_rank=s.shape.ndim)
+            for s in comp.outputs]
+        return TensorFrame.from_blocks(
+            [Block(cols, len(seg_starts))], Schema(out_fields))
+
     out_rows: Dict[str, List[np.ndarray]] = {f: [] for f in fetch_names}
     # Ingest each segment in power-of-two-sized chunks (capped): any length
     # decomposes into <= log2(cap) + n/cap chunks, so the whole aggregation

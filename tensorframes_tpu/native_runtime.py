@@ -35,16 +35,16 @@ __all__ = ["NativeComputation", "NativeRuntime", "load_computation"]
 _MAGIC = b"TFTPU1\x00"
 _ERRLEN = 4096
 
-# tfr_dtype codes (native/tfrpjrt.h) keyed by the wire dtype names
-# (dtypes.DType.name); device dtypes follow the x64-off TPU policy the
-# authoring side uses (double/long stored wide, computed f32/i32).
-_DTYPES = {
-    "float": (np.dtype(np.float32), 1),
-    "double": (np.dtype(np.float64), 2),
-    "int": (np.dtype(np.int32), 3),
-    "long": (np.dtype(np.int64), 4),
-    "bfloat16": (None, 5),  # storage is uint16; handled explicitly
-    "bool": (np.dtype(np.bool_), 6),
+# tfr_dtype codes (native/tfrpjrt.h) keyed by numpy dtype: the module's
+# TRACED argument dtypes ride in the header ("arg_dtypes" — they depend on
+# the authoring host's x64 policy, e.g. a 'double' column traces as f32
+# with x64 off), so this runtime never guesses the storage policy.
+_CODES = {
+    np.dtype(np.float32): 1,
+    np.dtype(np.float64): 2,
+    np.dtype(np.int32): 3,
+    np.dtype(np.int64): 4,
+    np.dtype(np.bool_): 6,
 }
 _NP_FROM_CODE = {1: np.dtype(np.float32), 2: np.dtype(np.float64),
                  3: np.dtype(np.int32), 4: np.dtype(np.int64),
@@ -61,12 +61,15 @@ class NativeComputation:
 
     def __init__(self, inputs: List[dict], outputs: List[dict],
                  module: bytes, cc_version: int,
-                 platforms: Tuple[str, ...]):
+                 platforms: Tuple[str, ...],
+                 arg_dtypes: Sequence[str]):
         self.inputs = inputs      # [{"name", "dtype", "shape"}]
         self.outputs = outputs
         self.module = module
         self.cc_version = cc_version
         self.platforms = platforms
+        # traced (module-parameter) dtypes, one per input, in order
+        self.arg_dtypes = [np.dtype(d) for d in arg_dtypes]
 
     @property
     def input_names(self) -> List[str]:
@@ -92,10 +95,15 @@ def load_computation(data: bytes) -> NativeComputation:
             "blob predates the native section; re-serialize with a "
             "current authoring host (jax path still accepts it)")
     payload = data[off + hlen:]
+    arg_dtypes = native.get("arg_dtypes")
+    if not arg_dtypes:
+        raise NativeRuntimeError(
+            "blob lacks traced argument dtypes (older wire format); "
+            "re-serialize with a current authoring host")
     return NativeComputation(header["inputs"], header["outputs"],
                              payload[: native["module_len"]],
                              native["cc_version"],
-                             tuple(native["platforms"]))
+                             tuple(native["platforms"]), arg_dtypes)
 
 
 def _find_library() -> Optional[str]:
@@ -109,6 +117,12 @@ def _find_library() -> Optional[str]:
         if os.path.exists(p):
             return p
     return None
+
+
+def _destroy_exes(lib, per_nc: dict) -> None:
+    for exe in per_nc.values():
+        lib.tfr_pjrt_exe_destroy(exe)
+    per_nc.clear()
 
 
 class NativeRuntime:
@@ -152,6 +166,8 @@ class NativeRuntime:
                                              ctypes.c_char_p, ci]
         lib.tfr_pjrt_result_read.restype = ci
         lib.tfr_pjrt_results_destroy.argtypes = [vp]
+        lib.tfr_pjrt_exe_destroy.argtypes = [vp]
+        lib.tfr_pjrt_client_destroy.argtypes = [vp]
         self._lib = lib
         err = ctypes.create_string_buffer(_ERRLEN)
         self._client = lib.tfr_pjrt_client_create(backend.encode(), err,
@@ -170,16 +186,16 @@ class NativeRuntime:
         self._exes: "weakref.WeakKeyDictionary[NativeComputation, Dict[tuple, ctypes.c_void_p]]" = \
             weakref.WeakKeyDictionary()
 
-    def _device_view(self, spec: dict, a: np.ndarray) -> Tuple[np.ndarray, int]:
-        dt_name = spec["dtype"]
-        if dt_name not in _DTYPES:
-            raise NativeRuntimeError(f"unsupported wire dtype {dt_name!r}")
-        want, code = _DTYPES[dt_name]
-        if dt_name == "bfloat16":
+    def _device_view(self, want: np.dtype,
+                     a: np.ndarray) -> Tuple[np.ndarray, int]:
+        if want == _BF16_STORAGE:
             if a.dtype != _BF16_STORAGE:
                 raise NativeRuntimeError(
                     "bfloat16 inputs must arrive as uint16 storage")
-            return np.ascontiguousarray(a), code
+            return np.ascontiguousarray(a), 5
+        code = _CODES.get(want)
+        if code is None:
+            raise NativeRuntimeError(f"unsupported traced dtype {want}")
         if a.dtype != want:
             a = a.astype(want)
         return np.ascontiguousarray(a), code
@@ -187,11 +203,15 @@ class NativeRuntime:
     def run(self, nc: NativeComputation,
             arrays: Mapping[str, np.ndarray]) -> Dict[str, np.ndarray]:
         lib = self._lib
-        names = nc.input_names
+        if nc.platforms and self.platform not in nc.platforms:
+            raise NativeRuntimeError(
+                f"computation was lowered for {nc.platforms}, not for this "
+                f"runtime's platform {self.platform!r}")
         views: List[np.ndarray] = []
         codes: List[int] = []
-        for spec in nc.inputs:
-            v, code = self._device_view(spec, np.asarray(arrays[spec["name"]]))
+        for spec, want in zip(nc.inputs, nc.arg_dtypes):
+            v, code = self._device_view(want,
+                                        np.asarray(arrays[spec["name"]]))
             views.append(v)
             codes.append(code)
         n = len(views)
@@ -204,7 +224,14 @@ class NativeRuntime:
         dims = (cll * max(1, len(flat)))(*flat)
 
         sig = tuple((c, v.shape) for c, v in zip(codes, views))
-        per_nc = self._exes.setdefault(nc, {})
+        per_nc = self._exes.get(nc)
+        if per_nc is None:
+            import weakref
+
+            per_nc = self._exes[nc] = {}
+            # free this computation's executables when it is collected
+            # (the WeakKeyDictionary entry alone would just vanish)
+            weakref.finalize(nc, _destroy_exes, self._lib, per_nc)
         exe = per_nc.get(sig)
         err = ctypes.create_string_buffer(_ERRLEN)
         if exe is None:
@@ -250,3 +277,20 @@ class NativeRuntime:
         finally:
             lib.tfr_pjrt_results_destroy(res)
         return dict(zip(nc.output_names, outs))
+
+    def close(self):
+        """Free compiled executables and the native client."""
+        if self._client:
+            for per_nc in self._exes.values():
+                # clears each per-computation dict in place so the
+                # weakref finalizers see empty dicts (no double destroy)
+                _destroy_exes(self._lib, per_nc)
+            self._exes.clear()
+            self._lib.tfr_pjrt_client_destroy(self._client)
+            self._client = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -43,35 +43,7 @@ from .mesh import DeviceMesh
 __all__ = ["DistributedFrame", "distribute", "dmap_blocks",
            "dreduce_blocks", "daggregate"]
 
-import weakref
-
-# Computation objects rebuilt per call would defeat the per-Computation jit
-# caches below (every daggregate/dreduce with callable fetches would
-# re-trace and re-compile its mesh program); this weak cache makes repeated
-# calls with the SAME fetches object reuse one Computation per schema.
-_fetches_comp_cache: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
-
-
-def _cached_reduce_computation(fetches, value_schema, suffixes,
-                               block_level: bool):
-    sig = (tuple(suffixes), block_level,
-           tuple((f.name, f.dtype.name,
-                  tuple(f.block_shape.dims) if f.block_shape is not None
-                  else None)
-                 for f in value_schema))
-    try:
-        per = _fetches_comp_cache.setdefault(fetches, {})
-    except TypeError:  # unhashable / not weakref-able (e.g. dsl node lists)
-        per = None
-    if per is not None:
-        comp = per.get(sig)
-        if comp is not None:
-            return comp
-    comp = _ops._reduce_computation(fetches, value_schema, suffixes,
-                                    block_level=block_level)
-    if per is not None:
-        per[sig] = comp
-    return comp
+_cached_reduce_computation = _ops.cached_reduce_computation
 
 
 def _jitted(comp):
@@ -610,6 +582,10 @@ def daggregate(fetches, dist: DistributedFrame, keys,
 def _segmented_fold(comp, names, mesh: DeviceMesh, arrays, ids_dev,
                     G: int) -> Dict[str, jax.Array]:
     """Per-group fold of an arbitrary reduce computation on the mesh.
+
+    Requires a vmappable computation: deserialized (``exported.call``)
+    computations have no batching rule and are rejected with a clear
+    error at trace time by jax.
 
     ``ids_dev``: row-sharded dense group ids ([padded_rows] int32, ``-1``
     for pad rows). Per shard: stable sort by id, segmented
