@@ -430,6 +430,36 @@ def _build_device_ids(kc, vm, max_groups: int):
     return ids, uniq, count, sentinel_hit
 
 
+def _device_key_ids(dist: DistributedFrame, keys, max_groups: int):
+    """Shared entry to the device-keys path (monoid + generic daggregate):
+    single-key validation + ids/uniques/count on the mesh. Returns
+    ``(ids_dev, uniq_dev, count_dev, table_groups)`` where
+    ``table_groups`` is the static table size (cap + sentinel slot)."""
+    if len(keys) != 1:
+        raise _ops.InvalidTypeError(
+            "device-side aggregation (max_groups=) supports a single "
+            "key column; composite keys take the host path")
+    ids_dev, uniq_dev, count_dev = _device_group_ids(dist, keys[0],
+                                                     max_groups)
+    return ids_dev, uniq_dev, count_dev, max_groups + 1
+
+
+def _device_key_column(dist: DistributedFrame, key: str, uniq_dev,
+                       count_dev, max_groups: int):
+    """Overflow check + host materialization of the device group table.
+    Returns ``(key_values, num_groups)``."""
+    count = int(count_dev)
+    if count > max_groups:
+        raise ValueError(
+            f"more than max_groups={max_groups} distinct keys in "
+            f"{key!r}; raise max_groups (the static table cap)")
+    kfld = dist.schema[key]
+    kvals = np.asarray(uniq_dev)[:count]
+    if kvals.dtype != kfld.dtype.np_storage:  # integer keys only
+        kvals = kvals.astype(kfld.dtype.np_storage)
+    return kvals, count
+
+
 def daggregate(fetches, dist: DistributedFrame, keys,
                max_groups: Optional[int] = None) -> TensorFrame:
     """Mesh-distributed keyed aggregation.
@@ -476,12 +506,8 @@ def daggregate(fetches, dist: DistributedFrame, keys,
             raise KeyError(f"No key column {k!r}; columns: {schema.names}")
     if not (isinstance(fetches, Mapping) and fetches and all(
             isinstance(v, str) for v in fetches.values())):
-        if max_groups is not None:
-            raise ValueError(
-                "max_groups= (device-side keys) currently applies to the "
-                "monoid combiner path; arbitrary computations use host "
-                "key factorization")
-        return _generic_daggregate(fetches, dist, keys)
+        return _generic_daggregate(fetches, dist, keys,
+                                   max_groups=max_groups)
     col_combiners = fetches
 
     from ..engine.ops import _validate_monoid_fetches
@@ -498,13 +524,8 @@ def daggregate(fetches, dist: DistributedFrame, keys,
 
     device_keys = max_groups is not None
     if device_keys:
-        if len(keys) != 1:
-            raise _ops.InvalidTypeError(
-                "device-side aggregation (max_groups=) supports a single "
-                "key column; composite keys take the host path")
-        ids_dev, uniq_dev, count_dev = _device_group_ids(
-            dist, keys[0], max_groups)
-        num_groups = max_groups + 1  # static cap incl the sentinel slot
+        ids_dev, uniq_dev, count_dev, num_groups = _device_key_ids(
+            dist, keys, max_groups)
         uniques = None
     else:
         ids_dev, uniques, num_groups = _host_group_ids(dist, keys)
@@ -546,18 +567,9 @@ def daggregate(fetches, dist: DistributedFrame, keys,
     tables = fn(ids_dev, *arrays)
 
     if device_keys:
-        count = int(count_dev)
-        if count > max_groups:
-            raise ValueError(
-                f"more than max_groups={max_groups} distinct keys in "
-                f"{keys[0]!r}; raise max_groups (the static table cap)")
-        kfld = schema[keys[0]]
-        kvals = np.asarray(uniq_dev)[:count]
-        if kvals.dtype != kfld.dtype.np_storage \
-                and kfld.dtype is not _dt.bfloat16:
-            kvals = kvals.astype(kfld.dtype.np_storage)
+        kvals, num_out = _device_key_column(dist, keys[0], uniq_dev,
+                                            count_dev, max_groups)
         cols: Dict[str, np.ndarray] = {keys[0]: kvals}
-        num_out = count
     else:
         cols = {k: u for k, u in zip(keys, uniques)}
         num_out = num_groups
@@ -691,8 +703,8 @@ def _segmented_fold(comp, names, mesh: DeviceMesh, arrays, ids_dev,
     return fn(ids_dev, *arrays)
 
 
-def _generic_daggregate(fetches, dist: DistributedFrame,
-                        keys) -> TensorFrame:
+def _generic_daggregate(fetches, dist: DistributedFrame, keys,
+                        max_groups: Optional[int] = None) -> TensorFrame:
     """Arbitrary-computation keyed aggregation on the mesh.
 
     The distributed form of the reference's UDAF-inside-the-shuffle
@@ -730,14 +742,27 @@ def _generic_daggregate(fetches, dist: DistributedFrame,
     _ops._validate_reduce(comp, value_schema, ("_input",), rank_delta=1)
     names = sorted(comp.output_names)
 
-    ids_dev, uniques, num_groups = _host_group_ids(dist, keys)
+    if max_groups is not None:
+        # device-side keys: ids + group table built on the mesh, the key
+        # column never visits the host (single integer key only)
+        ids_dev, uniq_dev, count_dev, table_groups = _device_key_ids(
+            dist, keys, max_groups)
+        uniques = None
+    else:
+        ids_dev, uniques, table_groups = _host_group_ids(dist, keys)
     final = _segmented_fold(comp, names, mesh,
                             [dist.columns[f] for f in names],
-                            ids_dev, num_groups)
+                            ids_dev, table_groups)
 
-    cols: Dict[str, np.ndarray] = {k: u for k, u in zip(keys, uniques)}
+    if max_groups is not None:
+        kvals, num_groups = _device_key_column(dist, keys[0], uniq_dev,
+                                               count_dev, max_groups)
+        cols: Dict[str, np.ndarray] = {keys[0]: kvals}
+    else:
+        num_groups = table_groups
+        cols = {k: u for k, u in zip(keys, uniques)}
     for f in names:
-        v = np.asarray(final[f])
+        v = np.asarray(final[f])[:num_groups]
         fld = schema[f]
         if v.dtype != fld.dtype.np_storage and fld.dtype is not _dt.bfloat16:
             v = v.astype(fld.dtype.np_storage)

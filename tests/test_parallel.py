@@ -302,3 +302,25 @@ def test_daggregate_device_keys_pad_rows_excluded(mesh8):
     out = par.daggregate({"x": "sum"}, dist, "k", max_groups=4)
     rows = out.collect()
     assert len(rows) == 1 and rows[0]["x"] == 10.0 and rows[0]["k"] == 0
+
+
+def test_daggregate_generic_device_keys(mesh8):
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(41)
+    n = 300
+    key = rng.integers(0, 19, n).astype(np.int32)
+    x = rng.normal(size=n)
+    df = tft.frame({"k": key, "x": x})
+    dist = par.distribute(df, mesh8)
+
+    def fetch(x_input):
+        return {"x": jnp.sqrt((x_input ** 2).sum(0))}
+
+    host_out = par.daggregate(fetch, dist, "k")
+    dev_out = par.daggregate(fetch, dist, "k", max_groups=32)
+    h = {r["k"]: r["x"] for r in host_out.collect()}
+    d = {r["k"]: r["x"] for r in dev_out.collect()}
+    assert set(h) == set(d)
+    for k in h:
+        np.testing.assert_allclose(h[k], d[k], rtol=1e-6)
