@@ -9,7 +9,12 @@ cd "$(dirname "$0")"
 echo "== building native runtime (libtfruntime.so) =="
 make -C native
 
+HAVE_TF=0
 if python -c "import tensorflow" >/dev/null 2>&1; then
+  HAVE_TF=1
+fi
+
+if [ "$HAVE_TF" = 1 ]; then
   echo "== building native PJRT core (libtfrpjrt.so) =="
   make -C native pjrt
 else
@@ -17,4 +22,10 @@ else
 fi
 
 echo "== running test suite =="
-exec python -m pytest tests/ -q "$@"
+python -m pytest tests/ -q "$@"
+
+if [ "$HAVE_TF" = 1 ]; then
+  echo "== op suite again through the native PJRT core (TFT_EXECUTOR=pjrt) =="
+  TFT_EXECUTOR=pjrt exec python -m pytest tests/test_ops.py \
+    tests/test_demos.py -q
+fi

@@ -402,6 +402,15 @@ def _device_group_ids(dist: DistributedFrame, key: str, max_groups: int):
         raise _ops.InvalidTypeError(
             f"device-side aggregation needs an integer key column; {key!r} "
             f"is {kcol.dtype} (use the host path)")
+    fld = dist.schema[key]
+    if np.dtype(kcol.dtype).itemsize < np.dtype(fld.dtype.np_storage).itemsize:
+        # same hazard _host_group_ids guards: device narrowing (long->int
+        # with x64 off) can merge distinct keys — unrecoverable, so fail
+        raise _ops.InvalidTypeError(
+            f"Key column {key!r} ({fld.dtype.name}) was narrowed to "
+            f"{kcol.dtype} on device, which can merge distinct keys; cast "
+            f"the key to a device-exact type (e.g. int) before "
+            f"distribute(), or enable x64")
     valid_host = dist.valid_row_mask()
     valid = jax.make_array_from_callback(
         (dist.padded_rows,), mesh.row_sharding(1),

@@ -324,3 +324,26 @@ def test_daggregate_generic_device_keys(mesh8):
     assert set(h) == set(d)
     for k in h:
         np.testing.assert_allclose(h[k], d[k], rtol=1e-6)
+
+
+def test_daggregate_device_keys_narrowed_long_rejected(mesh8):
+    # int64 keys narrowed to int32 on device (x64 off in this test? the
+    # conftest enables x64, so simulate via an int column that is exact) —
+    # assert the guard exists by checking the host-path error parity: when
+    # the device dtype is narrower than storage, both paths must refuse.
+    from tensorframes_tpu.engine.ops import InvalidTypeError
+    import jax
+
+    if not jax.config.jax_enable_x64:
+        df = tft.frame({"k": np.array([1, 1 + 2**32] * 8, np.int64),
+                        "x": np.ones(16)})
+        dist = par.distribute(df, mesh8)
+        with pytest.raises(InvalidTypeError, match="narrowed"):
+            par.daggregate({"x": "sum"}, dist, "k", max_groups=4)
+    else:
+        # x64 on (CPU tests): no narrowing occurs; both paths agree
+        df = tft.frame({"k": np.array([1, 1 + 2**32] * 8, np.int64),
+                        "x": np.ones(16)})
+        dist = par.distribute(df, mesh8)
+        out = par.daggregate({"x": "sum"}, dist, "k", max_groups=4)
+        assert len(out.collect()) == 2
