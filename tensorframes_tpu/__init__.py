@@ -39,8 +39,10 @@ from .api import (
     print_schema, reduce_blocks, reduce_rows, row,
 )
 from . import builder
+from . import io
 
 __all__ = [
+    "io",
     "Shape",
     "Unknown",
     "Field",

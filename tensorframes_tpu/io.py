@@ -1,0 +1,175 @@
+"""Columnar IO: parquet / pandas / npz in and out of TensorFrames.
+
+The reference had no IO layer of its own — Spark WAS the loader, and
+frames arrived as Catalyst DataFrames. A standalone TPU-native framework
+needs its own ingestion story, and it must be columnar end to end: a
+parquet row group is already the column-block layout ``TensorFrame``
+wants, so reading maps row groups to partitions with zero row-at-a-time
+work (the reference's convert/convertBack hot loop,
+``DataOps.scala:158-283``, does not exist on this path at all).
+
+Scope (honest): scalar columns (float/double/int/long/bool/string) and
+fixed-size-list columns (vector cells). Ragged lists are rejected with a
+clear error — the engine's ragged support is for in-memory frames.
+
+All entry points are lazy-import (pyarrow/pandas only load when used) so
+the core package stays dependency-light.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from .frame import TensorFrame
+
+__all__ = ["read_parquet", "write_parquet", "from_pandas", "to_pandas",
+           "read_npz", "write_npz"]
+
+
+def _column_to_numpy(col, name: str) -> np.ndarray:
+    """One pyarrow ChunkedArray/Array -> dense numpy column."""
+    import pyarrow as pa
+
+    if isinstance(col, pa.ChunkedArray):
+        col = col.combine_chunks()
+    t = col.type
+    if pa.types.is_fixed_size_list(t):
+        flat = col.flatten().to_numpy(zero_copy_only=False)
+        return np.asarray(flat).reshape(len(col), t.list_size)
+    if pa.types.is_list(t) or pa.types.is_large_list(t):
+        import pyarrow.compute as pc
+
+        if col.null_count:
+            raise ValueError(
+                f"column {name!r}: {col.null_count} null list cell(s); "
+                f"vector columns must be dense to load from parquet")
+        lengths = pc.unique(pc.list_value_length(col)).to_pylist()
+        if len(lengths) == 1:
+            width = lengths[0]
+            flat = col.flatten().to_numpy(zero_copy_only=False)
+            return np.asarray(flat).reshape(len(col), width)
+        raise ValueError(
+            f"column {name!r}: ragged list values (lengths "
+            f"{sorted(lengths)[:5]}...); only fixed-width vector columns "
+            f"load from parquet")
+    if pa.types.is_string(t) or pa.types.is_large_string(t):
+        return np.asarray(col.to_pylist(), dtype=object)
+    return col.to_numpy(zero_copy_only=False)
+
+
+def read_parquet(path: str, columns: Optional[Sequence[str]] = None,
+                 num_partitions: Optional[int] = None) -> TensorFrame:
+    """Read a parquet file into a TensorFrame, row groups → partitions.
+
+    ``num_partitions=None`` keeps the file's row-group structure (the
+    natural block layout); an explicit value re-blocks after load.
+    """
+    import pyarrow.parquet as pq
+
+    pf = pq.ParquetFile(path)
+    names = list(columns) if columns is not None else [
+        c for c in pf.schema_arrow.names]
+    blocks: List[dict] = []
+    for rg in range(pf.num_row_groups):
+        tbl = pf.read_row_group(rg, columns=names)
+        blocks.append({n: _column_to_numpy(tbl.column(n), n)
+                       for n in names})
+    if not blocks:
+        blocks = [{n: np.empty((0,)) for n in names}]
+    first = TensorFrame.from_columns(blocks[0])
+    if len(blocks) > 1:
+        from .frame import Block
+
+        schema = first.schema
+        fblocks = [Block({n: b[n] for n in names},
+                         len(next(iter(b.values())))) for b in blocks]
+        first = TensorFrame.from_blocks(fblocks, schema)
+    if num_partitions is not None:
+        from .frame import Block as _B
+
+        merged = _B.concat(first.blocks(), first.schema)
+        cols = {n: merged.dense(n) for n in names}
+        first = TensorFrame.from_columns(cols, schema=first.schema,
+                                         num_partitions=num_partitions)
+    return first
+
+
+def write_parquet(df: TensorFrame, path: str) -> None:
+    """Write a TensorFrame to parquet, partitions → row groups."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    writer = None
+    try:
+        for b in df.blocks():
+            arrays = {}
+            for name in df.schema.names:
+                a = b.dense(name)
+                if a.ndim == 1:
+                    arrays[name] = pa.array(a.tolist() if a.dtype == object
+                                            else a)
+                elif a.ndim == 2:
+                    arrays[name] = pa.FixedSizeListArray.from_arrays(
+                        pa.array(a.reshape(-1)), a.shape[1])
+                else:
+                    raise ValueError(
+                        f"column {name!r}: rank-{a.ndim} cells do not map "
+                        f"to parquet; flatten first")
+            tbl = pa.table(arrays)
+            if writer is None:
+                writer = pq.ParquetWriter(path, tbl.schema)
+            writer.write_table(tbl)
+    finally:
+        if writer is not None:
+            writer.close()
+
+
+def from_pandas(pdf, num_partitions: int = 1) -> TensorFrame:
+    """pandas DataFrame → TensorFrame (object/string dtypes pass through)."""
+    cols = {}
+    for name in pdf.columns:
+        s = pdf[name]
+        a = s.to_numpy()
+        if a.dtype.kind in ("U", "S") or (
+                a.dtype == object and len(a) and isinstance(a[0], str)):
+            a = np.asarray(a, dtype=object)
+        cols[str(name)] = a
+    return TensorFrame.from_columns(cols, num_partitions=num_partitions)
+
+
+def to_pandas(df: TensorFrame):
+    """TensorFrame → pandas DataFrame (vector cells become object lists)."""
+    import pandas as pd
+
+    from .frame import Block
+
+    merged = Block.concat(df.blocks(), df.schema)
+    data = {}
+    for name in df.schema.names:
+        a = merged.dense(name)
+        data[name] = list(a) if a.ndim > 1 else a
+    return pd.DataFrame(data)
+
+
+def read_npz(path: str, num_partitions: int = 1) -> TensorFrame:
+    """Load a ``.npz`` archive as one column per entry."""
+    with np.load(path, allow_pickle=False) as z:
+        cols = {k: z[k] for k in z.files}
+    return TensorFrame.from_columns(cols, num_partitions=num_partitions)
+
+
+def write_npz(df: TensorFrame, path: str) -> None:
+    from .frame import Block
+
+    merged = Block.concat(df.blocks(), df.schema)
+    cols = {}
+    for n in df.schema.names:
+        a = merged.dense(n)
+        if a.dtype == object:
+            raise ValueError(
+                f"column {n!r}: string/object columns do not round-trip "
+                f"through npz; use write_parquet, or select() them away")
+        cols[n] = a
+    np.savez(path, **cols)

@@ -169,6 +169,19 @@ def distribute(df: TensorFrame, mesh: DeviceMesh) -> DistributedFrame:
     cols: Dict[str, jax.Array] = {}
     for f in df.schema:
         a = merged.dense(f.name)
+        if not f.dtype.tensor:
+            # non-tensor (string) columns cannot live in device memory;
+            # they ride host-side in the same padded global layout —
+            # pass-through / group-key only, exactly the host engine's
+            # contract for them (dtypes.py: tensor=False). Stored as the
+            # schema's np_storage (object), so downstream dtype guards
+            # never mistake a '<U1' numpy view for device narrowing.
+            a = np.asarray(a, f.dtype.np_storage)
+            if padded != n:
+                a = np.concatenate(
+                    [a, np.full(padded - n, None, a.dtype)])
+            cols[f.name] = a
+            continue
         dd = _dt.device_dtype(f.dtype)
         if a.dtype != dd:
             from .. import native as _native

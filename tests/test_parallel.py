@@ -347,3 +347,23 @@ def test_daggregate_device_keys_narrowed_long_rejected(mesh8):
         dist = par.distribute(df, mesh8)
         out = par.daggregate({"x": "sum"}, dist, "k", max_groups=4)
         assert len(out.collect()) == 2
+
+
+def test_distribute_string_key_column_rides_host_side(mesh8):
+    # geom_mean-style pipeline: string group keys alongside tensor values
+    # (reference carried non-numeric Catalyst columns through untouched);
+    # the key column stays host-side, values shard.
+    df = tft.frame([(str(i % 3), float(i)) for i in range(10)],
+                   columns=["key", "x"])
+    dist = par.distribute(df, mesh8)
+    out = par.daggregate({"x": "sum"}, dist, "key")
+    got = {r["key"]: r["x"] for r in out.collect()}
+    want = {}
+    for i in range(10):
+        want[str(i % 3)] = want.get(str(i % 3), 0.0) + float(i)
+    assert got == pytest.approx(want)
+    # round trip preserves the string column
+    back = par.dmap_blocks(lambda x: {"z": x + 1.0}, dist).collect_frame()
+    rows = back.collect()
+    assert sorted((r["key"], r["x"], r["z"]) for r in rows) == sorted(
+        (str(i % 3), float(i), float(i) + 1.0) for i in range(10))
