@@ -20,7 +20,12 @@ re-designed TPU-first:
  - variant C (``step_device_resident``): variant B's math on a
    ``distribute``d frame — data stays in device HBM across iterations, the
    driver only moves k x m centroids per round (the TPU-native ideal: the
-   reference re-marshals every row through the JVM every iteration).
+   reference re-marshals every row through the JVM every iteration);
+ - variant D (``step_daggregate``): the groupBy shuffle itself at mesh
+   scale — ``dmap_blocks`` appends assignments, ``daggregate`` with
+   DEVICE-side keys folds the centroid table on the mesh (the reference's
+   cross-executor shuffle became one segment-reduce + collective, and the
+   key column never visits the driver).
 
 The driver loop (``kmeans``) matches the reference's: centroids live on the
 driver and are embedded as constants into the next round's computation
@@ -161,6 +166,48 @@ def step_device_resident(dist, centers: np.ndarray) -> Tuple[np.ndarray, float]:
                              np.asarray(out.columns["agg_counts"]),
                              np.asarray(out.columns["agg_distances"]),
                              centers)
+
+
+def step_daggregate(dist, centers: np.ndarray) -> Tuple[np.ndarray, float]:
+    """One step as a mesh-level keyed SHUFFLE (variant A at mesh scale).
+
+    The reference's groupBy path moved every row between executors by
+    centroid key; here ``dmap_blocks`` appends the assignment + per-point
+    partials and ``daggregate(max_groups=k)`` folds them into the k-row
+    table with DEVICE-side keys — per-step host traffic is the k x (m+2)
+    table, and the key column never visits the driver.
+    """
+    import jax.numpy as jnp
+
+    from tensorframes_tpu.parallel.distributed import daggregate, dmap_blocks
+
+    k, m = centers.shape
+    c = centers
+
+    def assign_fn(features):
+        d = _distances(features, c)
+        a = jnp.argmin(d, axis=1).astype(jnp.int32)
+        return {"assign": a,
+                "mind": jnp.min(d, axis=1),
+                "ones": jnp.ones((features.shape[0],), features.dtype)}
+
+    scored = dmap_blocks(assign_fn, dist)
+    # pad rows never reach the shuffle: daggregate marks them out via its
+    # validity-aware group-id construction
+    table = daggregate({"features": "sum", "mind": "sum", "ones": "sum"},
+                       scored, "assign", max_groups=k)
+    rows = table.collect()
+    sums = np.zeros_like(centers)
+    counts = np.zeros((k,))
+    dist_total = 0.0
+    for r in rows:
+        i = int(r["assign"])
+        sums[i] = np.asarray(r["features"])
+        counts[i] = r["ones"]
+        dist_total += float(r["mind"])
+    safe = np.maximum(counts, 1.0)[:, None]
+    new_centers = np.where(counts[:, None] > 0, sums / safe, centers)
+    return new_centers, float(dist_total)
 
 
 # -- driver loop (reference kmeans.py:148-163) ------------------------------

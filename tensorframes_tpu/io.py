@@ -68,16 +68,20 @@ def read_parquet(path: str, columns: Optional[Sequence[str]] = None,
     """
     import pyarrow.parquet as pq
 
-    pf = pq.ParquetFile(path)
-    names = list(columns) if columns is not None else [
-        c for c in pf.schema_arrow.names]
-    blocks: List[dict] = []
-    for rg in range(pf.num_row_groups):
-        tbl = pf.read_row_group(rg, columns=names)
-        blocks.append({n: _column_to_numpy(tbl.column(n), n)
-                       for n in names})
-    if not blocks:
-        blocks = [{n: np.empty((0,)) for n in names}]
+    with pq.ParquetFile(path) as pf:
+        names = list(columns) if columns is not None else [
+            c for c in pf.schema_arrow.names]
+        blocks: List[dict] = []
+        for rg in range(pf.num_row_groups):
+            tbl = pf.read_row_group(rg, columns=names)
+            blocks.append({n: _column_to_numpy(tbl.column(n), n)
+                           for n in names})
+        if not blocks:
+            # empty file: type the empty columns from the parquet schema,
+            # not as float64
+            empty = pf.schema_arrow.empty_table()
+            blocks = [{n: _column_to_numpy(empty.column(n), n)
+                       for n in names}]
     first = TensorFrame.from_columns(blocks[0])
     if len(blocks) > 1:
         from .frame import Block
@@ -172,4 +176,7 @@ def write_npz(df: TensorFrame, path: str) -> None:
                 f"column {n!r}: string/object columns do not round-trip "
                 f"through npz; use write_parquet, or select() them away")
         cols[n] = a
-    np.savez(path, **cols)
+    # write through an open handle so np.savez cannot silently append
+    # '.npz' and land at a different path than requested
+    with open(path, "wb") as fh:
+        np.savez(fh, **cols)

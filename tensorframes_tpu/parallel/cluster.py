@@ -168,16 +168,9 @@ def distribute_local(local: Mapping[str, np.ndarray] | TensorFrame,
     for f in schema:
         a = cols_in[f.name]
         if not f.dtype.tensor:
-            if jax.process_count() > 1:
-                raise ValueError(
-                    f"column {f.name!r}: non-tensor (string) columns are "
-                    f"not supported across processes yet — drop them with "
-                    f"select() or key on an integer column")
-            a = np.asarray(a, f.dtype.np_storage)
-            if a.shape[0] != local_padded:
-                a = np.concatenate(
-                    [a, np.full(local_padded - a.shape[0], None, a.dtype)])
-            columns[f.name] = a
+            from .distributed import _host_side_column
+
+            columns[f.name] = _host_side_column(a, f, local_padded)
             continue
         dd = _dt.device_dtype(f.dtype)
         if a.dtype != dd:

@@ -154,6 +154,29 @@ class DistributedFrame:
                 f"rows={self.num_rows} mesh={self.mesh!r}")
 
 
+def _host_side_column(a: np.ndarray, field, padded_rows: int) -> np.ndarray:
+    """Pad a non-tensor (string) column for the host-side ride-along.
+
+    Such columns cannot live in device memory; they travel in the same
+    padded global layout as the device columns — pass-through / group-key
+    only, exactly the host engine's contract for them (dtypes.py:
+    tensor=False). Stored as the schema's np_storage (object), so
+    downstream dtype guards never mistake a '<U1' numpy view for device
+    narrowing. Host-side columns are process-local: multi-process callers
+    must reject them (cluster.distribute_local does).
+    """
+    if jax.process_count() > 1:
+        raise ValueError(
+            f"column {field.name!r}: non-tensor (string) columns are not "
+            f"supported across processes — drop them with select() or key "
+            f"on an integer column")
+    a = np.asarray(a, field.dtype.np_storage)
+    if a.shape[0] != padded_rows:
+        a = np.concatenate(
+            [a, np.full(padded_rows - a.shape[0], None, a.dtype)])
+    return a
+
+
 def distribute(df: TensorFrame, mesh: DeviceMesh) -> DistributedFrame:
     """Shard a host frame over the mesh's data axis.
 
@@ -170,17 +193,7 @@ def distribute(df: TensorFrame, mesh: DeviceMesh) -> DistributedFrame:
     for f in df.schema:
         a = merged.dense(f.name)
         if not f.dtype.tensor:
-            # non-tensor (string) columns cannot live in device memory;
-            # they ride host-side in the same padded global layout —
-            # pass-through / group-key only, exactly the host engine's
-            # contract for them (dtypes.py: tensor=False). Stored as the
-            # schema's np_storage (object), so downstream dtype guards
-            # never mistake a '<U1' numpy view for device narrowing.
-            a = np.asarray(a, f.dtype.np_storage)
-            if padded != n:
-                a = np.concatenate(
-                    [a, np.full(padded - n, None, a.dtype)])
-            cols[f.name] = a
+            cols[f.name] = _host_side_column(a, f, padded)
             continue
         dd = _dt.device_dtype(f.dtype)
         if a.dtype != dd:

@@ -121,3 +121,17 @@ def test_readme_dsl_map():
     rows = gs.readme_dsl_map()
     np.testing.assert_allclose([r["z"] for r in rows],
                                np.arange(5.0) * 0.1 + 3.0)
+
+
+def test_kmeans_daggregate_step_matches(km_data):
+    # variant D: the groupBy shuffle at mesh scale (device-side keys)
+    from tensorframes_tpu.parallel.distributed import distribute
+    from tensorframes_tpu.parallel.mesh import local_mesh
+
+    df, init, _ = km_data
+    pts = np.concatenate([b.dense("features") for b in df.blocks()])
+    dist = distribute(df, local_mesh())
+    got_c, got_d = km.step_daggregate(dist, init)
+    want_c, want_d = _numpy_step(pts, init)
+    np.testing.assert_allclose(got_c, want_c, rtol=1e-5)
+    assert got_d == pytest.approx(want_d, rel=1e-5)
