@@ -1,0 +1,34 @@
+#!/usr/bin/env bash
+# One-shot chip evidence suite: run everything BASELINE.md still lists as
+# "re-run pending chip availability", each step with its own timeout so a
+# wedged grant loses one step, not the suite. Appends JSON lines to
+# benchmarks/chip_results.jsonl (gitignored artifacts aside, the numbers
+# land in BASELINE.md by hand).
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+OUT=benchmarks/chip_results.jsonl
+probe() {
+  timeout 90 python -c "import jax; assert jax.devices()[0].platform in ('tpu','axon')" 2>/dev/null
+}
+
+if ! probe; then
+  echo "no TPU grant available; aborting" >&2
+  exit 2
+fi
+
+run() {  # run <label> <timeout_s> <cmd...>
+  local label=$1 t=$2; shift 2
+  echo "== $label =="
+  timeout "$t" "$@" 2>>"$OUT.err" | tee -a "$OUT" || \
+    echo "{\"step\": \"$label\", \"error\": \"rc=$? (timeout or failure)\"}" | tee -a "$OUT"
+}
+
+run native_smoke   400 python benchmarks/tpu_native_smoke.py
+run pallas_smoke   400 python benchmarks/tpu_pallas_smoke.py
+run baseline_1_2_3 500 python benchmarks/run_tpu_baselines.py 1 2 3
+run baseline_4     580 python benchmarks/run_tpu_baselines.py 4
+run baseline_5     580 python benchmarks/run_tpu_baselines.py 5
+run daggregate     580 python benchmarks/daggregate_bench.py 1000000 100000
+run headline       580 python bench.py
+echo "chip suite complete; results in $OUT"

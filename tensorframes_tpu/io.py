@@ -66,8 +66,14 @@ def read_parquet(path: str, columns: Optional[Sequence[str]] = None,
     ``num_partitions=None`` keeps the file's row-group structure (the
     natural block layout); an explicit value re-blocks after load.
     """
+    import pyarrow as pa
     import pyarrow.parquet as pq
 
+    if tuple(int(x) for x in pa.__version__.split(".")[:1]) < (11,):
+        raise ImportError(
+            f"read_parquet needs pyarrow >= 11 (found {pa.__version__}): "
+            f"it relies on ParquetFile context management and "
+            f"Schema.empty_table")
     with pq.ParquetFile(path) as pf:
         names = list(columns) if columns is not None else [
             c for c in pf.schema_arrow.names]

@@ -149,6 +149,25 @@ class DistributedFrame:
             host_cols, schema=self.schema,
             num_partitions=num_partitions or self.mesh.num_data_shards)
 
+    def select(self, names) -> "DistributedFrame":
+        """A view with only ``names`` (no data movement — the reduce ops
+        require every column to back a fetch, so dropping ride-along
+        columns first is the normal prelude)."""
+        if isinstance(names, str):
+            names = [names]
+        names = list(names)
+        missing = [n for n in names if n not in self.schema]
+        if missing:
+            raise KeyError(
+                f"No column(s) {missing}; columns: {self.schema.names}")
+        return DistributedFrame(self.mesh, self.schema.select(names),
+                                {n: self.columns[n] for n in names},
+                                self.num_rows, shard_valid=self.shard_valid)
+
+    def count(self) -> int:
+        """True (un-padded) global row count."""
+        return self.num_rows
+
     def __repr__(self):
         return (f"DistributedFrame[{', '.join(self.schema.names)}] "
                 f"rows={self.num_rows} mesh={self.mesh!r}")
@@ -162,8 +181,9 @@ def _host_side_column(a: np.ndarray, field, padded_rows: int) -> np.ndarray:
     only, exactly the host engine's contract for them (dtypes.py:
     tensor=False). Stored as the schema's np_storage (object), so
     downstream dtype guards never mistake a '<U1' numpy view for device
-    narrowing. Host-side columns are process-local: multi-process callers
-    must reject them (cluster.distribute_local does).
+    narrowing. Host-side columns are process-local, so THIS helper
+    rejects them in multi-process runs (both distribute entry points
+    route through here).
     """
     if jax.process_count() > 1:
         raise ValueError(

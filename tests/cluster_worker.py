@@ -70,10 +70,10 @@ def main() -> int:
     np.testing.assert_allclose(red["v"], v_g.min(0), rtol=1e-12)
 
     # 3. generic dreduce (arbitrary computation over ragged validity;
-    # reduce consumes every column, so distribute a values-only frame)
-    dist_x = par.distribute_local({"x": x_local}, mesh)
+    # reduce consumes every column, so select the value column first)
     red2 = par.dreduce_blocks(
-        lambda x_input: {"x": jnp.sqrt((x_input ** 2).sum(0))}, dist_x)
+        lambda x_input: {"x": jnp.sqrt((x_input ** 2).sum(0))},
+        dist.select("x"))
     np.testing.assert_allclose(red2["x"], np.sqrt((x_g ** 2).sum()),
                                rtol=1e-9)
 
@@ -86,11 +86,10 @@ def main() -> int:
         np.testing.assert_allclose(r["v"], v_g[sel].max(0), rtol=1e-12)
 
     # 5. generic daggregate (UDAF-analogue inside the "shuffle"; every
-    # value column must back a fetch, so distribute key + value only)
-    dist_kx = par.distribute_local({"k": k_local, "x": x_local}, mesh)
+    # value column must back a fetch, so select key + value only)
     agg2 = par.daggregate(
         lambda x_input: {"x": jnp.sqrt((x_input ** 2).sum(0))},
-        dist_kx, "k").collect()
+        dist.select(["k", "x"]), "k").collect()
     assert len(agg2) == 5
     for r in agg2:
         sel = k_g == r["k"]
