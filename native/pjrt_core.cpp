@@ -193,13 +193,25 @@ struct ClientIface {
   virtual std::string platform() const = 0;
   virtual ExeIface* compile(std::string_view module, std::string* err) = 0;
   // Compile a serialized xla.HloModuleProto (the output of the dynamic-
-  // shape refinement below).
+  // shape refinement below), replicated n_replicas times (1 = single).
   virtual ExeIface* compile_hlo(const std::string& hlo_proto,
-                                std::string* err) = 0;
+                                std::string* err, int n_replicas = 1) = 0;
   virtual ResultsIface* execute(ExeIface* exe, int nargs, const int* dtypes,
                                 const int* ndims, const long long* dims,
                                 const void* const* data,
                                 std::string* err) = 0;
+  // SPMD-replicated: compile for n_replicas devices and run one program
+  // instance per device in a single call (the per-executor parallel
+  // dispatch of the reference's executor fleet, in-process).
+  virtual ExeIface* compile_n(std::string_view module, int n_replicas,
+                              std::string* err) = 0;
+  // data: n_replicas * nargs host pointers, replica-major; every replica
+  // shares the same shapes. Results are replica-major too
+  // (n_replicas * n_outputs entries).
+  virtual ResultsIface* execute_replicated(
+      ExeIface* exe, int n_replicas, int nargs, const int* dtypes,
+      const int* ndims, const long long* dims, const void* const* data,
+      std::string* err) = 0;
 };
 
 long long dense_elems(int ndim, const long long* dims) {
@@ -355,23 +367,98 @@ struct CppClient : ClientIface {
     return compile_xla(std::move(xc), err);
   }
 
-  ExeIface* compile_hlo(const std::string& hlo_proto,
-                        std::string* err) override {
+  ExeIface* compile_hlo(const std::string& hlo_proto, std::string* err,
+                        int n_replicas = 1) override {
     xla::HloModuleProto proto;
     if (!proto.ParseFromString(hlo_proto)) {
       *err = "HloModuleProto parse failed";
       return nullptr;
     }
-    return compile_xla(xla::XlaComputation(std::move(proto)), err);
+    return compile_xla(xla::XlaComputation(std::move(proto)), err,
+                       n_replicas);
   }
 
-  ExeIface* compile_xla(xla::XlaComputation xc, std::string* err) {
+  ExeIface* compile_xla(xla::XlaComputation xc, std::string* err,
+                        int n_replicas = 1) {
     xla::CompileOptions opts;
+    if (n_replicas > 1) {
+      opts.executable_build_options.set_num_replicas(n_replicas);
+    }
     auto exe_or = client->CompileAndLoad(xc, opts);
     if (!exe_or.ok()) { *err = exe_or.status().ToString(); return nullptr; }
     auto* e = new CppExe();
     e->exe = std::move(exe_or).value();
     return e;
+  }
+
+  ExeIface* compile_n(std::string_view module, int n_replicas,
+                      std::string* err) override {
+    if (n_replicas < 1 || n_replicas > device_count()) {
+      *err = "n_replicas " + std::to_string(n_replicas) +
+             " out of range (1.." + std::to_string(device_count()) + ")";
+      return nullptr;
+    }
+    xla::XlaComputation xc;
+    auto st = xla::ParseMlirModuleStringAndConvertToXlaComputation(
+        module, xc, /*use_tuple_args=*/false, /*return_tuple=*/false);
+    if (!st.ok()) { *err = st.ToString(); return nullptr; }
+    return compile_xla(std::move(xc), err, n_replicas);
+  }
+
+  ResultsIface* execute_replicated(ExeIface* exe_i, int n_replicas,
+                                   int nargs, const int* dtypes,
+                                   const int* ndims, const long long* dims,
+                                   const void* const* data,
+                                   std::string* err) override {
+    auto* exe = static_cast<CppExe*>(exe_i);
+    auto da = exe->exe->device_assignment();
+    if (n_replicas < 1 || n_replicas > da.replica_count()) {
+      *err = "n_replicas " + std::to_string(n_replicas) +
+             " does not match the executable's replica count " +
+             std::to_string(da.replica_count());
+      return nullptr;
+    }
+    std::vector<std::vector<std::unique_ptr<xla::PjRtBuffer>>> in_bufs(
+        n_replicas);
+    std::vector<std::vector<xla::PjRtBuffer*>> arg_lists(n_replicas);
+    for (int r = 0; r < n_replicas; ++r) {
+      int dev_id = da(r, 0);
+      xla::PjRtDevice* device = nullptr;
+      for (auto* d : client->addressable_devices()) {
+        if (d->id() == dev_id) { device = d; break; }
+      }
+      if (!device) {
+        *err = "replica " + std::to_string(r) + ": device " +
+               std::to_string(dev_id) + " not addressable";
+        return nullptr;
+      }
+      auto ms_or = device->default_memory_space();
+      if (!ms_or.ok()) { *err = ms_or.status().ToString(); return nullptr; }
+      const long long* d = dims;
+      for (int a = 0; a < nargs; ++a) {
+        std::vector<int64_t> shape(d, d + ndims[a]);
+        d += ndims[a];
+        auto buf_or = client->BufferFromHostBuffer(
+            data[r * nargs + a], to_xla_type(dtypes[a]), shape,
+            std::nullopt,
+            xla::PjRtClient::HostBufferSemantics::kImmutableOnlyDuringCall,
+            nullptr, ms_or.value(), nullptr);
+        if (!buf_or.ok()) {
+          *err = buf_or.status().ToString();
+          return nullptr;
+        }
+        in_bufs[r].push_back(std::move(buf_or).value());
+        arg_lists[r].push_back(in_bufs[r].back().get());
+      }
+    }
+    auto out_or = exe->exe->Execute(absl::MakeSpan(arg_lists),
+                                    xla::ExecuteOptions());
+    if (!out_or.ok()) { *err = out_or.status().ToString(); return nullptr; }
+    auto* res = new CppResults();
+    for (auto& per_replica : out_or.value()) {
+      for (auto& b : per_replica) res->bufs.push_back(std::move(b));
+    }
+    return res;
   }
 
   ResultsIface* execute(ExeIface* exe_i, int nargs, const int* dtypes,
@@ -478,6 +565,13 @@ int from_capi_type(PJRT_Buffer_Type t) {
 //   executable_build_options (field 3) {
 //     num_replicas (field 4) = 1; num_partitions (field 5) = 1; }
 const char kCompileOptionsProto[] = {0x1a, 0x04, 0x20, 0x01, 0x28, 0x01};
+
+// Same proto with num_replicas = n (single-byte varint, n < 128).
+std::string compile_options_proto(int n_replicas) {
+  std::string p(kCompileOptionsProto, sizeof(kCompileOptionsProto));
+  p[3] = static_cast<char>(n_replicas);
+  return p;
+}
 
 struct CApiExe : ExeIface {
   const PJRT_Api* api = nullptr;
@@ -641,13 +735,13 @@ struct CApiClient : ClientIface {
     return compile_fmt(module, "mlir", err);
   }
 
-  ExeIface* compile_hlo(const std::string& hlo_proto,
-                        std::string* err) override {
-    return compile_fmt(hlo_proto, "hlo", err);
+  ExeIface* compile_hlo(const std::string& hlo_proto, std::string* err,
+                        int n_replicas = 1) override {
+    return compile_fmt(hlo_proto, "hlo", err, n_replicas);
   }
 
   ExeIface* compile_fmt(std::string_view module, const char* format,
-                        std::string* err) {
+                        std::string* err, int n_replicas = 1) {
     PJRT_Program prog;
     std::memset(&prog, 0, sizeof(prog));
     prog.struct_size = PJRT_Program_STRUCT_SIZE;
@@ -656,13 +750,14 @@ struct CApiClient : ClientIface {
     prog.format = format;
     prog.format_size = std::strlen(format);
 
+    std::string opts = compile_options_proto(n_replicas);
     PJRT_Client_Compile_Args ca;
     std::memset(&ca, 0, sizeof(ca));
     ca.struct_size = PJRT_Client_Compile_Args_STRUCT_SIZE;
     ca.client = client;
     ca.program = &prog;
-    ca.compile_options = kCompileOptionsProto;
-    ca.compile_options_size = sizeof(kCompileOptionsProto);
+    ca.compile_options = opts.data();
+    ca.compile_options_size = opts.size();
     if (auto* e = api->PJRT_Client_Compile(&ca)) {
       *err = capi_err(api, e);
       return nullptr;
@@ -671,6 +766,151 @@ struct CApiClient : ClientIface {
     ex->api = api;
     ex->exe = ca.executable;
     return ex;
+  }
+
+  ExeIface* compile_n(std::string_view module, int n_replicas,
+                      std::string* err) override {
+    if (n_replicas < 1 || n_replicas > 127 ||
+        n_replicas > device_count()) {
+      *err = "n_replicas " + std::to_string(n_replicas) +
+             " out of range (1.." + std::to_string(device_count()) + ")";
+      return nullptr;
+    }
+    return compile_fmt(module, "mlir", err, n_replicas);
+  }
+
+  ResultsIface* execute_replicated(ExeIface* exe_i, int n_replicas,
+                                   int nargs, const int* dtypes,
+                                   const int* ndims, const long long* dims,
+                                   const void* const* data,
+                                   std::string* err) override {
+    auto* exe = static_cast<CApiExe*>(exe_i);
+    // the executable's addressable devices, in replica order
+    PJRT_LoadedExecutable_AddressableDevices_Args ad;
+    std::memset(&ad, 0, sizeof(ad));
+    ad.struct_size =
+        PJRT_LoadedExecutable_AddressableDevices_Args_STRUCT_SIZE;
+    ad.executable = exe->exe;
+    if (auto* e = api->PJRT_LoadedExecutable_AddressableDevices(&ad)) {
+      *err = capi_err(api, e);
+      return nullptr;
+    }
+    if (static_cast<int>(ad.num_addressable_devices) < n_replicas) {
+      *err = "executable has " + std::to_string(ad.num_addressable_devices)
+             + " addressable devices, need " + std::to_string(n_replicas);
+      return nullptr;
+    }
+
+    std::vector<PJRT_Buffer*> in_bufs;
+    auto destroy_inputs = [&]() {
+      for (auto* b : in_bufs) {
+        PJRT_Buffer_Destroy_Args dd;
+        std::memset(&dd, 0, sizeof(dd));
+        dd.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
+        dd.buffer = b;
+        capi_err(api, api->PJRT_Buffer_Destroy(&dd));
+      }
+    };
+    std::vector<std::vector<PJRT_Buffer*>> arg_lists(n_replicas);
+    for (int r = 0; r < n_replicas; ++r) {
+      PJRT_Device* device = ad.addressable_devices[r];
+      const long long* d = dims;
+      for (int a = 0; a < nargs; ++a) {
+        std::vector<int64_t> shape(d, d + ndims[a]);
+        d += ndims[a];
+        PJRT_Client_BufferFromHostBuffer_Args bh;
+        std::memset(&bh, 0, sizeof(bh));
+        bh.struct_size = PJRT_Client_BufferFromHostBuffer_Args_STRUCT_SIZE;
+        bh.client = client;
+        bh.data = data[r * nargs + a];
+        bh.type = to_capi_type(dtypes[a]);
+        bh.dims = shape.data();
+        bh.num_dims = shape.size();
+        bh.host_buffer_semantics =
+            PJRT_HostBufferSemantics_kImmutableOnlyDuringCall;
+        bh.device = device;
+        if (auto* e = api->PJRT_Client_BufferFromHostBuffer(&bh)) {
+          *err = capi_err(api, e);
+          destroy_inputs();
+          return nullptr;
+        }
+        std::string msg = capi_await(api, bh.done_with_host_buffer);
+        in_bufs.push_back(bh.buffer);
+        arg_lists[r].push_back(bh.buffer);
+        if (!msg.empty()) {
+          *err = msg;
+          destroy_inputs();
+          return nullptr;
+        }
+      }
+    }
+
+    PJRT_LoadedExecutable_GetExecutable_Args ge;
+    std::memset(&ge, 0, sizeof(ge));
+    ge.struct_size = PJRT_LoadedExecutable_GetExecutable_Args_STRUCT_SIZE;
+    ge.loaded_executable = exe->exe;
+    if (auto* e = api->PJRT_LoadedExecutable_GetExecutable(&ge)) {
+      *err = capi_err(api, e);
+      destroy_inputs();
+      return nullptr;
+    }
+    PJRT_Executable_NumOutputs_Args no;
+    std::memset(&no, 0, sizeof(no));
+    no.struct_size = PJRT_Executable_NumOutputs_Args_STRUCT_SIZE;
+    no.executable = ge.executable;
+    if (auto* e = api->PJRT_Executable_NumOutputs(&no)) {
+      *err = capi_err(api, e);
+      destroy_inputs();
+      return nullptr;
+    }
+
+    PJRT_ExecuteOptions opts;
+    std::memset(&opts, 0, sizeof(opts));
+    opts.struct_size = PJRT_ExecuteOptions_STRUCT_SIZE;
+
+    std::vector<std::vector<PJRT_Buffer*>> outs(
+        n_replicas, std::vector<PJRT_Buffer*>(no.num_outputs, nullptr));
+    std::vector<PJRT_Buffer* const*> arg_ptrs(n_replicas);
+    std::vector<PJRT_Buffer**> out_ptrs(n_replicas);
+    for (int r = 0; r < n_replicas; ++r) {
+      arg_ptrs[r] = arg_lists[r].data();
+      out_ptrs[r] = outs[r].data();
+    }
+    std::vector<PJRT_Event*> done(n_replicas, nullptr);
+
+    PJRT_LoadedExecutable_Execute_Args ex;
+    std::memset(&ex, 0, sizeof(ex));
+    ex.struct_size = PJRT_LoadedExecutable_Execute_Args_STRUCT_SIZE;
+    ex.executable = exe->exe;
+    ex.options = &opts;
+    ex.argument_lists = arg_ptrs.data();
+    ex.num_devices = static_cast<size_t>(n_replicas);
+    ex.num_args = static_cast<size_t>(nargs);
+    ex.output_lists = out_ptrs.data();
+    ex.device_complete_events = done.data();
+    ex.execute_device = nullptr;  // multi-device launch
+    if (auto* e = api->PJRT_LoadedExecutable_Execute(&ex)) {
+      *err = capi_err(api, e);
+      destroy_inputs();
+      return nullptr;
+    }
+    std::string msg;
+    for (int r = 0; r < n_replicas; ++r) {
+      std::string m = capi_await(api, done[r]);
+      if (!m.empty() && msg.empty()) msg = m;
+    }
+    destroy_inputs();
+    auto* res = new CApiResults();
+    res->api = api;
+    for (int r = 0; r < n_replicas; ++r) {
+      for (auto* b : outs[r]) res->bufs.push_back(b);
+    }
+    if (!msg.empty()) {
+      *err = msg;
+      delete res;
+      return nullptr;
+    }
+    return res;
   }
 
   ResultsIface* execute(ExeIface* exe_i, int nargs, const int* dtypes,
@@ -890,6 +1130,16 @@ tfr_pjrt_exe* tfr_pjrt_compile_dynamic(
     int cc_version, const char* platforms_csv, const char* select_platform,
     int nargs, const int* dtypes, const int* ndims, const long long* dims,
     char* err, int errlen) {
+  return tfr_pjrt_compile_dynamic_n(
+      c, module_bytes, module_len, cc_version, platforms_csv,
+      select_platform, nargs, dtypes, ndims, dims, 1, err, errlen);
+}
+
+tfr_pjrt_exe* tfr_pjrt_compile_dynamic_n(
+    tfr_pjrt_client* c, const char* module_bytes, long module_len,
+    int cc_version, const char* platforms_csv, const char* select_platform,
+    int nargs, const int* dtypes, const int* ndims, const long long* dims,
+    int n_replicas, char* err, int errlen) {
   std::vector<std::string> platforms;
   std::string csv(platforms_csv ? platforms_csv : "");
   size_t pos = 0;
@@ -910,13 +1160,52 @@ tfr_pjrt_exe* tfr_pjrt_compile_dynamic(
     return nullptr;
   }
   std::string errmsg;
-  ExeIface* e = c->impl->compile_hlo(hlo_or.value(), &errmsg);
+  ExeIface* e = c->impl->compile_hlo(hlo_or.value(), &errmsg, n_replicas);
   if (!e) {
     set_err(err, errlen, errmsg);
     return nullptr;
   }
   auto* out = new tfr_pjrt_exe();
   out->impl.reset(e);
+  return out;
+}
+
+tfr_pjrt_exe* tfr_pjrt_compile_n(tfr_pjrt_client* c,
+                                 const char* module_bytes, long module_len,
+                                 int n_replicas, char* err, int errlen) {
+  std::string errmsg;
+  ExeIface* e = c->impl->compile_n(
+      std::string_view(module_bytes, static_cast<size_t>(module_len)),
+      n_replicas, &errmsg);
+  if (!e) {
+    set_err(err, errlen, errmsg);
+    return nullptr;
+  }
+  auto* out = new tfr_pjrt_exe();
+  out->impl.reset(e);
+  return out;
+}
+
+tfr_pjrt_results* tfr_pjrt_execute_replicated(
+    tfr_pjrt_client* c, tfr_pjrt_exe* e, int n_replicas, int nargs,
+    const int* dtypes, const int* ndims, const long long* dims,
+    const void* const* data, char* err, int errlen) {
+  for (int a = 0; a < nargs; ++a) {
+    if (dtype_size(dtypes[a]) == 0) {
+      set_err(err, errlen,
+              "unsupported dtype code " + std::to_string(dtypes[a]));
+      return nullptr;
+    }
+  }
+  std::string errmsg;
+  ResultsIface* r = c->impl->execute_replicated(
+      e->impl.get(), n_replicas, nargs, dtypes, ndims, dims, data, &errmsg);
+  if (!r) {
+    set_err(err, errlen, errmsg);
+    return nullptr;
+  }
+  auto* out = new tfr_pjrt_results();
+  out->impl.reset(r);
   return out;
 }
 

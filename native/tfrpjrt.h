@@ -73,6 +73,29 @@ tfr_pjrt_exe* tfr_pjrt_compile_dynamic(
     int nargs, const int* dtypes, const int* ndims, const long long* dims,
     char* err, int errlen);
 
+/* As tfr_pjrt_compile_dynamic, replicated n_replicas times (SPMD). */
+tfr_pjrt_exe* tfr_pjrt_compile_dynamic_n(
+    tfr_pjrt_client* c, const char* module_bytes, long module_len,
+    int cc_version, const char* platforms_csv, const char* select_platform,
+    int nargs, const int* dtypes, const int* ndims, const long long* dims,
+    int n_replicas, char* err, int errlen);
+
+/* SPMD-replicated compile: one program instance per device,
+ * n_replicas <= device count (and < 128). */
+tfr_pjrt_exe* tfr_pjrt_compile_n(tfr_pjrt_client* c,
+                                 const char* module_bytes, long module_len,
+                                 int n_replicas, char* err, int errlen);
+
+/* Execute a replicated executable across its devices in ONE call.
+ * data holds n_replicas * nargs host pointers, replica-major; every
+ * replica shares the same shapes (dtypes/ndims/dims as in
+ * tfr_pjrt_execute). Results are replica-major: n_replicas * n_outputs
+ * entries. */
+tfr_pjrt_results* tfr_pjrt_execute_replicated(
+    tfr_pjrt_client* c, tfr_pjrt_exe* e, int n_replicas, int nargs,
+    const int* dtypes, const int* ndims, const long long* dims,
+    const void* const* data, char* err, int errlen);
+
 void tfr_pjrt_exe_destroy(tfr_pjrt_exe* e);
 
 /* Execute on the client's device (ordinal "tfr_device" from the spec;

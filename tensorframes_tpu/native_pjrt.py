@@ -98,6 +98,18 @@ def _load() -> Optional[ctypes.CDLL]:
         ctypes.c_char_p, ci, ctypes.POINTER(ci), ctypes.POINTER(ci),
         ctypes.POINTER(cll), ctypes.c_char_p, ci]
     lib.tfr_pjrt_compile_dynamic.restype = vp
+    lib.tfr_pjrt_compile_dynamic_n.argtypes = [
+        vp, ctypes.c_char_p, ctypes.c_long, ci, ctypes.c_char_p,
+        ctypes.c_char_p, ci, ctypes.POINTER(ci), ctypes.POINTER(ci),
+        ctypes.POINTER(cll), ci, ctypes.c_char_p, ci]
+    lib.tfr_pjrt_compile_dynamic_n.restype = vp
+    lib.tfr_pjrt_compile_n.argtypes = [vp, ctypes.c_char_p, ctypes.c_long,
+                                       ci, ctypes.c_char_p, ci]
+    lib.tfr_pjrt_compile_n.restype = vp
+    lib.tfr_pjrt_execute_replicated.argtypes = [
+        vp, vp, ci, ci, ctypes.POINTER(ci), ctypes.POINTER(ci),
+        ctypes.POINTER(cll), ctypes.POINTER(vp), ctypes.c_char_p, ci]
+    lib.tfr_pjrt_execute_replicated.restype = vp
     lib.tfr_pjrt_exe_destroy.argtypes = [vp]
     lib.tfr_pjrt_execute.argtypes = [vp, vp, ci, ctypes.POINTER(ci),
                                      ctypes.POINTER(ci),
@@ -166,6 +178,68 @@ class PjrtCoreError(RuntimeError):
     pass
 
 
+def _dtype_code(dt: np.dtype) -> int:
+    code = _CODES.get(dt)
+    if code is None:
+        if dt == _dt.bfloat16.np_storage:
+            return _BF16_CODE
+        raise PjrtCoreError(f"unsupported input dtype {dt}")
+    return code
+
+
+def _read_results(lib, res) -> list:
+    """Decode every result buffer of a tfr_pjrt_results into numpy
+    (shared by the single and replicated execute paths)."""
+    err = ctypes.create_string_buffer(_ERRLEN)
+    outs = []
+    for i in range(lib.tfr_pjrt_results_count(res)):
+        dt = ctypes.c_int()
+        nd = ctypes.c_int()
+        odims = (ctypes.c_longlong * 8)()
+        if lib.tfr_pjrt_result_meta(res, i, ctypes.byref(dt),
+                                    ctypes.byref(nd), odims):
+            raise PjrtCoreError(f"result {i}: meta query failed")
+        shape = tuple(odims[k] for k in range(nd.value))
+        np_dt = (_dt.bfloat16.np_storage if dt.value == _BF16_CODE
+                 else _NP_FROM_CODE.get(dt.value))
+        if np_dt is None:
+            raise PjrtCoreError(
+                f"result {i}: unsupported dtype code {dt.value}")
+        out = np.empty(shape, np_dt)
+        if lib.tfr_pjrt_result_read(
+                res, i, out.ctypes.data_as(ctypes.c_void_p),
+                out.nbytes, err, _ERRLEN):
+            raise PjrtCoreError(
+                f"result {i}: {err.value.decode(errors='replace')}")
+        outs.append(out)
+    return outs
+
+
+def _device_views(comp: "Computation", arrays: Mapping) -> Dict:
+    """Inputs as contiguous device-dtype arrays (shared input prep)."""
+    dev = {}
+    for spec in comp.inputs:
+        a = np.ascontiguousarray(arrays[spec.name])
+        dd = _dt.device_dtype(spec.dtype)
+        if a.dtype != dd:
+            from . import native as _native
+            a = _native.convert(a, dd)
+        dev[spec.name] = a
+    return dev
+
+
+def _to_storage(comp: "Computation", outs) -> Dict:
+    """Zip outputs back to names + storage dtypes (shared output conv)."""
+    rec = {}
+    for spec, a in zip(comp.outputs, outs):
+        storage = spec.dtype.np_storage
+        if a.dtype != storage and spec.dtype is not _dt.bfloat16:
+            from . import native as _native
+            a = _native.convert(a, storage)
+        rec[spec.name] = a
+    return rec
+
+
 class PjrtCoreClient:
     """A native PJRT client: the per-host analogue of the reference's
     per-executor TF C++ session factory (``TensorFlowOps.withSession``).
@@ -210,24 +284,18 @@ class PjrtCoreClient:
         return PjrtExecutable(self, h)
 
     def compile_dynamic(self, module: bytes, cc_version: int, platforms,
-                        arg_dtypes, arg_shapes) -> "PjrtExecutable":
+                        arg_dtypes, arg_shapes, n_replicas: int = 1):
         """Compile a serialized dynamic-shape module (jax.export wire
         format) at concrete shapes: refinement happens in the native core,
         no jax involved. ``arg_dtypes``: numpy dtypes; ``arg_shapes``:
-        tuples."""
+        tuples. ``n_replicas > 1`` compiles SPMD-replicated and returns a
+        :class:`PjrtReplicatedExecutable`."""
         n = len(arg_dtypes)
         dtypes = (ctypes.c_int * n)()
         ndims = (ctypes.c_int * n)()
         flat = []
         for i, (dt, shp) in enumerate(zip(arg_dtypes, arg_shapes)):
-            dt = np.dtype(dt)
-            code = _CODES.get(dt)
-            if code is None:
-                if dt == _dt.bfloat16.np_storage:
-                    code = _BF16_CODE
-                else:
-                    raise PjrtCoreError(f"unsupported input dtype {dt}")
-            dtypes[i] = code
+            dtypes[i] = _dtype_code(np.dtype(dt))
             ndims[i] = len(shp)
             flat.extend(shp)
         dims = (ctypes.c_longlong * max(1, len(flat)))(*flat)
@@ -237,15 +305,31 @@ class PjrtCoreClient:
                 f"computation was lowered for {platforms}, not for this "
                 f"client's platform {select!r}")
         err = ctypes.create_string_buffer(_ERRLEN)
-        h = self._lib.tfr_pjrt_compile_dynamic(
+        h = self._lib.tfr_pjrt_compile_dynamic_n(
             self._client, module, len(module), cc_version,
             ",".join(platforms).encode(), select.encode(), n, dtypes,
-            ndims, dims, err, _ERRLEN)
+            ndims, dims, n_replicas, err, _ERRLEN)
         if not h:
             raise PjrtCoreError(
                 f"dynamic compile failed: "
                 f"{err.value.decode(errors='replace')}")
+        if n_replicas > 1:
+            return PjrtReplicatedExecutable(self, h, n_replicas)
         return PjrtExecutable(self, h)
+
+    def compile_replicated(self, stablehlo: bytes,
+                           n_replicas: int) -> "PjrtReplicatedExecutable":
+        """Compile for ``n_replicas`` devices (SPMD replication); run all
+        replicas in one native call via the returned executable."""
+        err = ctypes.create_string_buffer(_ERRLEN)
+        h = self._lib.tfr_pjrt_compile_n(self._client, stablehlo,
+                                         len(stablehlo), n_replicas, err,
+                                         _ERRLEN)
+        if not h:
+            raise PjrtCoreError(
+                f"replicated compile failed: "
+                f"{err.value.decode(errors='replace')}")
+        return PjrtReplicatedExecutable(self, h, n_replicas)
 
     def close(self):
         if self._client:
@@ -276,13 +360,7 @@ class PjrtExecutable:
         flat_dims = []
         datas = (ctypes.c_void_p * n)()
         for i, a in enumerate(arrays):
-            code = _CODES.get(a.dtype)
-            if code is None:
-                if a.dtype == _dt.bfloat16.np_storage:
-                    code = _BF16_CODE
-                else:
-                    raise PjrtCoreError(f"unsupported input dtype {a.dtype}")
-            dtypes[i] = code
+            dtypes[i] = _dtype_code(a.dtype)
             ndims[i] = a.ndim
             flat_dims.extend(a.shape)
             datas[i] = a.ctypes.data_as(ctypes.c_void_p)
@@ -294,30 +372,80 @@ class PjrtExecutable:
             raise PjrtCoreError(
                 f"execute failed: {err.value.decode(errors='replace')}")
         try:
-            outs = []
-            for i in range(lib.tfr_pjrt_results_count(res)):
-                dt = ctypes.c_int()
-                nd = ctypes.c_int()
-                odims = (ctypes.c_longlong * 8)()
-                if lib.tfr_pjrt_result_meta(res, i, ctypes.byref(dt),
-                                            ctypes.byref(nd), odims):
-                    raise PjrtCoreError(f"result {i}: meta query failed")
-                shape = tuple(odims[k] for k in range(nd.value))
-                np_dt = (_dt.bfloat16.np_storage if dt.value == _BF16_CODE
-                         else _NP_FROM_CODE.get(dt.value))
-                if np_dt is None:
-                    raise PjrtCoreError(
-                        f"result {i}: unsupported dtype code {dt.value}")
-                out = np.empty(shape, np_dt)
-                if lib.tfr_pjrt_result_read(
-                        res, i, out.ctypes.data_as(ctypes.c_void_p),
-                        out.nbytes, err, _ERRLEN):
-                    raise PjrtCoreError(
-                        f"result {i}: {err.value.decode(errors='replace')}")
-                outs.append(out)
-            return outs
+            return _read_results(lib, res)
         finally:
             lib.tfr_pjrt_results_destroy(res)
+
+    def close(self):
+        if self._h:
+            self._client._lib.tfr_pjrt_exe_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class PjrtReplicatedExecutable:
+    """A program compiled for N devices; one ``execute`` call runs every
+    replica in parallel inside the native core — the in-process analogue
+    of the reference's fleet of executor sessions each running the same
+    shipped graph on its partition (``DebugRowOps.scala:372-386``)."""
+
+    def __init__(self, client: PjrtCoreClient, handle, n_replicas: int):
+        self._client = client
+        self._h = handle
+        self.n_replicas = n_replicas
+
+    def execute(self, per_replica_args) -> list:
+        """``per_replica_args``: list of ``n_replicas`` argument lists
+        (equal shapes/dtypes across replicas — XLA's static world).
+        Returns one output list per replica."""
+        lib = self._client._lib
+        if len(per_replica_args) != self.n_replicas:
+            raise PjrtCoreError(
+                f"expected {self.n_replicas} replica argument lists, got "
+                f"{len(per_replica_args)}")
+        nargs = len(per_replica_args[0])
+        views = [[np.ascontiguousarray(a) for a in rep]
+                 for rep in per_replica_args]
+        first = views[0]
+        dtypes = (ctypes.c_int * nargs)()
+        ndims = (ctypes.c_int * nargs)()
+        flat_dims = []
+        for i, a in enumerate(first):
+            dtypes[i] = _dtype_code(a.dtype)
+            ndims[i] = a.ndim
+            flat_dims.extend(a.shape)
+        for rep in views[1:]:
+            if len(rep) != nargs or any(
+                    b.shape != a.shape or b.dtype != a.dtype
+                    for a, b in zip(first, rep)):
+                raise PjrtCoreError(
+                    "replica argument lists must share shapes and dtypes")
+        dims = (ctypes.c_longlong * max(1, len(flat_dims)))(*flat_dims)
+        n_total = self.n_replicas * nargs
+        datas = (ctypes.c_void_p * n_total)()
+        for r, rep in enumerate(views):
+            for i, a in enumerate(rep):
+                datas[r * nargs + i] = a.ctypes.data_as(ctypes.c_void_p)
+        err = ctypes.create_string_buffer(_ERRLEN)
+        res = lib.tfr_pjrt_execute_replicated(
+            self._client._client, self._h, self.n_replicas, nargs, dtypes,
+            ndims, dims, datas, err, _ERRLEN)
+        if not res:
+            raise PjrtCoreError(
+                f"replicated execute failed: "
+                f"{err.value.decode(errors='replace')}")
+        try:
+            outs = _read_results(lib, res)
+        finally:
+            lib.tfr_pjrt_results_destroy(res)
+        per_rep = len(outs) // self.n_replicas
+        return [outs[r * per_rep:(r + 1) * per_rep]
+                for r in range(self.n_replicas)]
 
     def close(self):
         if self._h:
@@ -399,53 +527,92 @@ class PjrtBlockExecutor:
         self._lock = threading.Lock()
         self.compile_count = 0
 
+    def _compiled(self, comp: Computation, dev_arrays: Dict,
+                  n_replicas: int = 1):
+        """Per-(comp, signature[, replicas]) compile cache. Shipped
+        computations (``_native_dynamic``) refine + compile natively;
+        live ones lower through jax tracing."""
+        in_names = [s.name for s in comp.inputs]
+        sig = tuple((n, dev_arrays[n].shape, str(dev_arrays[n].dtype))
+                    for n in in_names)
+        if n_replicas > 1:
+            sig = ("replicated", n_replicas) + sig
+        per_comp = self._cache.get(comp)
+        exe = None if per_comp is None else per_comp.get(sig)
+        if exe is not None:
+            return exe
+        with self._lock:
+            per_comp = self._cache.setdefault(comp, {})
+            exe = per_comp.get(sig)
+            if exe is not None:
+                return exe
+            dyn = getattr(comp, "_native_dynamic", None)
+            if dyn:
+                exe = self.client.compile_dynamic(
+                    dyn["module"], dyn["cc_version"], dyn["platforms"],
+                    [dev_arrays[n].dtype for n in in_names],
+                    [dev_arrays[n].shape for n in in_names],
+                    n_replicas=n_replicas)
+            else:
+                hlo = _lower_stablehlo(comp, dev_arrays, in_names,
+                                       [s.name for s in comp.outputs])
+                exe = (self.client.compile_replicated(hlo, n_replicas)
+                       if n_replicas > 1 else self.client.compile(hlo))
+            per_comp[sig] = exe
+            self.compile_count += 1
+            _log.debug("native compile #%d for %s", self.compile_count,
+                       sig)
+            return exe
+
     def run(self, comp: Computation, arrays: Mapping[str, np.ndarray],
             pad_ok: bool = True) -> Dict[str, np.ndarray]:
         del pad_ok  # exact-shape compiles; padding never applies
         in_names = [s.name for s in comp.inputs]
-        out_names = [s.name for s in comp.outputs]
-        dev_arrays = {}
-        for spec in comp.inputs:
-            a = np.ascontiguousarray(arrays[spec.name])
-            dd = _dt.device_dtype(spec.dtype)
-            if a.dtype != dd:
-                from . import native as _native
-                a = _native.convert(a, dd)
-            dev_arrays[spec.name] = a
-        sig = tuple((n, dev_arrays[n].shape, str(dev_arrays[n].dtype))
-                    for n in in_names)
-        per_comp = self._cache.get(comp)
-        exe = None if per_comp is None else per_comp.get(sig)
-        if exe is None:
-            with self._lock:
-                per_comp = self._cache.setdefault(comp, {})
-                exe = per_comp.get(sig)
-                if exe is None:
-                    dyn = getattr(comp, "_native_dynamic", None)
-                    if dyn:
-                        # shipped computation: refine + compile natively
-                        exe = self.client.compile_dynamic(
-                            dyn["module"], dyn["cc_version"],
-                            dyn["platforms"],
-                            [dev_arrays[n].dtype for n in in_names],
-                            [dev_arrays[n].shape for n in in_names])
-                    else:
-                        hlo = _lower_stablehlo(comp, dev_arrays, in_names,
-                                               out_names)
-                        exe = self.client.compile(hlo)
-                    per_comp[sig] = exe
-                    self.compile_count += 1
-                    _log.debug("native compile #%d for %s",
-                               self.compile_count, sig)
+        dev_arrays = _device_views(comp, arrays)
+        exe = self._compiled(comp, dev_arrays)
         outs = exe.execute([dev_arrays[n] for n in in_names])
-        result: Dict[str, np.ndarray] = {}
-        for spec, a in zip(comp.outputs, outs):
-            storage = spec.dtype.np_storage
-            if a.dtype != storage and spec.dtype is not _dt.bfloat16:
-                from . import native as _native
-                a = _native.convert(a, storage)
-            result[spec.name] = a
-        return result
+        return _to_storage(comp, outs)
+
+    def run_blocks_parallel(self, comp: Computation, blocks,
+                            ) -> "list[Dict[str, np.ndarray]]":
+        """Run one map computation over MANY blocks in parallel — native
+        replicated dispatches in device-count-sized waves when the blocks
+        share shapes, else the sequential per-block path.
+
+        The parallel case is the reference's executor fleet in-process:
+        every device runs the same compiled program on its own partition,
+        one C++ call per wave. Works for shipped (jax-free) computations
+        too — the replicated compile goes through the native refinement.
+        """
+        blocks = list(blocks)
+        if not blocks:
+            return []
+        in_names = [s.name for s in comp.inputs]
+        prepared = [_device_views(comp, arrays) for arrays in blocks]
+        sig0 = tuple((n, prepared[0][n].shape, str(prepared[0][n].dtype))
+                     for n in in_names)
+        uniform = all(
+            tuple((n, p[n].shape, str(p[n].dtype)) for n in in_names)
+            == sig0 for p in prepared[1:])
+        wave = min(len(prepared), self.client.device_count)
+        if not uniform or wave < 2:
+            return [self.run(comp, p, pad_ok=False) for p in prepared]
+
+        results: "list[Dict[str, np.ndarray]]" = []
+        i = 0
+        # full waves run replicated; the ragged tail (< wave blocks, a
+        # different replica count) takes the sequential path rather than
+        # paying a second replicated compile
+        while len(prepared) - i >= wave:
+            exe = self._compiled(comp, prepared[i], n_replicas=wave)
+            rep_outs = exe.execute(
+                [[p[nm] for nm in in_names]
+                 for p in prepared[i:i + wave]])
+            results.extend(_to_storage(comp, outs) for outs in rep_outs)
+            i += wave
+        for p in prepared[i:]:
+            results.append(self.run(comp, p, pad_ok=False))
+        return results
 
     def clear(self):
         with self._lock:
