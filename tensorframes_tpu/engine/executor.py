@@ -28,7 +28,8 @@ from ..computation import Computation
 from ..utils.logging import get_logger
 from ..utils.tracing import enabled as _tracing_enabled, span
 
-__all__ = ["BlockExecutor", "default_executor", "default_padding_executor"]
+__all__ = ["BlockExecutor", "PaddingExecutor", "default_executor",
+           "default_padding_executor"]
 
 _log = get_logger("engine.executor")
 
@@ -38,6 +39,43 @@ def _next_bucket(n: int, minimum: int = 8) -> int:
     while b < n:
         b *= 2
     return b
+
+
+def _row_count(comp: Computation, arrays: Mapping) -> Optional[int]:
+    """Leading row count of the first row-dimensioned input, if any."""
+    for spec in comp.inputs:
+        if spec.shape.ndim > 0 and spec.shape.head == -1:
+            return np.asarray(arrays[spec.name]).shape[0]
+    return None
+
+
+def _pad_inputs(comp: Computation, arrays: Mapping, pad_to: int,
+                n_rows: int) -> Dict[str, np.ndarray]:
+    """Pad row-dimensioned inputs to ``pad_to`` rows (edge fill; pooled
+    staging buffers so bucketed sizes reuse allocations)."""
+    padded = {}
+    for spec in comp.inputs:
+        a = np.asarray(arrays[spec.name])
+        if spec.shape.ndim > 0 and spec.shape.head == -1:
+            dst = _native.empty_aligned((pad_to,) + a.shape[1:], a.dtype)
+            dst[:n_rows] = a
+            dst[n_rows:] = a[n_rows - 1:n_rows]  # edge fill
+            a = dst
+        padded[spec.name] = a
+    return padded
+
+
+def _slice_outputs(comp: Computation, out: Mapping, pad_to: int,
+                   n_rows: int) -> Dict[str, np.ndarray]:
+    """Drop pad rows from row-dimensioned outputs."""
+    result = {}
+    for spec in comp.outputs:
+        a = out[spec.name]
+        if spec.shape.ndim > 0 and spec.shape.head == -1 \
+                and a.shape[:1] == (pad_to,):
+            a = a[:n_rows]
+        result[spec.name] = a
+    return result
 
 
 class BlockExecutor:
@@ -98,22 +136,10 @@ class BlockExecutor:
                     n_rows = a.shape[0] if n_rows is None else n_rows
 
         pad_to = None
-        if self.pad_rows and pad_ok and n_rows is not None:
+        if self.pad_rows and pad_ok and n_rows:  # 0-row blocks never pad
             pad_to = _next_bucket(n_rows)
             if pad_to != n_rows:
-                padded = {}
-                for spec in comp.inputs:
-                    a = dev_arrays[spec.name]
-                    if spec.shape.ndim > 0 and spec.shape.head == -1:
-                        # pooled staging buffer: bucketed sizes are hot, so
-                        # freed buffers are immediately reused (native.py)
-                        dst = _native.empty_aligned(
-                            (pad_to,) + a.shape[1:], a.dtype)
-                        dst[:n_rows] = a
-                        dst[n_rows:] = a[n_rows - 1:n_rows]  # edge fill
-                        a = dst
-                    padded[spec.name] = a
-                dev_arrays = padded
+                dev_arrays = _pad_inputs(comp, dev_arrays, pad_to, n_rows)
 
         sig = tuple(sorted(
             (n, a.shape, str(a.dtype)) for n, a in dev_arrays.items()))
@@ -126,11 +152,12 @@ class BlockExecutor:
                 jax.block_until_ready(out)
         result: Dict[str, np.ndarray] = {}
         with span("executor.convert_back"):
+            host_out = {s.name: np.asarray(out[s.name])
+                        for s in comp.outputs}
+            if pad_to is not None:
+                host_out = _slice_outputs(comp, host_out, pad_to, n_rows)
             for spec in comp.outputs:
-                a = np.asarray(out[spec.name])
-                if pad_to is not None and spec.shape.ndim > 0 \
-                        and spec.shape.head == -1 and a.shape[:1] == (pad_to,):
-                    a = a[:n_rows]
+                a = host_out[spec.name]
                 storage = spec.dtype.np_storage
                 if a.dtype != storage and spec.dtype is not _dt.bfloat16:
                     a = _native.convert(a, storage)
@@ -140,6 +167,39 @@ class BlockExecutor:
     def clear(self):
         with self._lock:
             self._cache.clear()
+
+
+class PaddingExecutor:
+    """Bucketed-padding wrapper around ANY exact-shape executor.
+
+    Pads the leading (row) dimension of row-dimensioned inputs to
+    power-of-two buckets before delegating, and slices outputs back — so
+    streams of odd-sized blocks share the inner executor's compiled
+    programs (the same compile-signature bound ``BlockExecutor(pad_rows=
+    True)`` provides, but composable with e.g. the native PJRT executor).
+    Only valid for row-local computations, like every padding path.
+    """
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.pad_rows = True
+
+    @property
+    def compile_count(self) -> int:
+        return self.inner.compile_count
+
+    def run(self, comp: Computation, arrays: Mapping[str, np.ndarray],
+            pad_ok: bool = True) -> Dict[str, np.ndarray]:
+        n_rows = _row_count(comp, arrays)
+        pad_to = _next_bucket(n_rows) if (pad_ok and n_rows) else None
+        if pad_to is None or pad_to == n_rows:  # incl. 0-row blocks
+            return self.inner.run(comp, arrays, pad_ok=False)
+        padded = _pad_inputs(comp, arrays, pad_to, n_rows)
+        out = self.inner.run(comp, padded, pad_ok=False)
+        return _slice_outputs(comp, out, pad_to, n_rows)
+
+    def clear(self):
+        self.inner.clear()
 
 
 _default: Optional[BlockExecutor] = None
@@ -179,10 +239,20 @@ def default_padding_executor() -> BlockExecutor:
     """Bucketed-padding executor for row-local computations (``map_rows``:
     rows are independent under vmap, so padding the row dim to power-of-two
     buckets is safe and bounds compile signatures to O(log max_rows) for
-    streams of odd-sized blocks — SURVEY.md §7 hard part #1)."""
+    streams of odd-sized blocks — SURVEY.md §7 hard part #1).
+
+    Under ``TFT_EXECUTOR=pjrt`` the buckets wrap the native PJRT executor
+    (:class:`PaddingExecutor` composition), so map_rows runs through the
+    C++ core too."""
     global _default_padding
     if _default_padding is None:
+        inner = default_executor()  # resolves TFT_EXECUTOR + fallback once
         with _default_lock:
             if _default_padding is None:
-                _default_padding = BlockExecutor(pad_rows=True)
+                if type(inner) is BlockExecutor:
+                    _default_padding = BlockExecutor(pad_rows=True)
+                else:
+                    # native core default: share its ONE client (a second
+                    # PJRT client per process can be refused on TPU hosts)
+                    _default_padding = PaddingExecutor(inner)
     return _default_padding
