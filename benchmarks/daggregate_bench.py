@@ -27,11 +27,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 def main() -> int:
     import jax
 
-    # this image's sitecustomize re-registers the TPU platform via
-    # jax.config at interpreter start, overriding the env var — force it
-    # back when the caller asked for CPU
-    if os.environ.get("JAX_PLATFORMS") == "cpu":
-        jax.config.update("jax_platforms", "cpu")
+    from benchmarks._platform import force_cpu_if_requested
+
+    force_cpu_if_requested()
 
     n_rows = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
     n_groups = int(sys.argv[2]) if len(sys.argv) > 2 else 100_000

@@ -31,6 +31,10 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 def main() -> int:
     import jax
+
+    from benchmarks._platform import force_cpu_if_requested
+
+    force_cpu_if_requested()
     import jax.numpy as jnp
 
     import tensorframes_tpu as tft

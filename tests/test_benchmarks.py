@@ -38,3 +38,46 @@ def test_heavy_configs_smoke():
     assert r4["images_per_s"] > 0
     r5 = baseline_configs.config5_logreg_step(n=4096, d=8)
     assert r5["rows_per_s"] > 0
+
+
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_CPU_ENV = dict(os.environ, JAX_PLATFORMS="cpu")
+
+
+def test_daggregate_bench_light():
+    # keeps the keyed-aggregation bench runnable (host + device key paths)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "benchmarks",
+                                      "daggregate_bench.py"),
+         "20000", "500"],
+        capture_output=True, text=True, timeout=300, env=_CPU_ENV)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [json.loads(ln) for ln in proc.stdout.strip().splitlines()]
+    suffixes = {"_".join(r["metric"].rsplit("_", 2)[-2:]) for r in lines}
+    assert suffixes == {"host_keys", "device_keys"}
+
+
+def test_tpu_pallas_smoke_fails_gracefully_off_chip():
+    # chip-only kernel smoke: off-TPU it must exit 1 with a JSON reason,
+    # not crash
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "benchmarks",
+                                      "tpu_pallas_smoke.py")],
+        capture_output=True, text=True, timeout=240, env=_CPU_ENV)
+    out = proc.stdout.strip().splitlines()
+    assert out and json.loads(out[-1]).get("ok") is False
+    assert proc.returncode == 1
+
+
+def test_tpu_native_smoke_runs_on_cpu():
+    # the native-core smoke runs off-chip too (cpu backend for both the
+    # jax path and the C++ core), exiting 0 with parity
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "benchmarks",
+                                      "tpu_native_smoke.py")],
+        capture_output=True, text=True, timeout=500, env=_CPU_ENV)
+    assert proc.returncode == 0, (proc.stdout[-500:], proc.stderr[-1000:])
+    rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert rec["ok"] is True and rec["native_platform"] == "cpu"
