@@ -17,6 +17,10 @@ def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     light = "--light" in argv
 
+    from ._platform import force_cpu_if_requested
+
+    force_cpu_if_requested()
+
     from . import baseline_configs, e2e_bench, marshal_bench
 
     records = []

@@ -96,6 +96,15 @@ def main() -> int:
         np.testing.assert_allclose(r["x"], np.sqrt((x_g[sel] ** 2).sum()),
                                    rtol=1e-9)
 
+    # 6. daggregate with DEVICE-side keys across processes (the ids are
+    # built by one jitted sort-unique over the global sharded key column)
+    agg3 = par.daggregate({"x": "sum"}, dist.select(["k", "x"]), "k",
+                          max_groups=8).collect()
+    assert len(agg3) == 5
+    for r in agg3:
+        sel = k_g == r["k"]
+        np.testing.assert_allclose(r["x"], x_g[sel].sum(), rtol=1e-12)
+
     print(f"[worker {pid}] OK", flush=True)
     return 0
 

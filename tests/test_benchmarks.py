@@ -3,6 +3,7 @@
 opt-in: ``python -m benchmarks.run_all``."""
 
 import json
+import os
 import subprocess
 import sys
 
@@ -40,8 +41,6 @@ def test_heavy_configs_smoke():
     assert r5["rows_per_s"] > 0
 
 
-import os
-
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _CPU_ENV = dict(os.environ, JAX_PLATFORMS="cpu")
 
@@ -74,6 +73,10 @@ def test_tpu_pallas_smoke_fails_gracefully_off_chip():
 def test_tpu_native_smoke_runs_on_cpu():
     # the native-core smoke runs off-chip too (cpu backend for both the
     # jax path and the C++ core), exiting 0 with parity
+    from tensorframes_tpu import native_pjrt
+
+    if not native_pjrt.available():
+        pytest.skip("libtfrpjrt.so unavailable (no TF C++ libs)")
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "benchmarks",
                                       "tpu_native_smoke.py")],
