@@ -32,23 +32,60 @@ def _mfu(flops_per_iter: float, sec_per_iter: float) -> float:
     return flops_per_iter / sec_per_iter / V5E_PEAK_FLOPS
 
 
-def _jit_flops(fn, *args) -> float:
-    """XLA cost-model FLOPs for one call of the jitted fn."""
+def _compile_with_flops(fn, *args):
+    """Compile ``fn`` ONCE; return (compiled executable, cost-model FLOPs).
+
+    The compiled object serves both the cost analysis and the timed calls —
+    compiling twice would double the slowest, most failure-prone step
+    (ResNet-50's remote_compile has broken the tunnel relay mid-read).
+    Returns ``(None, 0.0)`` if the compile itself fails, so the caller can
+    still emit its end-to-end measurement without the MFU fields.
+    """
     import jax
 
     try:
         comp = jax.jit(fn).lower(*args).compile()
+    except Exception:
+        return None, 0.0
+    try:
         ca = comp.cost_analysis()
         if isinstance(ca, list):
             ca = ca[0]
-        return float(ca.get("flops", 0.0))
+        flops = float(ca.get("flops", 0.0)) if ca else 0.0
     except Exception:
-        return 0.0
+        flops = 0.0
+    return comp, flops
+
+
+def _steady_state(compiled, *args, iters: int = 20):
+    """Pipelined steady-state s/call of a pre-compiled executable on
+    device-resident inputs: ``iters`` async dispatches, one
+    ``block_until_ready`` at the end. Overlapping dispatches amortize the
+    per-dispatch relay RTT (~0.5 s through this environment's tunnel), so
+    this measures sustained device throughput — the right wall for MFU —
+    NOT single-call latency (configs report the end-to-end per-call
+    figure separately). Inputs stay in HBM: no marshalling, re-trace, or
+    re-compile in the loop.
+    """
+    import jax
+
+    args = jax.device_put(args)
+    jax.block_until_ready(compiled(*args))  # warm
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = compiled(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
 
 
 def config4_resnet_mfu(batch: int = 32, image: int = 224,
                        iters: int = 5):
-    """ResNet-50 batch inference + MFU (BASELINE config 4)."""
+    """ResNet-50 batch inference + MFU (BASELINE config 4).
+
+    Two numbers: the via-frame end-to-end path (map_blocks + marshalling
+    each call), and the device-resident steady-state apply — MFU uses the
+    latter, which is what the chip itself sustains.
+    """
     import jax
     import numpy as np
 
@@ -73,16 +110,26 @@ def config4_resnet_mfu(batch: int = 32, image: int = 224,
     sec = (time.perf_counter() - t0) / iters
     assert blocks[0].dense("logits").shape == (batch, 1000)
 
-    flops = _jit_flops(lambda p, x: model.apply(p, x), params, imgs)
-    return {"metric": "resnet50_infer", "value": sec, "unit": "s/batch",
-            "images": batch, "images_per_s": batch / sec,
-            "flops_per_batch": flops,
-            "mfu": round(_mfu(flops, sec), 4) if flops else None,
-            "platform": jax.default_backend()}
+    rec = {"metric": "resnet50_infer", "value": sec, "unit": "s/batch",
+           "images": batch, "images_per_s": batch / sec,
+           "platform": jax.default_backend()}
+    compiled, flops = _compile_with_flops(
+        lambda p, x: model.apply(p, x), params, imgs)
+    if compiled is not None:
+        dev_sec = _steady_state(compiled, params, imgs)
+        rec.update(
+            device_resident_s_per_batch=dev_sec,
+            device_resident_images_per_s=batch / dev_sec,
+            flops_per_batch=flops,
+            mfu=round(_mfu(flops, dev_sec), 4) if flops else None)
+    return rec
 
 
 def config5_logreg_mfu(n: int = 262_144, d: int = 64, iters: int = 5):
-    """Logreg gradient step + MFU (BASELINE config 5)."""
+    """Logreg gradient step + MFU (BASELINE config 5).
+
+    Same two-number convention as config 4: via-frame end-to-end, plus
+    device-resident steady-state grads (the MFU numerator's wall)."""
     import jax
     import numpy as np
 
@@ -110,26 +157,79 @@ def config5_logreg_mfu(n: int = 262_144, d: int = 64, iters: int = 5):
 
     xb = x.astype(np.float32)
     yb = y.astype(np.float32)
-    flops = _jit_flops(lambda p, xx, yy: model.grads(p, xx, yy),
-                       params, xb, yb)
-    return {"metric": "logreg_grad_step", "value": sec, "unit": "s/step",
-            "rows": n, "rows_per_s": n / sec,
-            "flops_per_step": flops,
-            "mfu": round(_mfu(flops, sec), 6) if flops else None,
-            "platform": jax.default_backend()}
+    rec = {"metric": "logreg_grad_step", "value": sec, "unit": "s/step",
+           "rows": n, "rows_per_s": n / sec,
+           "platform": jax.default_backend()}
+    compiled, flops = _compile_with_flops(
+        lambda p, xx, yy: model.grads(p, xx, yy), params, xb, yb)
+    if compiled is not None:
+        dev_sec = _steady_state(compiled, params, xb, yb)
+        rec.update(
+            device_resident_s_per_step=dev_sec,
+            device_resident_rows_per_s=n / dev_sec,
+            flops_per_step=flops,
+            mfu=round(_mfu(flops, dev_sec), 6) if flops else None)
+    return rec
+
+
+def config2_with_device_resident(n: int = 100_000, width: int = 16):
+    """Config 2 (reduce_sum/min) + the mesh collective-reduce rate.
+
+    The base config times the full op path (build + marshal + reduce +
+    collect) per call; through the tunnelled relay that is dominated by
+    dispatch RTTs. The extra fields time the mesh reduce with the column
+    already living in HBM — one compiled collective program per
+    iteration, but each iteration still ends in the reduce contract's
+    one-cell driver collect, so through the relay the figure includes one
+    host round-trip (it is labelled ``collective_path_*``, not
+    device-resident, for exactly that reason).
+    """
+    import jax
+    import numpy as np
+
+    import tensorframes_tpu as tft
+    from benchmarks import baseline_configs as bc
+    from tensorframes_tpu.parallel import distributed as par
+    from tensorframes_tpu.parallel.mesh import local_mesh
+
+    rec = bc.config2_reduce_vector(n, width)
+
+    data = np.random.default_rng(0).normal(size=(n, width))
+    df = tft.analyze(tft.frame({"x": data}, num_partitions=4))
+    dist = par.distribute(df, local_mesh())
+
+    def go():
+        # the mapping form takes the monoid ICI-collective path (one
+        # psum-tree shard_map program) — the BASELINE north-star path
+        return par.dreduce_blocks({"x": "sum"}, dist)
+
+    go()  # compile + warm
+    iters = 10
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = go()
+    dev_sec = (time.perf_counter() - t0) / iters
+    np.testing.assert_allclose(out["x"], data.sum(0), rtol=1e-3)
+    rec["collective_path_s_per_reduce"] = dev_sec
+    rec["collective_path_rows_per_s"] = n / dev_sec
+    return rec
 
 
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     which = [int(a) for a in argv] or [1, 2, 3, 4, 5]
 
-    from benchmarks import baseline_configs as bc
     import jax
+
+    from benchmarks._platform import force_cpu_if_requested
+
+    force_cpu_if_requested()
+    from benchmarks import baseline_configs as bc
 
     plat = jax.default_backend()
     runners = {
         1: bc.config1_readme_x_plus_3,
-        2: bc.config2_reduce_vector,
+        2: config2_with_device_resident,
         3: bc.config3_dsl_map,
         4: config4_resnet_mfu,
         5: config5_logreg_mfu,

@@ -60,11 +60,31 @@ def main() -> int:
     fa_diff = float(jnp.max(jnp.abs(fa_p - fa_x)))
     fa_ok = fa_diff < 5e-2  # MXU bf16 passes vs full-softmax reference
 
+    # Mosaic kernel traced INSIDE shard_map(check_vma=True): the exact
+    # combination daggregate runs per shard (regression: pallas_call's
+    # out_shape must declare the varying mesh axes or tracing fails)
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()), ("shards",))
+    n_dev = len(jax.devices())
+    v2 = rng.standard_normal((512 * n_dev, 8)).astype(np.float32)
+    ids2 = rng.integers(0, 16, 512 * n_dev).astype(np.int32)
+    shard_fn = jax.shard_map(
+        lambda vv_, ii_: segment_sum(vv_, ii_, 16, impl="pallas"),
+        mesh=mesh, in_specs=(P("shards"), P("shards")),
+        out_specs=P("shards"), check_vma=True)
+    sm_out = np.asarray(jax.jit(shard_fn)(v2, ids2))
+    sm_sum = sm_out.reshape(n_dev, 16, 8).sum(axis=0)
+    sm_ref = np.asarray(segment_sum(v2, ids2, 16, impl="xla"))
+    sm_diff = float(np.max(np.abs(sm_sum - sm_ref)))
+    sm_ok = sm_diff < 1e-3
+
     rec = {
-        "ok": bool(seg_ok and fa_ok),
+        "ok": bool(seg_ok and fa_ok and sm_ok),
         "platform": platform,
         "segment_sum_pallas_max_diff": seg_diff,
         "flash_attention_pallas_max_diff": fa_diff,
+        "segment_sum_in_shard_map_max_diff": sm_diff,
         "mosaic_compiled": True,  # impl="pallas" → interpret=False
     }
     print(json.dumps(rec))

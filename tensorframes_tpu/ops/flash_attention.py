@@ -27,6 +27,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ._pallas_mesh import interpret_blocked_by_vma, vma_union
+
 __all__ = ["flash_attention"]
 
 _LANES = 128  # VMEM lane width: m/l scratch keeps stats broadcast over lanes
@@ -125,7 +127,11 @@ def _pallas_attention(q, k, v, *, causal: bool, scale: float,
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, qp.shape[1], d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct(
+            (bh, qp.shape[1], d), q.dtype,
+            # shard_map(check_vma=True) requires declaring the mesh axes the
+            # output varies over — the attention output varies like q/k/v
+            vma=vma_union(q, k, v)),
         scratch_shapes=[
             pltpu.VMEM((block_q, _LANES), jnp.float32),  # m
             pltpu.VMEM((block_q, _LANES), jnp.float32),  # l
@@ -162,6 +168,8 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     """
     if impl is None:
         impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+    if impl == "interpret" and interpret_blocked_by_vma(q, k, v):
+        impl = "xla"  # see ops/_pallas_mesh.py: interpreter can't do vma
     b, sq, h, d = q.shape
     sk = k.shape[1]
     if scale is None:

@@ -26,6 +26,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ._pallas_mesh import interpret_blocked_by_vma, vma_union
+
 __all__ = ["segment_sum"]
 
 
@@ -64,6 +66,9 @@ def _pallas_segment_sum(values, segment_ids, num_segments: int,
         segment_ids = jnp.pad(segment_ids, (0, pad), constant_values=-1)
     nblocks = values.shape[0] // block_rows
 
+    # under shard_map(check_vma=True) the out_shape must declare which mesh
+    # axes it varies over; the reduction output varies wherever its inputs do
+    vma = vma_union(values, segment_ids)
     kern = functools.partial(_kernel, block_rows=block_rows,
                              num_segments=num_segments)
     out = pl.pallas_call(
@@ -74,7 +79,8 @@ def _pallas_segment_sum(values, segment_ids, num_segments: int,
             pl.BlockSpec((block_rows, d), lambda i: (i, 0)),
         ],
         out_specs=pl.BlockSpec((num_segments, d), lambda i: (0, 0)),
-        out_shape=jax.ShapeDtypeStruct((num_segments, d), acc_dtype),
+        out_shape=jax.ShapeDtypeStruct((num_segments, d), acc_dtype,
+                                       vma=vma),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary",)),
         interpret=interpret,
@@ -109,6 +115,8 @@ def segment_sum(values: jax.Array, segment_ids: jax.Array,
         impl = "xla"
     elif impl is None:
         impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+    if impl == "interpret" and interpret_blocked_by_vma(values, segment_ids):
+        impl = "xla"  # see ops/_pallas_mesh.py: interpreter can't do vma
     if impl == "xla":
         valid = (segment_ids >= 0) & (segment_ids < num_segments)
         shaped = jnp.where(

@@ -140,3 +140,95 @@ class TestSegmentSum:
         ids = jnp.zeros(4, jnp.int32)
         with pytest.raises(ValueError, match="Unknown segment_sum impl"):
             segment_sum(vals, ids, 1, impl="bogus")
+
+
+class TestShardMapVma:
+    """Pallas kernels inside shard_map(check_vma=True).
+
+    Regression (hit on TPU by daggregate, where segment_sum auto-picks
+    Pallas): pallas_call's out_shape must declare the mesh axes it varies
+    over, or *tracing* fails with "vma ... must not be None". Tracing the
+    real impl="pallas" path via eval_shape exercises exactly that check
+    without needing Mosaic, so these run on CPU. Execution-side CPU
+    coverage goes through the documented interpret→xla redirect (the
+    Pallas HLO interpreter cannot replay kernel bodies under vma
+    tracking); the non-interpreted on-chip run lives in
+    benchmarks/tpu_pallas_smoke.py.
+    """
+
+    def _mesh(self, n):
+        from jax.sharding import Mesh
+
+        return Mesh(np.array(jax.devices()[:n]), ("shards",))
+
+    def test_segment_sum_pallas_traces_under_shard_map(self, rng):
+        from jax.sharding import PartitionSpec as P
+
+        mesh = self._mesh(4)
+        vals = jnp.ones((32, 3), jnp.float32)
+        ids = jnp.zeros((32,), jnp.int32)
+
+        def fn(v, i):
+            return segment_sum(v, i, 5, impl="pallas", block_rows=8)
+
+        sharded = jax.shard_map(
+            fn, mesh=mesh, in_specs=(P("shards"), P("shards")),
+            out_specs=P("shards"), check_vma=True)
+        out = jax.eval_shape(sharded, vals, ids)  # raises pre-fix
+        assert out.shape == (5 * 4, 3)
+
+    def test_flash_attention_pallas_traces_under_shard_map(self, rng):
+        from jax.sharding import PartitionSpec as P
+
+        mesh = self._mesh(2)
+        q, k, v = _qkv(rng, b=4, s=32, h=1, d=8)
+
+        def fn(q, k, v):
+            return flash_attention(q, k, v, impl="pallas",
+                                   block_q=16, block_k=16)
+
+        sharded = jax.shard_map(
+            fn, mesh=mesh, in_specs=(P("shards"), P("shards"), P("shards")),
+            out_specs=P("shards"), check_vma=True)
+        out = jax.eval_shape(sharded, q, k, v)  # raises pre-fix
+        assert out.shape == q.shape
+
+    def test_segment_sum_interpret_redirects_and_matches(self, rng):
+        from jax.sharding import PartitionSpec as P
+
+        mesh = self._mesh(4)
+        n = 8 * 4
+        vals = jnp.asarray(rng.standard_normal((n, 3)), jnp.float32)
+        ids = jnp.asarray(rng.integers(0, 5, n), jnp.int32)
+
+        def fn(v, i):
+            return segment_sum(v, i, 5, impl="interpret", block_rows=8)
+
+        sharded = jax.shard_map(
+            fn, mesh=mesh, in_specs=(P("shards"), P("shards")),
+            out_specs=P("shards"), check_vma=True)
+        out = jax.jit(sharded)(vals, ids)  # [5 * ndev, 3] stacked partials
+        per_shard = np.asarray(out).reshape(4, 5, 3).sum(axis=0)
+        ref = np.zeros((5, 3), np.float32)
+        np.add.at(ref, np.asarray(ids), np.asarray(vals))
+        np.testing.assert_allclose(per_shard, ref, rtol=1e-5, atol=1e-5)
+
+    def test_interpret_redirect_covers_partial_vma(self, rng):
+        # replicated q but sharded k/v: the redirect must consider every
+        # input's vma, not just the first one's
+        from jax.sharding import PartitionSpec as P
+
+        mesh = self._mesh(2)
+        q, k, v = _qkv(rng, b=2, s=32, h=1, d=8)
+
+        def fn(q, k, v):
+            o = flash_attention(q, k, v, impl="interpret",
+                                block_q=16, block_k=16)
+            return jax.lax.psum(o, "shards")
+
+        sharded = jax.shard_map(
+            fn, mesh=mesh,
+            in_specs=(P(None), P(None, "shards"), P(None, "shards")),
+            out_specs=P(None), check_vma=True)
+        out = jax.jit(sharded)(q, k, v)  # pre-fix: interpreter vma crash
+        assert out.shape == q.shape
