@@ -138,14 +138,19 @@ def _attempt(platform: str, timeout_s: int):
     try:
         stdout, stderr = proc.communicate(timeout=timeout_s)
     except subprocess.TimeoutExpired:
-        try:
-            os.killpg(proc.pid, signal.SIGKILL)
-        except (ProcessLookupError, PermissionError):
-            pass
-        try:
-            proc.communicate(timeout=10)
-        except subprocess.TimeoutExpired:
-            pass  # unreapable (D state); abandon the corpse and move on
+        # SIGTERM first: a SIGKILLed PJRT client never releases the
+        # tunnel's server-side session lease and the grant wedges for the
+        # rest of the round (observed r2/r3). Grace period, then KILL.
+        for sig, grace in ((signal.SIGTERM, 15), (signal.SIGKILL, 10)):
+            try:
+                os.killpg(proc.pid, sig)
+            except (ProcessLookupError, PermissionError):
+                pass  # group already exited — still reap + drain pipes below
+            try:
+                proc.communicate(timeout=grace)
+                break
+            except subprocess.TimeoutExpired:
+                continue  # escalate; if still unreapable (D state), move on
         return None, f"{platform}: timed out after {timeout_s}s"
     if proc.returncode != 0:
         tail = (stderr or "").strip().splitlines()[-1:] or ["no output"]

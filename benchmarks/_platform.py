@@ -1,21 +1,13 @@
 """Shared benchmark-script plumbing.
 
-This image's sitecustomize registers the tunnelled-TPU platform via
-``jax.config`` at interpreter start, OVERRIDING the ``JAX_PLATFORMS`` env
-var — so a script that should honor an explicit CPU request must force the
-config back after importing jax. One helper, used by every benchmark
-script, so the workaround cannot drift.
+Kept as a thin alias so every benchmark keeps its historical import path;
+the real helper lives in :mod:`tensorframes_tpu.utils.platform` (demos
+need it too — see that module's docstring for why the env var alone is
+not enough in this image).
 """
 
 from __future__ import annotations
 
-import os
+from tensorframes_tpu.utils.platform import force_cpu_if_requested
 
-
-def force_cpu_if_requested() -> None:
-    """Honor ``JAX_PLATFORMS=cpu`` from the environment (call after
-    ``import jax``, before any backend use)."""
-    if os.environ.get("JAX_PLATFORMS") == "cpu":
-        import jax
-
-        jax.config.update("jax_platforms", "cpu")
+__all__ = ["force_cpu_if_requested"]

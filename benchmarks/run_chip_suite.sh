@@ -17,10 +17,17 @@ if ! probe; then
   exit 2
 fi
 
+# the native smoke needs the C++ core; build it up front so a fresh
+# checkout doesn't burn its one grant on a "libtfrpjrt.so missing" step
+make -C native -j4 >/dev/null 2>&1 || true
+
 run() {  # run <label> <timeout_s> <cmd...>
   local label=$1 t=$2; shift 2
   echo "== $label =="
-  timeout "$t" "$@" 2>>"$OUT.err" | tee -a "$OUT" || \
+  # SIGTERM first and only escalate to SIGKILL after a 20s grace: a
+  # KILLed PJRT client leaves the server-side session lease held and the
+  # relay wedges for the rest of the round (observed r2 and r3)
+  timeout -k 20 "$t" "$@" 2>>"$OUT.err" | tee -a "$OUT" || \
     echo "{\"step\": \"$label\", \"error\": \"rc=$? (timeout or failure)\"}" | tee -a "$OUT"
 }
 

@@ -83,4 +83,7 @@ def main():
 
 
 if __name__ == "__main__":
+    from tensorframes_tpu.utils.platform import force_cpu_if_requested
+
+    force_cpu_if_requested()
     main()

@@ -184,6 +184,126 @@ class TransformerLM:
     def apply(self, params: Params, tokens: jax.Array, **kw) -> jax.Array:
         return self.apply_with_aux(params, tokens, **kw)[0]
 
+    # -- autoregressive decoding (KV cache) ---------------------------------
+    def init_cache(self, batch: int, max_len: int) -> Params:
+        """Static-shape KV cache: per layer ``k``/``v`` of
+        ``[B, max_len, H, Dh]`` — XLA-friendly decoding writes into fixed
+        buffers with ``dynamic_update_slice`` instead of growing arrays."""
+        c = self.config
+        zeros = lambda: jnp.zeros(  # noqa: E731
+            (batch, max_len, c.n_heads, c.head_dim), c.dtype)
+        return {"layers": [{"k": zeros(), "v": zeros()}
+                           for _ in range(c.n_layers)]}
+
+    def _block_cached(self, lp, ck, x, start, positions, key_positions):
+        """One block over ``x`` (``[B, S, D]`` at global ``positions``),
+        reading/writing the KV cache at offset ``start``. Attention sees
+        every cached key with ``key_positions <= position`` (causal within
+        the new tokens, everything before them unconditionally). Returns
+        ``(x, new_cache_entry)``."""
+        c = self.config
+        h = _rms_norm(x, lp["ln1"])
+        q = jnp.einsum("bsd,dhk->bshk", h, lp["wq"])
+        k = jnp.einsum("bsd,dhk->bshk", h, lp["wk"])
+        v = jnp.einsum("bsd,dhk->bshk", h, lp["wv"])
+        q = _rope(q, positions, c.rope_base)
+        k = _rope(k, positions, c.rope_base)
+        kc = jax.lax.dynamic_update_slice(ck["k"], k, (0, start, 0, 0))
+        vc = jax.lax.dynamic_update_slice(ck["v"], v, (0, start, 0, 0))
+        scores = jnp.einsum("bqhk,bthk->bhqt", q, kc,
+                            preferred_element_type=jnp.float32)
+        scores = scores * (1.0 / np.sqrt(c.head_dim))
+        mask = key_positions[None, :] <= positions[:, None]  # [S, T]
+        scores = jnp.where(mask[None, None], scores, -jnp.inf)
+        p = jax.nn.softmax(scores, axis=-1)
+        attn = jnp.einsum("bhqt,bthk->bqhk", p, vc.astype(p.dtype))
+        x = x + jnp.einsum("bshk,hkd->bsd", attn.astype(x.dtype), lp["wo"])
+        h = _rms_norm(x, lp["ln2"])
+        if "moe" in lp:
+            from ..parallel.moe import switch_ffn
+            B, S, D = h.shape
+            y, _ = switch_ffn(h.reshape(B * S, D), lp["moe"],
+                              capacity_factor=c.expert_capacity_factor)
+            ff = y.reshape(B, S, D)
+        else:
+            ff = jax.nn.gelu(h @ lp["w1"]) @ lp["w2"]
+        return x + ff, {"k": kc, "v": vc}
+
+    def _forward_cached(self, params, cache, tokens, start, max_len):
+        """Cached forward over ``tokens`` (``[B, S]``) written at cache
+        offset ``start``; serves both prefill (S = prompt) and decode
+        (S = 1). Returns ``(logits [B, S, V], new_cache)``."""
+        S = tokens.shape[1]
+        x = params["embed"][tokens]
+        positions = start + jnp.arange(S)
+        key_positions = jnp.arange(max_len)
+        new_layers = []
+        for lp, ck in zip(params["layers"], cache["layers"]):
+            x, nck = self._block_cached(lp, ck, x, start, positions,
+                                        key_positions)
+            new_layers.append(nck)
+        x = _rms_norm(x, params["ln_f"])
+        return x @ params["head"], {"layers": new_layers}
+
+    def generate(self, params: Params, prompt: jax.Array,
+                 max_new_tokens: int, temperature: float = 0.0,
+                 rng: Optional[jax.Array] = None) -> jax.Array:
+        """Autoregressive decode: ``prompt`` ``[B, S0]`` int32 ->
+        ``[B, S0 + max_new_tokens]``.
+
+        One prefill pass fills the KV cache for the whole prompt, then a
+        ``lax.scan`` emits one token per step against the static-shape
+        cache — the whole loop is one compiled XLA program (no Python in
+        the decode path, the TPU-idiomatic replacement for a host loop).
+        ``temperature=0`` is greedy; otherwise softmax sampling with
+        ``rng``.
+        """
+        if temperature > 0 and rng is None:
+            raise ValueError("temperature > 0 sampling needs rng")
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        prompt = jnp.asarray(prompt, jnp.int32)
+        # one compiled program for prefill + decode scan + glue (cached per
+        # static (max_new_tokens, temperature); prompt shape changes
+        # retrace as usual) — an un-jitted prefill would dispatch op by op,
+        # which through a ~0.5 s/RTT relay costs seconds per call
+        if not hasattr(self, "_generate_jit"):
+            self._generate_jit = jax.jit(self._generate_impl,
+                                         static_argnums=(3, 4))
+        return self._generate_jit(params, prompt, rng, max_new_tokens,
+                                  temperature)
+
+    def _generate_impl(self, params, prompt, rng, max_new_tokens,
+                       temperature):
+        B, S0 = prompt.shape
+        T = S0 + max_new_tokens
+        cache = self.init_cache(B, T)
+        logits, cache = self._forward_cached(params, cache, prompt, 0, T)
+
+        def pick(lg, key):
+            if temperature > 0:
+                return jax.random.categorical(key, lg / temperature, axis=-1)
+            return jnp.argmax(lg, axis=-1)
+
+        first_key, scan_key = jax.random.split(rng)
+        first = pick(logits[:, -1].astype(jnp.float32), first_key)
+
+        def step(carry, key):
+            cache, tok, pos = carry
+            lg, cache = self._forward_cached(
+                params, cache, tok[:, None], pos, T)
+            nxt = pick(lg[:, -1].astype(jnp.float32), key)
+            return (cache, nxt.astype(jnp.int32), pos + 1), tok
+
+        # each step emits the token it was CARRIED (first, then each
+        # sampled successor), so max_new_tokens steps yield exactly
+        # max_new_tokens tokens; the last step's sampled successor is
+        # discarded (one spare decode forward keeps the loop uniform)
+        keys = jax.random.split(scan_key, max_new_tokens)
+        _, toks = jax.lax.scan(
+            step, (cache, first.astype(jnp.int32), S0), keys)
+        return jnp.concatenate([prompt, toks.transpose(1, 0)], axis=1)
+
     @staticmethod
     def _xent(logits: jax.Array, targets: jax.Array) -> jax.Array:
         logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
@@ -267,8 +387,18 @@ class TransformerLM:
         def init_state(rng=None):
             params = jax.device_put(self.init(rng), p_shard)
             # adam moments inherit each param's sharding (jit propagates
-            # input shardings to the zeros_like outputs)
+            # input shardings to the zeros_like outputs), but scalar leaves
+            # (adam's step count) come back with an uncommitted
+            # single-device placement. That mixes fine with mesh-committed
+            # params only because jax relocates uncommitted arrays — a
+            # checkpoint restore commits every leaf, so resume would fail
+            # with "incompatible devices". Commit every non-mesh leaf to a
+            # replicated mesh sharding up front.
             opt_state = jax.jit(opt.init)(params)
+            opt_state = jax.tree_util.tree_map(
+                lambda l: l if isinstance(l.sharding, NamedSharding)
+                else jax.device_put(l, NamedSharding(mesh.mesh, P())),
+                opt_state)
             return {"params": params, "opt": opt_state}
 
         def step(state, tokens, targets):
@@ -371,8 +501,14 @@ class TransformerLM:
                 "stages": jax.tree_util.tree_map(
                     lambda a: jax.device_put(a, stage_shard), stages),
             }
-            # adam moments inherit each leaf's sharding through jit
+            # adam moments inherit each leaf's sharding through jit;
+            # commit scalar leaves (adam count) to the mesh so a
+            # checkpoint-restored state matches (see make_sharded_train_step)
             opt_state = jax.jit(opt.init)(params)
+            opt_state = jax.tree_util.tree_map(
+                lambda l: l if isinstance(l.sharding, NamedSharding)
+                else jax.device_put(l, repl),
+                opt_state)
             return {"params": params, "opt": opt_state}
 
         def step(state, tokens, targets):

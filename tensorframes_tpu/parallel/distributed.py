@@ -79,6 +79,11 @@ class DistributedFrame:
         self.columns = columns
         self.num_rows = num_rows
         self.shard_valid = shard_valid
+        # group-id factorizations memoized per key tuple: frames are
+        # immutable (every op returns a new frame), so repeated
+        # aggregations over the same keys skip the host transfer +
+        # lexsort (host path) / sort-unique program (device path)
+        self._group_ids_cache: Dict[tuple, tuple] = {}
 
     @property
     def padded_rows(self) -> int:
@@ -381,6 +386,30 @@ def _collective_reduce(col_combiners: Mapping[str, str],
     return result
 
 
+def _cached_group_ids(dist: DistributedFrame, keys, max_groups):
+    """Memoized key factorization (see ``DistributedFrame._group_ids_cache``).
+
+    Returns ``(ids_dev, uniques, uniq_dev, count_dev, num_groups)`` —
+    ``uniques`` is None on the device path, ``uniq_dev``/``count_dev``
+    are None on the host path.
+    """
+    if max_groups is not None:
+        ckey = ("device", tuple(keys), max_groups)
+        hit = dist._group_ids_cache.get(ckey)
+        if hit is None:
+            hit = _device_key_ids(dist, keys, max_groups)
+            dist._group_ids_cache[ckey] = hit
+        ids_dev, uniq_dev, count_dev, num_groups = hit
+        return ids_dev, None, uniq_dev, count_dev, num_groups
+    ckey = ("host", tuple(keys))
+    hit = dist._group_ids_cache.get(ckey)
+    if hit is None:
+        hit = _host_group_ids(dist, keys)
+        dist._group_ids_cache[ckey] = hit
+    ids_dev, uniques, num_groups = hit
+    return ids_dev, uniques, None, None, num_groups
+
+
 def _host_group_ids(dist: DistributedFrame, keys):
     """Key columns → dense group ids on the mesh (host factorization).
 
@@ -578,12 +607,8 @@ def daggregate(fetches, dist: DistributedFrame, keys,
         raise ValueError("aggregate on an empty distributed frame")
 
     device_keys = max_groups is not None
-    if device_keys:
-        ids_dev, uniq_dev, count_dev, num_groups = _device_key_ids(
-            dist, keys, max_groups)
-        uniques = None
-    else:
-        ids_dev, uniques, num_groups = _host_group_ids(dist, keys)
+    ids_dev, uniques, uniq_dev, count_dev, num_groups = _cached_group_ids(
+        dist, keys, max_groups)
 
     fetch_names = sorted(col_combiners)
     arrays = [dist.columns[f] for f in fetch_names]
@@ -797,14 +822,10 @@ def _generic_daggregate(fetches, dist: DistributedFrame, keys,
     _ops._validate_reduce(comp, value_schema, ("_input",), rank_delta=1)
     names = sorted(comp.output_names)
 
-    if max_groups is not None:
-        # device-side keys: ids + group table built on the mesh, the key
-        # column never visits the host (single integer key only)
-        ids_dev, uniq_dev, count_dev, table_groups = _device_key_ids(
-            dist, keys, max_groups)
-        uniques = None
-    else:
-        ids_dev, uniques, table_groups = _host_group_ids(dist, keys)
+    # device-side keys: ids + group table built on the mesh, the key
+    # column never visits the host (single integer key only)
+    ids_dev, uniques, uniq_dev, count_dev, table_groups = _cached_group_ids(
+        dist, keys, max_groups)
     final = _segmented_fold(comp, names, mesh,
                             [dist.columns[f] for f in names],
                             ids_dev, table_groups)

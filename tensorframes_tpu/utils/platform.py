@@ -1,0 +1,23 @@
+"""Backend-selection plumbing shared by benchmarks, demos, and scripts.
+
+This image's sitecustomize registers the tunnelled-TPU platform via
+``jax.config`` at interpreter start, OVERRIDING the ``JAX_PLATFORMS`` env
+var — so any entry point that should honor an explicit CPU request must
+force the config back after importing jax, before first backend use. One
+helper, so the workaround cannot drift.
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["force_cpu_if_requested"]
+
+
+def force_cpu_if_requested() -> None:
+    """Honor ``JAX_PLATFORMS=cpu`` from the environment (call after
+    ``import jax``, before any backend use)."""
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")

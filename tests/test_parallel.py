@@ -367,3 +367,57 @@ def test_distribute_string_key_column_rides_host_side(mesh8):
     rows = back.collect()
     assert sorted((r["key"], r["x"], r["z"]) for r in rows) == sorted(
         (str(i % 3), float(i), float(i) + 1.0) for i in range(10))
+
+
+def test_daggregate_key_factorization_cached(mesh8, monkeypatch):
+    # repeated aggregations over the same keys on the same frame must not
+    # re-run the host transfer + factorization (or the device sort-unique
+    # program): the frame memoizes per key tuple
+    from tensorframes_tpu.parallel import distributed as dmod
+
+    rng = np.random.default_rng(13)
+    keys = rng.integers(0, 20, 200)
+    vals = rng.normal(size=200)
+    df = tft.frame({"key": keys, "x": vals})
+    dist = par.distribute(df, mesh8)
+
+    calls = {"host": 0, "device": 0}
+    orig_host, orig_dev = dmod._host_group_ids, dmod._device_key_ids
+
+    def count_host(*a, **k):
+        calls["host"] += 1
+        return orig_host(*a, **k)
+
+    def count_dev(*a, **k):
+        calls["device"] += 1
+        return orig_dev(*a, **k)
+
+    monkeypatch.setattr(dmod, "_host_group_ids", count_host)
+    monkeypatch.setattr(dmod, "_device_key_ids", count_dev)
+
+    first = par.daggregate({"x": "sum"}, dist, "key")
+    again = par.daggregate({"x": "min"}, dist, "key")   # same keys, new fetch
+    gen = par.daggregate(lambda x_input: {"x": x_input.sum(0)}, dist, "key")
+    assert calls["host"] == 1
+
+    dev1 = par.daggregate({"x": "sum"}, dist, "key", max_groups=32)
+    dev2 = par.daggregate({"x": "max"}, dist, "key", max_groups=32)
+    assert calls["device"] == 1
+    # a different cap is a different static program: fresh entry
+    par.daggregate({"x": "sum"}, dist, "key", max_groups=64)
+    assert calls["device"] == 2
+
+    # and the cached ids still produce correct results
+    ref = {}
+    for k, v in zip(keys, vals):
+        ref[int(k)] = ref.get(int(k), 0.0) + v
+    for out in (first, dev1):
+        got = {int(r["key"]): float(r["x"]) for r in out.collect()}
+        for k in ref:
+            assert np.isclose(got[k], ref[k], rtol=1e-9)
+    gmin = {int(r["key"]): float(r["x"]) for r in again.collect()}
+    for k in ref:
+        assert np.isclose(gmin[k], vals[keys == k].min(), rtol=1e-9)
+    gsum = {int(r["key"]): float(r["x"]) for r in gen.collect()}
+    for k in ref:
+        assert np.isclose(gsum[k], ref[k], rtol=1e-6)
