@@ -128,6 +128,19 @@ def columns_to_rows(columns: Dict[str, Column], schema: Schema,
     cols = [columns[n] for n in names]
     if not cols:
         return []
+    lens = {len(c) for c in cols}
+    if len(lens) > 1:
+        raise ValueError(
+            "columns disagree on row count: "
+            + ", ".join(f"{n}={len(c)}" for n, c in zip(names, cols)))
+    if fast:
+        # column-at-a-time: ndarray.tolist() unboxes a whole scalar column
+        # to Python values in C, list(arr) splits a tensor column into row
+        # views in C, and zip reassembles tuples — ~10x the per-cell loop
+        # below (the reference's fastPath/slow-path split, DataOps.scala:40)
+        seqs = [c.tolist() if isinstance(c, np.ndarray) and c.ndim == 1
+                else list(c) for c in cols]
+        return list(zip(*seqs))
     n = len(cols[0])
     scalar = [isinstance(c, np.ndarray) and c.ndim == 1 for c in cols]
     rows = []

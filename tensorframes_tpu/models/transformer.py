@@ -304,6 +304,41 @@ class TransformerLM:
             step, (cache, first.astype(jnp.int32), S0), keys)
         return jnp.concatenate([prompt, toks.transpose(1, 0)], axis=1)
 
+    def generate_via_frame(self, params: Params, df,
+                           max_new_tokens: int,
+                           prompt_col: str = "prompt",
+                           temperature: float = 0.0,
+                           rng: Optional[jax.Array] = None,
+                           trim: bool = True):
+        """Batch decoding through ``map_blocks``: prompts live in a frame
+        column (``[S0]`` int cells), completions come back as a
+        ``completion`` column (``[S0 + max_new_tokens]``) — the
+        broadcast-the-frozen-graph pattern the other zoo models use for
+        inference, here driving the KV-cache decode loop per block.
+
+        Sampling (``temperature > 0``) folds the block's token content
+        into ``rng`` so different blocks draw independent streams; blocks
+        with byte-identical prompts reproduce the same completion
+        (deterministic by content — re-running the frame gives the same
+        result, the laziness contract's requirement)."""
+        def fn_impl(**cols):
+            toks = cols[prompt_col].astype(jnp.int32)
+            key = rng
+            if key is not None:
+                mix = jnp.sum(
+                    toks.astype(jnp.uint32)
+                    * (jnp.arange(toks.size, dtype=jnp.uint32)
+                       .reshape(toks.shape)
+                       * np.uint32(2654435761) + np.uint32(1)))
+                key = jax.random.fold_in(key, mix.astype(jnp.uint32))
+            out = self.generate(params, toks, max_new_tokens,
+                                temperature=temperature, rng=key)
+            return {"completion": out}
+
+        from .logreg import _named_args_fn
+        return df.map_blocks(_named_args_fn(fn_impl, [prompt_col]),
+                             trim=trim)
+
     @staticmethod
     def _xent(logits: jax.Array, targets: jax.Array) -> jax.Array:
         logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)

@@ -33,6 +33,33 @@ def test_rows_to_columns_fast_and_back():
     assert back == rows
 
 
+def test_columns_to_rows_fast_matches_slow():
+    # the fast path (column-at-a-time tolist/zip) and the slow per-cell
+    # reference loop must agree on every column kind: numeric scalars,
+    # tensor cells, object (string) columns, and ragged list columns
+    s = Schema([Field("x", dt.double), Field("n", dt.int32),
+                Field("m", dt.double, sql_rank=1)])
+    cols = {"x": np.array([1.5, 2.5, 3.5]),
+            "n": np.array([1, 2, 3], np.int32),
+            "m": np.arange(6.0).reshape(3, 2)}
+    fastr = columns_to_rows(cols, s, fast=True)
+    slowr = columns_to_rows(cols, s, fast=False)
+    assert len(fastr) == len(slowr) == 3
+    for fr, sr in zip(fastr, slowr):
+        assert fr[0] == sr[0] and isinstance(fr[0], float)
+        assert fr[1] == sr[1] and isinstance(fr[1], int)
+        np.testing.assert_array_equal(fr[2], sr[2])
+
+    so = Schema([Field("k", dt.string), Field("v", dt.double, sql_rank=1)])
+    cols2 = {"k": np.array(["a", "b"], object),
+             "v": [np.array([1.0, 2.0]), np.array([3.0])]}  # ragged
+    fast2 = columns_to_rows(cols2, so, fast=True)
+    slow2 = columns_to_rows(cols2, so, fast=False)
+    for fr, sr in zip(fast2, slow2):
+        assert fr[0] == sr[0] and isinstance(fr[0], str)
+        np.testing.assert_array_equal(fr[1], sr[1])
+
+
 def test_rows_to_columns_ragged():
     s = Schema([Field("v", dt.double, sql_rank=1)])
     rows = [([1.0, 2.0],), ([3.0],)]
@@ -116,3 +143,12 @@ def test_block_concat_mixed():
     c = Block.concat([b1, b2], s)
     assert c.num_rows == 3
     np.testing.assert_array_equal(c.dense("x"), [1.0, 2.0, 3.0])
+
+
+def test_columns_to_rows_length_mismatch_raises():
+    s = Schema.of(x="double", n="int")
+    cols = {"x": np.array([1.0, 2.0, 3.0]), "n": np.array([1, 2], np.int32)}
+    with pytest.raises(ValueError, match="disagree on row count"):
+        columns_to_rows(cols, s, fast=True)
+    with pytest.raises(ValueError, match="disagree on row count"):
+        columns_to_rows(cols, s, fast=False)

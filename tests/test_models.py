@@ -253,3 +253,44 @@ class TestGenerate:
         out = np.asarray(model.generate(params, prompt, max_new_tokens=6))
         expect = [(start + i) % vocab for i in range(14)]
         assert out[0].tolist() == expect, (out[0].tolist(), expect)
+
+    def test_generate_via_frame(self):
+        model, params = self._model()
+        prompts = np.asarray([[1, 2, 3, 4], [9, 8, 7, 6]], np.int64)
+        df = tft.analyze(tft.frame({"prompt": prompts}))
+        out = model.generate_via_frame(params, df, max_new_tokens=3)
+        comp = out.blocks()[0].dense("completion")
+        assert comp.shape == (2, 7)
+        ref = np.asarray(model.generate(
+            params, jnp.asarray(prompts, jnp.int32), 3))
+        np.testing.assert_array_equal(np.asarray(comp), ref)
+
+    def test_generate_via_frame_sampling_independent_blocks(self):
+        # temperature>0 across partitions: different blocks must draw
+        # different streams; identical frames must reproduce exactly
+        model, params = self._model()
+        prompts = np.asarray([[1, 2, 3, 4], [1, 2, 3, 4],
+                              [1, 2, 3, 4], [1, 2, 3, 4]], np.int64)
+        df = tft.analyze(tft.frame({"prompt": prompts}, num_partitions=2))
+        key = jax.random.PRNGKey(3)
+        out = model.generate_via_frame(params, df, max_new_tokens=6,
+                                       temperature=1.5, rng=key)
+        blocks = [b.dense("completion") for b in out.blocks()]
+        assert len(blocks) == 2
+        # same prompts, different block content is identical here — both
+        # blocks hold the same rows, so streams coincide by the
+        # deterministic-by-content contract...
+        np.testing.assert_array_equal(blocks[0], blocks[1])
+        # ...but a block with different content draws a different stream
+        prompts2 = np.asarray([[1, 2, 3, 4], [5, 6, 7, 8]], np.int64)
+        df2 = tft.analyze(tft.frame({"prompt": prompts2},
+                                    num_partitions=2))
+        out2 = model.generate_via_frame(params, df2, max_new_tokens=6,
+                                        temperature=1.5, rng=key)
+        b2 = [b.dense("completion") for b in out2.blocks()]
+        # reproducibility: rerunning the same frame gives the same bytes
+        again = model.generate_via_frame(params, df2, max_new_tokens=6,
+                                         temperature=1.5, rng=key)
+        a2 = [b.dense("completion") for b in again.blocks()]
+        np.testing.assert_array_equal(b2[0], a2[0])
+        np.testing.assert_array_equal(b2[1], a2[1])
