@@ -35,8 +35,8 @@ from .schema import Field, Schema
 from .frame import Block, GroupedFrame, Row, TensorFrame
 from .computation import Computation, TensorSpec, analyze_graph
 from .api import (
-    aggregate, analyze, block, explain, frame, map_blocks, map_rows,
-    print_schema, reduce_blocks, reduce_rows, row,
+    aggregate, analyze, block, explain, filter_rows, frame, map_blocks,
+    map_rows, print_schema, reduce_blocks, reduce_rows, row,
 )
 from . import builder
 from . import io
@@ -59,6 +59,7 @@ __all__ = [
     "map_blocks",
     "reduce_rows",
     "reduce_blocks",
+    "filter_rows",
     "aggregate",
     "analyze",
     "print_schema",

@@ -27,7 +27,8 @@ from .frame import GroupedFrame, TensorFrame, frame
 
 __all__ = [
     "map_blocks", "map_rows", "reduce_blocks", "reduce_rows", "aggregate",
-    "analyze", "print_schema", "explain", "block", "row", "frame",
+    "filter_rows", "analyze", "print_schema", "explain", "block", "row",
+    "frame",
 ]
 
 
@@ -82,6 +83,17 @@ def reduce_rows(fetches, dframe: TensorFrame):
                                     block_level=False)
     out = _ops.reduce_rows(comp, dframe)
     return _unpack(out, comp.output_names)
+
+
+def filter_rows(predicate, dframe: TensorFrame) -> TensorFrame:
+    """Keeps the rows where ``predicate`` is true (nonzero). Lazy.
+
+    ``predicate`` follows the map conventions (named args select columns)
+    and must produce one boolean/integer vector of block length. Beyond
+    the reference's own surface — its users filtered through Spark's
+    relational API, which a standalone frame library must supply itself.
+    """
+    return _ops.filter_rows(predicate, dframe)
 
 
 def aggregate(fetches, grouped_data: GroupedFrame,

@@ -406,6 +406,102 @@ def map_rows(fetches: Fetches, df: TensorFrame,
 
 
 # ---------------------------------------------------------------------------
+# filter_rows
+# ---------------------------------------------------------------------------
+
+def cached_map_computation(fetches, schema: Schema,
+                           block_level: bool) -> Computation:
+    """`_map_computation` with reuse keyed weakly by the fetches object —
+    the map-side twin of :func:`cached_reduce_computation` (a fresh
+    Computation per call would defeat every per-Computation jit/program
+    cache downstream)."""
+    sig = ("map", block_level,
+           tuple((f.name, f.dtype.name,
+                  tuple(f.block_shape.dims) if f.block_shape is not None
+                  else None)
+                 for f in schema))
+    try:
+        per = _fetches_comp_cache.setdefault(fetches, {})
+    except TypeError:
+        per = None
+    if per is not None:
+        comp = per.get(sig)
+        if comp is not None:
+            return comp
+    comp = _map_computation(fetches, schema, block_level=block_level)
+    if per is not None:
+        per[sig] = comp
+    return comp
+
+
+def _filter_computation(predicate: Fetches, schema: Schema) -> Computation:
+    """Build/validate a filter predicate: one rank-1 boolean/integer fetch
+    over block-level columns (nonzero keeps the row). Shared by the host
+    op and the mesh ``dfilter``."""
+    comp = cached_map_computation(predicate, schema, block_level=True)
+    if len(comp.outputs) != 1:
+        raise InvalidShapeError(
+            f"filter predicate must produce exactly one fetch, got "
+            f"{comp.output_names}")
+    out_spec = comp.outputs[0]
+    if len(out_spec.shape.dims) != 1:
+        raise InvalidShapeError(
+            f"filter predicate fetch {out_spec.name!r} must be a rank-1 "
+            f"row mask, got shape {out_spec.shape}")
+    if out_spec.dtype.np_storage.kind not in ("b", "i"):
+        raise InvalidTypeError(
+            f"filter predicate fetch {out_spec.name!r} must be boolean or "
+            f"integer (nonzero keeps the row), got {out_spec.dtype.name}")
+    return comp
+
+
+def filter_rows(predicate: Fetches, df: TensorFrame,
+                executor: Optional[BlockExecutor] = None) -> TensorFrame:
+    """Keep the rows where ``predicate`` holds. Lazy.
+
+    The reference had no filter of its own — users reached for Spark's
+    relational ``df.filter`` around the six tensor ops; a frame library
+    standing alone needs one. ``predicate`` follows the map-computation
+    conventions (named args select columns, DSL nodes work too) and must
+    produce exactly ONE boolean/integer vector of block length; nonzero
+    keeps the row. The schema is unchanged; every column (including
+    non-tensor pass-through columns like strings) is masked.
+    """
+    ex = executor or default_executor()
+    comp = _filter_computation(predicate, df.schema)
+    in_names = comp.input_names
+    pname = comp.output_names[0]
+
+    def run_block(b: Block) -> Block:
+        if b.num_rows == 0:
+            return b
+        with span("filter_rows.block"):
+            arrays = {n: b.dense(n) for n in in_names}
+            # masks are row-aligned, so bucketed padding stays legal
+            out = ex.run(comp, arrays, pad_ok=True)
+        mask = np.asarray(out[pname]).astype(bool)
+        if mask.shape != (b.num_rows,):
+            raise InvalidShapeError(
+                f"filter predicate produced shape {mask.shape} for a "
+                f"{b.num_rows}-row block")
+        keep = int(mask.sum())
+        if keep == b.num_rows:
+            return b
+        cols: Dict[str, Column] = {}
+        for n, c in b.columns.items():
+            if isinstance(c, np.ndarray):
+                cols[n] = c[mask]
+            else:  # ragged / list-backed columns mask by index
+                cols[n] = [c[i] for i in np.flatnonzero(mask)]
+        return Block(cols, keep)
+
+    return TensorFrame(df.schema,
+                       lambda: [run_block(b) for b in df.blocks()],
+                       df.num_partitions,
+                       plan=f"filter_rows({df._plan})")
+
+
+# ---------------------------------------------------------------------------
 # reduce_blocks / reduce_rows
 # ---------------------------------------------------------------------------
 

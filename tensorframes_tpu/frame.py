@@ -400,6 +400,10 @@ class TensorFrame:
         from . import api
         return api.reduce_rows(fetches, self)
 
+    def filter(self, predicate) -> "TensorFrame":
+        from . import api
+        return api.filter_rows(predicate, self)
+
     def analyze(self) -> "TensorFrame":
         from . import api
         return api.analyze(self)

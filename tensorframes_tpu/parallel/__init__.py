@@ -11,15 +11,16 @@ first-class citizen of the same mesh.
 
 from .mesh import DeviceMesh, local_mesh
 from .distributed import (
-    DistributedFrame, daggregate, distribute, dmap_blocks, dreduce_blocks)
+    DistributedFrame, daggregate, dfilter, distribute, dmap_blocks,
+    dreduce_blocks)
 from .collectives import COMBINERS
 from .ring import ring_attention, ring_allreduce
 from .cluster import cluster_mesh, distribute_local, initialize
 
 __all__ = [
     "DeviceMesh", "local_mesh",
-    "DistributedFrame", "daggregate", "distribute", "dmap_blocks",
-    "dreduce_blocks",
+    "DistributedFrame", "daggregate", "dfilter", "distribute",
+    "dmap_blocks", "dreduce_blocks",
     "COMBINERS",
     "ring_attention", "ring_allreduce",
     "cluster_mesh", "distribute_local", "initialize",

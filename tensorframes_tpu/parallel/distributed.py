@@ -40,7 +40,7 @@ from ..schema import Schema
 from .collectives import COMBINERS
 from .mesh import DeviceMesh
 
-__all__ = ["DistributedFrame", "distribute", "dmap_blocks",
+__all__ = ["DistributedFrame", "distribute", "dmap_blocks", "dfilter",
            "dreduce_blocks", "daggregate"]
 
 _cached_reduce_computation = _ops.cached_reduce_computation
@@ -117,24 +117,7 @@ class DistributedFrame:
         the process-local blocks (process-contiguous row layout, the
         ``cluster.distribute_local`` invariant) with one allgather.
         """
-        a = self.columns[name]
-        if getattr(a, "is_fully_addressable", True):
-            return np.asarray(a)
-        from jax.experimental import multihost_utils
-
-        def start(s):
-            sl = s.index[0]
-            return 0 if sl.start is None else sl.start
-
-        # replication over non-data mesh axes repeats each row block across
-        # devices; keep one shard per distinct row range
-        by_start = {}
-        for s in a.addressable_shards:
-            by_start.setdefault(start(s), s)
-        shards = [by_start[k] for k in sorted(by_start)]
-        local = np.concatenate([np.asarray(s.data) for s in shards])
-        gathered = np.asarray(multihost_utils.process_allgather(local))
-        return gathered.reshape((-1,) + tuple(a.shape[1:]))
+        return _read_global(self.columns[name])
 
     def collect_frame(self, num_partitions: Optional[int] = None) -> TensorFrame:
         """Bring the data back to the host as a TensorFrame (pad dropped).
@@ -200,6 +183,33 @@ def _host_side_column(a: np.ndarray, field, padded_rows: int) -> np.ndarray:
         a = np.concatenate(
             [a, np.full(padded_rows - a.shape[0], None, a.dtype)])
     return a
+
+
+def _read_global(a) -> np.ndarray:
+    """A (possibly multi-host) row-sharded global array as host numpy.
+
+    Fully-addressable arrays read directly; otherwise each process
+    concatenates its distinct row blocks and one allgather assembles the
+    global array (row-contiguous process layout, the
+    ``cluster.distribute_local`` invariant).
+    """
+    if getattr(a, "is_fully_addressable", True):
+        return np.asarray(a)
+    from jax.experimental import multihost_utils
+
+    def start(s):
+        sl = s.index[0]
+        return 0 if sl.start is None else sl.start
+
+    # replication over non-data mesh axes repeats each row block across
+    # devices; keep one shard per distinct row range
+    by_start = {}
+    for s in a.addressable_shards:
+        by_start.setdefault(start(s), s)
+    shards = [by_start[k] for k in sorted(by_start)]
+    local = np.concatenate([np.asarray(s.data) for s in shards])
+    gathered = np.asarray(multihost_utils.process_allgather(local))
+    return gathered.reshape((-1,) + tuple(a.shape[1:]))
 
 
 def distribute(df: TensorFrame, mesh: DeviceMesh) -> DistributedFrame:
@@ -291,6 +301,82 @@ def dmap_blocks(fetches, dist: DistributedFrame, trim: bool = False,
     return DistributedFrame(mesh, out_schema, cols, num_rows,
                             shard_valid=(dist.shard_valid if row_aligned
                                          else None))
+
+
+def dfilter(predicate, dist: DistributedFrame) -> DistributedFrame:
+    """Mesh filter: keep the rows where ``predicate`` holds (nonzero).
+
+    The TPU-first shape of a row filter: global array shapes cannot
+    change per data (XLA's static world), so one ``shard_map`` program
+    computes the mask per shard, stably compacts each shard's kept rows
+    to the front (argsort on the negated mask + gather), and reports the
+    per-shard survivor counts — the padded global layout is untouched and
+    the result's validity becomes per-shard (``shard_valid`` semantics,
+    exactly the multi-host frame layout every consumer already handles).
+    Host-side ride-along columns (strings) replay the same per-shard
+    permutation on the host from the returned mask.
+
+    ``predicate`` follows :func:`tensorframes_tpu.filter_rows`'s
+    contract: named args select columns, one rank-1 boolean/integer
+    fetch.
+    """
+    schema = dist.schema
+    comp = _ops._filter_computation(predicate, schema)
+    pname = comp.output_names[0]
+    mesh = dist.mesh
+    axis = mesh.data_axis
+    S = mesh.num_data_shards
+    rows_per = dist.padded_rows // S
+    in_names = comp.input_names
+    tensor_names = [f.name for f in schema if f.dtype.tensor]
+    host_names = [f.name for f in schema if not f.dtype.tensor]
+
+    counts_host = dist.per_shard_valid().astype(np.int32)
+    cnt_dev = jax.make_array_from_callback(
+        (S,), mesh.row_sharding(1), lambda idx: counts_host[idx])
+    arrays = [dist.columns[n] for n in tensor_names]
+
+    cache = getattr(comp, "_tft_dfilter_cache", None)
+    if cache is None:
+        cache = comp._tft_dfilter_cache = {}
+    key = (mesh.mesh, axis, rows_per,
+           tuple((n, a.shape, str(a.dtype))
+                 for n, a in zip(tensor_names, arrays)))
+    fn = cache.get(key)
+    if fn is None:
+        def shard_fn(cnt, *cols):
+            local = dict(zip(tensor_names, cols))
+            m = comp.fn({n: local[n] for n in in_names})[pname]
+            rowid = jnp.arange(rows_per)
+            keep = (m != 0) & (rowid < cnt[0])
+            order = jnp.argsort((~keep).astype(jnp.int8), stable=True)
+            permuted = tuple(jnp.take(c, order, axis=0) for c in cols)
+            return permuted + (jnp.sum(keep, dtype=jnp.int32)[None], keep)
+
+        in_specs = (P(axis),) + tuple(
+            P(axis, *([None] * (a.ndim - 1))) for a in arrays)
+        out_specs = tuple(
+            P(axis, *([None] * (a.ndim - 1))) for a in arrays
+        ) + (P(axis), P(axis))
+        fn = jax.jit(shard_map(shard_fn, mesh=mesh.mesh,
+                               in_specs=in_specs, out_specs=out_specs))
+        cache[key] = fn
+
+    outs = fn(cnt_dev, *arrays)
+    new_cols: Dict[str, jax.Array] = dict(zip(tensor_names, outs))
+    counts = _read_global(outs[len(tensor_names)]).astype(np.int64)
+    if host_names:
+        keep_host = _read_global(outs[len(tensor_names) + 1])
+        for n in host_names:
+            a = dist.columns[n]
+            out_a = np.empty_like(a)
+            for s in range(S):
+                sl = slice(s * rows_per, (s + 1) * rows_per)
+                order = np.argsort(~keep_host[sl], kind="stable")
+                out_a[sl] = a[sl][order]
+            new_cols[n] = out_a
+    return DistributedFrame(mesh, schema, new_cols, int(counts.sum()),
+                            shard_valid=counts)
 
 
 def dreduce_blocks(fetches, dist: DistributedFrame):

@@ -288,6 +288,13 @@ class TestGenerate:
         out2 = model.generate_via_frame(params, df2, max_new_tokens=6,
                                         temperature=1.5, rng=key)
         b2 = [b.dense("completion") for b in out2.blocks()]
+        # the SAME prompt row [1,2,3,4] sits in both frames, but df2's
+        # first block has different sibling rows than df's — the content
+        # fold must give it a different sample stream (near-uniform model,
+        # 6 tokens, vocab 32: collision odds ~1e-9). Deleting the fold_in
+        # mix would make these byte-identical.
+        assert not np.array_equal(blocks[0][0], b2[0][0]), (
+            blocks[0][0], b2[0][0])
         # reproducibility: rerunning the same frame gives the same bytes
         again = model.generate_via_frame(params, df2, max_new_tokens=6,
                                          temperature=1.5, rng=key)

@@ -505,3 +505,50 @@ def test_aggregate_generic_many_groups_single_program():
     for k in list(got)[:50]:
         np.testing.assert_allclose(
             got[k], np.sqrt((x[key == k] ** 2).sum()), rtol=1e-5)
+
+
+class TestFilterRows:
+    def test_basic_predicate(self):
+        df = tft.frame({"x": np.arange(10, dtype=np.float64)})
+        out = tft.filter_rows(lambda x: x >= 4.0, df)
+        assert [r["x"] for r in out.collect()] == [4.0, 5.0, 6.0, 7.0,
+                                                   8.0, 9.0]
+        # schema unchanged, laziness: a fresh collect recomputes fine
+        assert out.schema.names == ["x"]
+        assert len(out.collect()) == 6
+
+    def test_fluent_and_multi_column(self):
+        df = tft.frame({"x": np.arange(8, dtype=np.float64),
+                        "y": np.array([1.0, -1.0] * 4)})
+        out = df.filter(lambda x, y: (x > 2.0) & (y > 0.0)).collect()
+        assert [(r["x"], r["y"]) for r in out] == [(4.0, 1.0), (6.0, 1.0)]
+
+    def test_vector_column_predicate(self):
+        df = tft.analyze(tft.frame({"v": np.arange(12.0).reshape(4, 3)}))
+        out = tft.filter_rows(lambda v: v.sum(axis=1) > 10.0, df).collect()
+        assert len(out) == 3
+
+    def test_string_columns_ride_through(self):
+        df = tft.frame({"k": np.array(["a", "b", "c", "d"], object),
+                        "x": np.arange(4, dtype=np.float64)})
+        rows = tft.filter_rows(lambda x: x % 2.0 == 0.0, df).collect()
+        assert [(r["k"], r["x"]) for r in rows] == [("a", 0.0), ("c", 2.0)]
+
+    def test_empty_blocks_and_all_dropped(self):
+        df = tft.frame({"x": np.arange(6, dtype=np.float64)},
+                       num_partitions=3)
+        out = tft.filter_rows(lambda x: x < 0.0, df)
+        assert out.collect() == []
+        assert out.count() == 0
+
+    def test_validation(self):
+        df = tft.analyze(tft.frame({"x": np.arange(4, dtype=np.float64),
+                                    "v": np.ones((4, 2))}))
+        with pytest.raises(engine_ops.InvalidShapeError,
+                           match="exactly one fetch"):
+            tft.filter_rows(lambda x: {"a": x > 0, "b": x < 0}, df)
+        with pytest.raises(engine_ops.InvalidShapeError, match="rank-1"):
+            tft.filter_rows(lambda v: v > 0.0, df)
+        with pytest.raises(engine_ops.InvalidTypeError,
+                           match="boolean or integer"):
+            tft.filter_rows(lambda x: x * 2.0, df)

@@ -421,3 +421,71 @@ def test_daggregate_key_factorization_cached(mesh8, monkeypatch):
     gsum = {int(r["key"]): float(r["x"]) for r in gen.collect()}
     for k in ref:
         assert np.isclose(gsum[k], ref[k], rtol=1e-6)
+
+
+class TestDFilter:
+    def test_matches_host_filter(self, mesh8):
+        rng = np.random.default_rng(21)
+        x = rng.normal(size=1000)
+        v = rng.normal(size=(1000, 3))
+        df = tft.analyze(tft.frame({"x": x, "v": v}))
+        dist = par.distribute(df, mesh8)
+        out = par.dfilter(lambda x: x > 0.0, dist)
+        assert out.count() == int((x > 0).sum())
+        back = out.collect_frame().collect()
+        keep = x > 0
+        # per-shard compaction does not reorder within a shard, but shard
+        # boundaries differ from host partitioning: compare as sets
+        got = sorted((r["x"], tuple(r["v"])) for r in back)
+        want = sorted(zip(x[keep], map(tuple, v[keep])))
+        for (gx, gv), (wx, wv) in zip(got, want):
+            assert gx == pytest.approx(wx, rel=1e-6)
+            np.testing.assert_allclose(gv, wv, rtol=1e-6)
+
+    def test_chains_with_dmap_and_dreduce(self, mesh8):
+        x = np.arange(100, dtype=np.float64)
+        dist = par.distribute(tft.frame({"x": x}), mesh8)
+        flt = par.dfilter(lambda x: x >= 50.0, dist)
+        mapped = par.dmap_blocks(lambda x: {"z": x * 2.0}, flt)
+        total = par.dreduce_blocks({"z": "sum"}, mapped.select(["z"]))
+        assert float(total["z"]) == float((x[x >= 50] * 2).sum())
+
+    def test_pad_rows_never_survive(self, mesh8):
+        # 10 rows pad to 16 on 8 shards; an always-true predicate must
+        # still drop the 6 pad rows
+        dist = par.distribute(tft.frame({"x": np.ones(10)}), mesh8)
+        out = par.dfilter(lambda x: x > 0.0, dist)
+        assert out.count() == 10
+        assert len(out.collect_frame().collect()) == 10
+
+    def test_string_rider_column_permutes(self, mesh8):
+        keys = np.array([f"k{i}" for i in range(12)], object)
+        x = np.arange(12, dtype=np.float64)
+        df = tft.frame({"k": keys, "x": x})
+        dist = par.distribute(df, mesh8)
+        out = par.dfilter(lambda x: x % 2.0 == 0.0, dist)
+        rows = out.collect_frame().collect()
+        assert sorted((r["k"], r["x"]) for r in rows) == sorted(
+            (f"k{i}", float(i)) for i in range(0, 12, 2))
+
+    def test_filter_all_gone_then_count_zero(self, mesh8):
+        dist = par.distribute(tft.frame({"x": np.ones(16)}), mesh8)
+        out = par.dfilter(lambda x: x < 0.0, dist)
+        assert out.count() == 0
+
+    def test_dfilter_reuses_compiled_program(self, mesh8):
+        # the predicate's Computation (and so its shard_map jit cache)
+        # must be reused across calls — a fresh trace per call would pay
+        # full XLA compile every iteration of a driver loop
+        from tensorframes_tpu.engine import ops as eops
+
+        pred = lambda x: x > 0.0  # noqa: E731
+        dist = par.distribute(tft.frame({"x": np.arange(16.0)}), mesh8)
+        par.dfilter(pred, dist)
+        comp = eops.cached_map_computation(pred, dist.schema,
+                                           block_level=True)
+        assert comp._tft_dfilter_cache  # populated by the first call
+        before = dict(comp._tft_dfilter_cache)
+        out = par.dfilter(pred, dist)
+        assert comp._tft_dfilter_cache == before  # same compiled entry
+        assert out.count() == 15
