@@ -404,6 +404,54 @@ class TensorFrame:
         from . import api
         return api.filter_rows(predicate, self)
 
+    def order_by(self, *cols: str, descending: bool = False,
+                 num_partitions: Optional[int] = None) -> "TensorFrame":
+        """Rows globally sorted by scalar key column(s). Lazy.
+
+        Beyond the reference's surface (its users ordered through Spark's
+        relational API). Multi-key: first name is the primary key. Stable
+        within equal keys. The result is re-partitioned evenly
+        (``num_partitions`` defaults to the input's count) — a global sort
+        cannot preserve partition boundaries.
+        """
+        if not cols:
+            raise ValueError("order_by needs at least one key column")
+        for c in cols:
+            f = self._schema.get(c)
+            if f is None:
+                raise KeyError(
+                    f"No column {c!r}; columns: {self._schema.names}")
+            if f.sql_rank != 0:
+                raise ValueError(
+                    f"order_by key {c!r} must be a scalar column")
+        parts = num_partitions or self._num_partitions
+
+        def run() -> List[Block]:
+            merged = Block.concat(self.blocks(), self._schema)
+            n = merged.num_rows
+            # np.lexsort: LAST key is primary; stable. Descending negates
+            # each key's dense rank (works for strings too) instead of
+            # reversing the result, which would un-stabilize ties.
+            keys = []
+            for c in reversed(cols):
+                k = np.asarray(merged.columns[c])
+                if descending:
+                    k = -np.unique(k, return_inverse=True)[1]
+                keys.append(k)
+            order = np.lexsort(keys)
+            out_cols: Dict[str, Column] = {}
+            for name, c in merged.columns.items():
+                if isinstance(c, np.ndarray):
+                    out_cols[name] = c[order]
+                else:  # ragged list columns reorder by index
+                    out_cols[name] = [c[i] for i in order]
+            spans = _split_even(n, parts)
+            return [Block({k: v[a:b] for k, v in out_cols.items()}, b - a)
+                    for a, b in spans]
+
+        return TensorFrame(self._schema, run, parts,
+                           plan=f"order_by{cols}({self._plan})")
+
     def analyze(self) -> "TensorFrame":
         from . import api
         return api.analyze(self)

@@ -12,7 +12,7 @@ first-class citizen of the same mesh.
 from .mesh import DeviceMesh, local_mesh
 from .distributed import (
     DistributedFrame, daggregate, dfilter, distribute, dmap_blocks,
-    dreduce_blocks)
+    dreduce_blocks, dsort)
 from .collectives import COMBINERS
 from .ring import ring_attention, ring_allreduce
 from .cluster import cluster_mesh, distribute_local, initialize
@@ -20,7 +20,7 @@ from .cluster import cluster_mesh, distribute_local, initialize
 __all__ = [
     "DeviceMesh", "local_mesh",
     "DistributedFrame", "daggregate", "dfilter", "distribute",
-    "dmap_blocks", "dreduce_blocks",
+    "dmap_blocks", "dreduce_blocks", "dsort",
     "COMBINERS",
     "ring_attention", "ring_allreduce",
     "cluster_mesh", "distribute_local", "initialize",

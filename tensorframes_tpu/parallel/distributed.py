@@ -25,6 +25,7 @@ collectives ride ICI within a slice and DCN across slices.
 from __future__ import annotations
 
 import functools
+from collections import OrderedDict
 from typing import Dict, Mapping, Optional
 
 import jax
@@ -41,7 +42,7 @@ from .collectives import COMBINERS
 from .mesh import DeviceMesh
 
 __all__ = ["DistributedFrame", "distribute", "dmap_blocks", "dfilter",
-           "dreduce_blocks", "daggregate"]
+           "dsort", "dreduce_blocks", "daggregate"]
 
 _cached_reduce_computation = _ops.cached_reduce_computation
 
@@ -377,6 +378,109 @@ def dfilter(predicate, dist: DistributedFrame) -> DistributedFrame:
             new_cols[n] = out_a
     return DistributedFrame(mesh, schema, new_cols, int(counts.sum()),
                             shard_valid=counts)
+
+
+_dsort_cache: "OrderedDict[tuple, object]" = OrderedDict()
+_DSORT_CACHE_CAP = 32
+
+
+def dsort(dist: DistributedFrame, keys, descending: bool = False
+          ) -> DistributedFrame:
+    """Rows globally sorted by scalar key column(s), on the mesh.
+
+    One compiled program: pad/invalid rows get a sentinel key so they sink
+    to the end, a stable ``argsort`` chain (last key first) computes the
+    global order, and every column gathers through it. XLA/GSPMD
+    partitions the sort itself (on today's compilers that means gathering
+    the key column — sorting is not shardable for free; the VALUE columns
+    still move only once, through the final sharded gather). The result
+    has prefix validity: pad rows are all at the tail, whatever the input
+    layout (so ``dsort`` also normalizes a ``dfilter``/multi-host mask
+    layout back to prefix semantics).
+
+    Keys must be device (numeric) columns; sort by a string key on the
+    host via ``TensorFrame.order_by`` instead. Host-side string
+    ride-along columns are permuted on the host from the same order.
+    """
+    if isinstance(keys, str):
+        keys = [keys]
+    keys = list(keys)
+    schema = dist.schema
+    for k in keys:
+        f = schema.get(k)
+        if f is None:
+            raise KeyError(f"No key column {k!r}; columns: {schema.names}")
+        if not f.dtype.tensor:
+            raise _ops.InvalidTypeError(
+                f"dsort key {k!r} is a host-side (string) column; sort on "
+                f"the host with order_by, or key on a numeric column")
+        if f.block_shape is not None and len(f.block_shape.dims) != 1:
+            raise _ops.InvalidShapeError(
+                f"dsort key {k!r} must be a scalar column")
+    mesh = dist.mesh
+    tensor_names = [f.name for f in schema if f.dtype.tensor]
+    host_names = [f.name for f in schema if not f.dtype.tensor]
+    arrays = [dist.columns[n] for n in tensor_names]
+
+    valid_host = dist.valid_row_mask()
+    valid_dev = jax.make_array_from_callback(
+        (dist.padded_rows,), mesh.row_sharding(1),
+        lambda idx: valid_host[idx])
+
+    want_order = bool(host_names)
+    ckey = (mesh.mesh, tuple(keys), descending, want_order,
+            tuple((n, a.shape, str(a.dtype))
+                  for n, a in zip(tensor_names, arrays)))
+    fn = _dsort_cache.get(ckey)
+    if fn is None:
+        def program(valid, *cols):
+            named = dict(zip(tensor_names, cols))
+            order = None
+            # stable argsort chain, LAST key first -> first key primary
+            for k in reversed(keys):
+                kv = named[k]
+                if descending:
+                    # order-reversing transforms with no overflow: float
+                    # negation, and bitwise-not for ints (~k = -k-1 is
+                    # strictly decreasing for signed AND unsigned — raw
+                    # negation wraps uint 0 onto itself and overflows
+                    # iinfo.min)
+                    kv = (-kv if jnp.issubdtype(kv.dtype, jnp.floating)
+                          else ~kv)
+                if order is not None:
+                    kv = jnp.take(kv, order, axis=0)
+                    step = jnp.argsort(kv, stable=True)
+                    order = jnp.take(order, step, axis=0)
+                else:
+                    order = jnp.argsort(kv, stable=True)
+            # final primary pass: pad/invalid rows sink stably to the tail.
+            # No value sentinel is involved, so real rows keyed NaN / +inf /
+            # iinfo.max cannot be displaced into the pad region — NaNs end
+            # up last WITHIN the valid prefix (argsort's NaN ordering),
+            # pads strictly after.
+            inv = jnp.take((~valid).astype(jnp.int8), order, axis=0)
+            step = jnp.argsort(inv, stable=True)
+            order = jnp.take(order, step, axis=0)
+            outs = tuple(jnp.take(c, order, axis=0) for c in cols)
+            return outs + ((order,) if want_order else ())
+
+        shardings = tuple(mesh.row_sharding(a.ndim) for a in arrays)
+        if want_order:
+            shardings = shardings + (mesh.row_sharding(1),)
+        fn = jax.jit(program, out_shardings=shardings)
+        _dsort_cache[ckey] = fn
+        while len(_dsort_cache) > _DSORT_CACHE_CAP:
+            _dsort_cache.popitem(last=False)
+    else:
+        _dsort_cache.move_to_end(ckey)
+
+    outs = fn(valid_dev, *arrays)
+    new_cols: Dict[str, jax.Array] = dict(zip(tensor_names, outs))
+    if want_order:
+        order_host = _read_global(outs[len(tensor_names)])
+        for n in host_names:
+            new_cols[n] = dist.columns[n][order_host]
+    return DistributedFrame(mesh, schema, new_cols, dist.num_rows)
 
 
 def dreduce_blocks(fetches, dist: DistributedFrame):

@@ -152,3 +152,55 @@ def test_columns_to_rows_length_mismatch_raises():
         columns_to_rows(cols, s, fast=True)
     with pytest.raises(ValueError, match="disagree on row count"):
         columns_to_rows(cols, s, fast=False)
+
+
+class TestOrderBy:
+    def test_single_key(self):
+        import tensorframes_tpu as tft
+
+        df = tft.frame({"x": np.array([3.0, 1.0, 2.0]),
+                        "y": np.array([30.0, 10.0, 20.0])},
+                       num_partitions=2)
+        rows = df.order_by("x").collect()
+        assert [(r["x"], r["y"]) for r in rows] == [
+            (1.0, 10.0), (2.0, 20.0), (3.0, 30.0)]
+
+    def test_multi_key_and_stability(self):
+        import tensorframes_tpu as tft
+
+        k1 = np.array([1, 0, 1, 0, 1], np.int64)
+        x = np.array([5.0, 4.0, 3.0, 2.0, 1.0])
+        df = tft.frame({"k": k1, "x": x})
+        rows = df.order_by("k", "x").collect()
+        assert [(r["k"], r["x"]) for r in rows] == [
+            (0, 2.0), (0, 4.0), (1, 1.0), (1, 3.0), (1, 5.0)]
+
+    def test_descending_stable_with_strings(self):
+        import tensorframes_tpu as tft
+
+        k = np.array(["b", "a", "b", "a"], object)
+        tag = np.array([0.0, 1.0, 2.0, 3.0])
+        df = tft.frame({"k": k, "tag": tag})
+        rows = df.order_by("k", descending=True).collect()
+        # primary: b before a; ties keep original order (stable)
+        assert [(r["k"], r["tag"]) for r in rows] == [
+            ("b", 0.0), ("b", 2.0), ("a", 1.0), ("a", 3.0)]
+
+    def test_vector_columns_follow(self):
+        import tensorframes_tpu as tft
+
+        df = tft.analyze(tft.frame({"x": np.array([2.0, 1.0]),
+                                    "v": np.array([[2., 2.], [1., 1.]])}))
+        rows = df.order_by("x").collect()
+        np.testing.assert_array_equal(rows[0]["v"], [1.0, 1.0])
+
+    def test_validation(self):
+        import tensorframes_tpu as tft
+
+        df = tft.analyze(tft.frame({"v": np.ones((3, 2))}))
+        with pytest.raises(ValueError, match="scalar column"):
+            df.order_by("v")
+        with pytest.raises(KeyError, match="No column"):
+            df.order_by("nope")
+        with pytest.raises(ValueError, match="at least one"):
+            df.order_by()

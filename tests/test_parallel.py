@@ -489,3 +489,86 @@ class TestDFilter:
         out = par.dfilter(pred, dist)
         assert comp._tft_dfilter_cache == before  # same compiled entry
         assert out.count() == 15
+
+
+class TestDSort:
+    def test_matches_host_order_by(self, mesh8):
+        rng = np.random.default_rng(31)
+        x = rng.normal(size=100)
+        v = rng.normal(size=(100, 2))
+        df = tft.analyze(tft.frame({"x": x, "v": v}))
+        dist = par.distribute(df, mesh8)
+        out = par.dsort(dist, "x")
+        rows = out.collect_frame().collect()
+        order = np.argsort(x, stable=True)
+        np.testing.assert_allclose([r["x"] for r in rows], x[order],
+                                   rtol=1e-7)
+        np.testing.assert_allclose(np.stack([r["v"] for r in rows]),
+                                   v[order], rtol=1e-7)
+
+    def test_descending_and_multi_key(self, mesh8):
+        k = np.array([1, 0, 1, 0, 2, 2], np.int64)
+        x = np.array([6.0, 5.0, 4.0, 3.0, 2.0, 1.0])
+        dist = par.distribute(tft.frame({"k": k, "x": x}), mesh8)
+        rows = par.dsort(dist, ["k", "x"]).collect_frame().collect()
+        assert [(r["k"], r["x"]) for r in rows] == [
+            (0, 3.0), (0, 5.0), (1, 4.0), (1, 6.0), (2, 1.0), (2, 2.0)]
+        rows = par.dsort(dist, "x", descending=True) \
+            .collect_frame().collect()
+        assert [r["x"] for r in rows] == [6.0, 5.0, 4.0, 3.0, 2.0, 1.0]
+
+    def test_pad_rows_sink_and_normalize_mask_layout(self, mesh8):
+        # dfilter leaves a per-shard mask layout; dsort must sort only the
+        # real rows and emerge with prefix validity
+        x = np.arange(20, dtype=np.float64)
+        dist = par.distribute(tft.frame({"x": x}), mesh8)
+        flt = par.dfilter(lambda x: x % 3.0 == 0.0, dist)
+        out = par.dsort(flt, "x", descending=True)
+        assert out.shard_valid is None  # prefix layout restored
+        rows = out.collect_frame().collect()
+        assert [r["x"] for r in rows] == [18.0, 15.0, 12.0, 9.0, 6.0,
+                                          3.0, 0.0]
+
+    def test_string_rider_follows(self, mesh8):
+        k = np.array([f"s{i}" for i in range(10)], object)
+        x = np.arange(10, dtype=np.float64)[::-1].copy()
+        dist = par.distribute(tft.frame({"k": k, "x": x}), mesh8)
+        rows = par.dsort(dist, "x").collect_frame().collect()
+        assert [r["k"] for r in rows] == [f"s{i}" for i in range(9, -1, -1)]
+
+    def test_string_key_rejected(self, mesh8):
+        from tensorframes_tpu.engine.ops import InvalidTypeError
+
+        k = np.array(["a", "b"], object)
+        dist = par.distribute(tft.frame({"k": k, "x": np.arange(2.0)}),
+                              mesh8)
+        with pytest.raises(InvalidTypeError, match="host-side"):
+            par.dsort(dist, "k")
+
+    def test_nan_keys_stay_in_valid_prefix(self, mesh8):
+        # a real row keyed NaN must not be displaced into the pad region
+        # (10 rows pad to 16): it sorts last among the REAL rows
+        x = np.array([3.0, np.nan, 1.0, 4.0, 0.5, 2.0, 9.0, 8.0, 7.0,
+                      6.0])
+        dist = par.distribute(tft.frame({"x": x}), mesh8)
+        rows = par.dsort(dist, "x").collect_frame().collect()
+        got = [r["x"] for r in rows]
+        assert len(got) == 10
+        assert np.isnan(got[-1])
+        assert got[:-1] == sorted(v for v in x if not np.isnan(v))
+
+    def test_descending_unsigned_and_int_min(self, mesh8):
+        # raw negation wraps uint 0 onto itself and overflows iinfo.min;
+        # the bitwise-not transform must order both correctly
+        u = np.array([5, 0, 7, 255], np.uint8)
+        dist = par.distribute(tft.frame({"u": u, "x": np.arange(4.0)}),
+                              mesh8)
+        rows = par.dsort(dist, "u", descending=True) \
+            .collect_frame().collect()
+        assert [r["u"] for r in rows] == [255, 7, 5, 0]
+        i = np.array([5, np.iinfo(np.int32).min, -1, 3], np.int64)
+        dist = par.distribute(tft.frame({"i": i, "x": np.arange(4.0)}),
+                              mesh8)
+        rows = par.dsort(dist, "i", descending=True) \
+            .collect_frame().collect()
+        assert [r["i"] for r in rows] == [5, 3, -1, np.iinfo(np.int32).min]
