@@ -45,16 +45,33 @@ def bench(fn, iters=10):
     t0 = time.perf_counter()
     for _ in range(iters):
         r = fn()
-    jax.block_until_ready(r if not hasattr(r, "columns") else
-                          list(r.columns.values()))
+    cols = getattr(r, "columns", None)
+    if isinstance(cols, dict):          # DistributedFrame: async device work
+        jax.block_until_ready(list(cols.values()))
+    elif cols is None:                  # plain dict of arrays (dreduce)
+        jax.block_until_ready(r)
+    # host TensorFrame results (daggregate) are already materialized
     return (time.perf_counter() - t0) / iters
 
 map_sec = bench(lambda: par.dmap_blocks(
     lambda x: {{"z": x + 3.0}}, dist, trim=True))
 red_sec = bench(lambda: par.dreduce_blocks({{"x": "sum"}}, dist))
+flt_sec = bench(lambda: par.dfilter(lambda x: x % 2.0 < 1.0, dist))
+srt_sec = bench(lambda: par.dsort("x", dist, descending=True))
+
+# keyed aggregation: 10k groups over the same rows (host-factorized ids
+# are memoized per frame, so this measures the segment-reduce + psum)
+keys = (np.arange(N) % 10_000).astype(np.int32)  # device-exact key dtype
+kdist = par.distribute(tft.frame({{"k": keys,
+                                   "x": np.arange(N, dtype=np.float64)}}),
+                       mesh)
+agg_sec = bench(lambda: par.daggregate({{"x": "sum"}}, kdist, "k"), iters=5)
 print(json.dumps({{"n_dev": n_dev,
                    "map_rows_per_s": N / map_sec,
-                   "reduce_rows_per_s": N / red_sec}}))
+                   "reduce_rows_per_s": N / red_sec,
+                   "filter_rows_per_s": N / flt_sec,
+                   "sort_rows_per_s": N / srt_sec,
+                   "aggregate_rows_per_s": N / agg_sec}}))
 """
 
 
@@ -83,6 +100,9 @@ def main() -> int:
             "metric": f"scaling_{n}dev",
             "map_rows_per_s": round(r["map_rows_per_s"], 1),
             "reduce_rows_per_s": round(r["reduce_rows_per_s"], 1),
+            "filter_rows_per_s": round(r["filter_rows_per_s"], 1),
+            "sort_rows_per_s": round(r["sort_rows_per_s"], 1),
+            "aggregate_rows_per_s": round(r["aggregate_rows_per_s"], 1),
             "map_efficiency": round(
                 r["map_rows_per_s"] / (n * base["map_rows_per_s"]), 3),
             "reduce_efficiency": round(

@@ -25,7 +25,7 @@ import numpy as np
 from .frame import TensorFrame
 
 __all__ = ["read_parquet", "write_parquet", "from_pandas", "to_pandas",
-           "read_npz", "write_npz"]
+           "read_npz", "write_npz", "read_csv", "write_csv"]
 
 
 def _column_to_numpy(col, name: str) -> np.ndarray:
@@ -186,3 +186,31 @@ def write_npz(df: TensorFrame, path: str) -> None:
     # '.npz' and land at a different path than requested
     with open(path, "wb") as fh:
         np.savez(fh, **cols)
+
+
+def read_csv(path: str, num_partitions: int = 1,
+             columns: Optional[Sequence[str]] = None) -> TensorFrame:
+    """Load a CSV (header row required) as a TensorFrame.
+
+    Parsing rides pandas (baked in); dtypes map through the same policy
+    as :func:`from_pandas` — float/int/bool columns become tensor
+    columns, everything else (strings) becomes object pass-through
+    columns.
+    """
+    import pandas as pd
+
+    pdf = pd.read_csv(path, usecols=list(columns) if columns else None)
+    if columns:
+        pdf = pdf[list(columns)]  # usecols returns file order; honor ours
+    return from_pandas(pdf, num_partitions=num_partitions)
+
+
+def write_csv(df: TensorFrame, path: str) -> None:
+    """Write a frame of scalar columns as CSV (vector cells are rejected:
+    CSV has no faithful encoding for them — use parquet)."""
+    for f in df.schema:
+        if f.sql_rank != 0:
+            raise ValueError(
+                f"column {f.name!r} holds rank-{f.sql_rank} cells; CSV "
+                f"cannot represent tensor cells — use write_parquet")
+    to_pandas(df).to_csv(path, index=False)

@@ -384,7 +384,7 @@ _dsort_cache: "OrderedDict[tuple, object]" = OrderedDict()
 _DSORT_CACHE_CAP = 32
 
 
-def dsort(dist: DistributedFrame, keys, descending: bool = False
+def dsort(keys, dist: DistributedFrame, descending: bool = False
           ) -> DistributedFrame:
     """Rows globally sorted by scalar key column(s), on the mesh.
 

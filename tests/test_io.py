@@ -69,3 +69,29 @@ def test_npz_round_trip(tmp_path):
     assert back.count() == 8
     np.testing.assert_allclose(
         [r["x"] for r in back.collect()], np.arange(8.0))
+
+
+class TestCsv:
+    def test_round_trip(self, tmp_path):
+        p = str(tmp_path / "t.csv")
+        df = tft.frame({"name": np.array(["a", "b", "c"], object),
+                        "x": np.array([1.5, 2.5, 3.5]),
+                        "n": np.array([1, 2, 3], np.int64)})
+        tft.io.write_csv(df, p)
+        back = tft.io.read_csv(p, num_partitions=2)
+        rows = back.collect()
+        assert [(r["name"], r["x"], r["n"]) for r in rows] == [
+            ("a", 1.5, 1), ("b", 2.5, 2), ("c", 3.5, 3)]
+        assert back.num_partitions == 2
+
+    def test_columns_subset(self, tmp_path):
+        p = str(tmp_path / "t.csv")
+        tft.io.write_csv(tft.frame({"x": np.arange(3.0),
+                                    "y": np.arange(3.0)}), p)
+        back = tft.io.read_csv(p, columns=["y"])
+        assert back.schema.names == ["y"]
+
+    def test_vector_cells_rejected(self, tmp_path):
+        df = tft.analyze(tft.frame({"v": np.ones((2, 3))}))
+        with pytest.raises(ValueError, match="CSV cannot represent"):
+            tft.io.write_csv(df, str(tmp_path / "t.csv"))

@@ -498,7 +498,7 @@ class TestDSort:
         v = rng.normal(size=(100, 2))
         df = tft.analyze(tft.frame({"x": x, "v": v}))
         dist = par.distribute(df, mesh8)
-        out = par.dsort(dist, "x")
+        out = par.dsort("x", dist)
         rows = out.collect_frame().collect()
         order = np.argsort(x, stable=True)
         np.testing.assert_allclose([r["x"] for r in rows], x[order],
@@ -510,10 +510,10 @@ class TestDSort:
         k = np.array([1, 0, 1, 0, 2, 2], np.int64)
         x = np.array([6.0, 5.0, 4.0, 3.0, 2.0, 1.0])
         dist = par.distribute(tft.frame({"k": k, "x": x}), mesh8)
-        rows = par.dsort(dist, ["k", "x"]).collect_frame().collect()
+        rows = par.dsort(["k", "x"], dist).collect_frame().collect()
         assert [(r["k"], r["x"]) for r in rows] == [
             (0, 3.0), (0, 5.0), (1, 4.0), (1, 6.0), (2, 1.0), (2, 2.0)]
-        rows = par.dsort(dist, "x", descending=True) \
+        rows = par.dsort("x", dist, descending=True) \
             .collect_frame().collect()
         assert [r["x"] for r in rows] == [6.0, 5.0, 4.0, 3.0, 2.0, 1.0]
 
@@ -523,7 +523,7 @@ class TestDSort:
         x = np.arange(20, dtype=np.float64)
         dist = par.distribute(tft.frame({"x": x}), mesh8)
         flt = par.dfilter(lambda x: x % 3.0 == 0.0, dist)
-        out = par.dsort(flt, "x", descending=True)
+        out = par.dsort("x", flt, descending=True)
         assert out.shard_valid is None  # prefix layout restored
         rows = out.collect_frame().collect()
         assert [r["x"] for r in rows] == [18.0, 15.0, 12.0, 9.0, 6.0,
@@ -533,7 +533,7 @@ class TestDSort:
         k = np.array([f"s{i}" for i in range(10)], object)
         x = np.arange(10, dtype=np.float64)[::-1].copy()
         dist = par.distribute(tft.frame({"k": k, "x": x}), mesh8)
-        rows = par.dsort(dist, "x").collect_frame().collect()
+        rows = par.dsort("x", dist).collect_frame().collect()
         assert [r["k"] for r in rows] == [f"s{i}" for i in range(9, -1, -1)]
 
     def test_string_key_rejected(self, mesh8):
@@ -543,7 +543,7 @@ class TestDSort:
         dist = par.distribute(tft.frame({"k": k, "x": np.arange(2.0)}),
                               mesh8)
         with pytest.raises(InvalidTypeError, match="host-side"):
-            par.dsort(dist, "k")
+            par.dsort("k", dist)
 
     def test_nan_keys_stay_in_valid_prefix(self, mesh8):
         # a real row keyed NaN must not be displaced into the pad region
@@ -551,7 +551,7 @@ class TestDSort:
         x = np.array([3.0, np.nan, 1.0, 4.0, 0.5, 2.0, 9.0, 8.0, 7.0,
                       6.0])
         dist = par.distribute(tft.frame({"x": x}), mesh8)
-        rows = par.dsort(dist, "x").collect_frame().collect()
+        rows = par.dsort("x", dist).collect_frame().collect()
         got = [r["x"] for r in rows]
         assert len(got) == 10
         assert np.isnan(got[-1])
@@ -563,12 +563,12 @@ class TestDSort:
         u = np.array([5, 0, 7, 255], np.uint8)
         dist = par.distribute(tft.frame({"u": u, "x": np.arange(4.0)}),
                               mesh8)
-        rows = par.dsort(dist, "u", descending=True) \
+        rows = par.dsort("u", dist, descending=True) \
             .collect_frame().collect()
         assert [r["u"] for r in rows] == [255, 7, 5, 0]
         i = np.array([5, np.iinfo(np.int32).min, -1, 3], np.int64)
         dist = par.distribute(tft.frame({"i": i, "x": np.arange(4.0)}),
                               mesh8)
-        rows = par.dsort(dist, "i", descending=True) \
+        rows = par.dsort("i", dist, descending=True) \
             .collect_frame().collect()
         assert [r["i"] for r in rows] == [5, 3, -1, np.iinfo(np.int32).min]
