@@ -404,6 +404,84 @@ class TensorFrame:
         from . import api
         return api.filter_rows(predicate, self)
 
+    def limit(self, n: int) -> "TensorFrame":
+        """The first ``n`` rows (in block order). Lazy."""
+        if n < 0:
+            raise ValueError(f"limit({n}): n must be >= 0")
+
+        def run() -> List[Block]:
+            from .marshal import _concrete_cell
+
+            out: List[Block] = []
+            left = n
+            for b in self.blocks():
+                if left <= 0:
+                    break
+                take = min(left, b.num_rows)
+                if take == b.num_rows:
+                    out.append(b)
+                else:
+                    out.append(Block(
+                        {k: v[:take] for k, v in b.columns.items()}, take))
+                left -= take
+            return out or [Block(
+                {f.name: np.empty((0,) + _concrete_cell(f),
+                                  f.dtype.np_storage)
+                 for f in self._schema}, 0)]
+
+        return TensorFrame(self._schema, run, self._num_partitions,
+                           plan=f"limit({n})({self._plan})")
+
+    def sample(self, fraction: float, seed: int = 0) -> "TensorFrame":
+        """A Bernoulli row sample (each row kept independently with
+        probability ``fraction``). Lazy; deterministic for a given seed."""
+        if not 0.0 <= fraction <= 1.0:
+            raise ValueError(f"sample fraction {fraction} not in [0, 1]")
+
+        def run() -> List[Block]:
+            out: List[Block] = []
+            for i, b in enumerate(self.blocks()):
+                rng = np.random.default_rng((seed, i))
+                mask = rng.random(b.num_rows) < fraction
+                keep = int(mask.sum())
+                out.append(Block(
+                    {k: (v[mask] if isinstance(v, np.ndarray)
+                         else [v[j] for j in np.flatnonzero(mask)])
+                     for k, v in b.columns.items()}, keep))
+            return out
+
+        return TensorFrame(self._schema, run, self._num_partitions,
+                           plan=f"sample({fraction})({self._plan})")
+
+    def show(self, n: int = 20) -> None:
+        """Print the first ``n`` rows as a small aligned table (the Spark
+        ``df.show()`` convenience)."""
+        rows = self.limit(n).collect()
+        names = self._schema.names
+
+        def fmt(v):
+            if isinstance(v, float):
+                return f"{v:.6g}"
+            if isinstance(v, np.ndarray):
+                flat = np.asarray(v).reshape(-1)
+                s = ", ".join(f"{x:.4g}" if isinstance(x, float)
+                              else str(x) for x in flat[:4])
+                return f"[{s}{', ...' if flat.size > 4 else ''}]"
+            return str(v)
+
+        table = [[fmt(r[i]) for i in range(len(names))] for r in rows]
+        widths = [max(len(nm), *(len(t[i]) for t in table))
+                  if table else len(nm) for i, nm in enumerate(names)]
+        line = "+" + "+".join("-" * (w + 2) for w in widths) + "+"
+        print(line)
+        print("|" + "|".join(f" {nm:<{w}} "
+                             for nm, w in zip(names, widths)) + "|")
+        print(line)
+        for t in table:
+            print("|" + "|".join(f" {c:<{w}} "
+                                 for c, w in zip(t, widths)) + "|")
+        print(line)
+
     def order_by(self, *cols: str, descending: bool = False,
                  num_partitions: Optional[int] = None) -> "TensorFrame":
         """Rows globally sorted by scalar key column(s). Lazy.

@@ -199,8 +199,9 @@ def read_csv(path: str, num_partitions: int = 1,
     """
     import pandas as pd
 
-    pdf = pd.read_csv(path, usecols=list(columns) if columns else None)
-    if columns:
+    pdf = pd.read_csv(
+        path, usecols=list(columns) if columns is not None else None)
+    if columns is not None:
         pdf = pdf[list(columns)]  # usecols returns file order; honor ours
     return from_pandas(pdf, num_partitions=num_partitions)
 

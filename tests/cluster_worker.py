@@ -105,6 +105,21 @@ def main() -> int:
         sel = k_g == r["k"]
         np.testing.assert_allclose(r["x"], x_g[sel].sum(), rtol=1e-12)
 
+    # 7. dfilter across processes (per-shard compaction under the
+    # per-process pad layout) chained into a collective reduce
+    flt = par.dfilter(lambda x: x < 500.0, dist)   # keeps only p0's rows
+    assert flt.count() == 23, flt.count()
+    fred = par.dreduce_blocks({"x": "sum"}, flt.select("x"))
+    np.testing.assert_allclose(fred["x"], x_g[x_g < 500].sum(), rtol=1e-12)
+
+    # 8. dsort across processes: global order out of process-local shards,
+    # result normalized to prefix validity
+    srt = par.dsort("x", flt.select("x"), descending=True)
+    assert srt.shard_valid is None
+    top = srt.collect_frame().collect()
+    np.testing.assert_allclose([r["x"] for r in top],
+                               np.sort(x_g[x_g < 500])[::-1], rtol=1e-12)
+
     print(f"[worker {pid}] OK", flush=True)
     return 0
 

@@ -204,3 +204,49 @@ class TestOrderBy:
             df.order_by("nope")
         with pytest.raises(ValueError, match="at least one"):
             df.order_by()
+
+
+class TestLimitSampleShow:
+    def _df(self, n=10, parts=3):
+        import tensorframes_tpu as tft
+
+        return tft.frame({"x": np.arange(float(n))}, num_partitions=parts)
+
+    def test_limit(self):
+        df = self._df()
+        assert [r["x"] for r in df.limit(4).collect()] == [0.0, 1.0, 2.0,
+                                                           3.0]
+        assert df.limit(0).collect() == []
+        assert df.limit(100).count() == 10
+        with pytest.raises(ValueError, match=">= 0"):
+            df.limit(-1)
+
+    def test_limit_preserves_string_columns(self):
+        import tensorframes_tpu as tft
+
+        df = tft.frame({"k": np.array(["a", "b", "c"], object),
+                        "x": np.arange(3.0)})
+        rows = df.limit(2).collect()
+        assert [(r["k"], r["x"]) for r in rows] == [("a", 0.0), ("b", 1.0)]
+
+    def test_sample_deterministic_and_bounds(self):
+        df = self._df(1000, parts=4)
+        s1 = df.sample(0.3, seed=7).collect()
+        s2 = df.sample(0.3, seed=7).collect()
+        assert [r["x"] for r in s1] == [r["x"] for r in s2]
+        assert 200 < len(s1) < 400          # ~300, loose bounds
+        assert df.sample(0.0).count() == 0
+        assert df.sample(1.0).count() == 1000
+        with pytest.raises(ValueError, match="not in"):
+            df.sample(1.5)
+
+    def test_show_prints_table(self, capsys):
+        import tensorframes_tpu as tft
+
+        df = tft.analyze(tft.frame({"x": np.arange(3.0),
+                                    "v": np.ones((3, 6))}))
+        df.show(2)
+        out = capsys.readouterr().out
+        assert "| x" in out and "| v" in out
+        assert "..." in out          # long vector cells elide
+        assert out.count("\n") >= 6  # frame lines + 2 rows

@@ -95,3 +95,8 @@ class TestCsv:
         df = tft.analyze(tft.frame({"v": np.ones((2, 3))}))
         with pytest.raises(ValueError, match="CSV cannot represent"):
             tft.io.write_csv(df, str(tmp_path / "t.csv"))
+
+    def test_empty_columns_list_matches_parquet_semantics(self, tmp_path):
+        p = str(tmp_path / "t.csv")
+        tft.io.write_csv(tft.frame({"x": np.arange(3.0)}), p)
+        assert tft.io.read_csv(p, columns=[]).schema.names == []
