@@ -103,17 +103,50 @@ def _child(platform: str) -> None:
     ref = N_ROWS / (time.perf_counter() - t0)
 
     n_chips = max(1, len(jax.devices()))
-    print(json.dumps({
+    plat = jax.default_backend()
+    rec = {
         "metric": "map_blocks_add_const_1M_rows",
         "value": round(ours / n_chips, 1),
         "unit": "rows/sec/chip",
         "vs_baseline": round(ours / ref, 2),
-        "platform": jax.default_backend(),
+        "platform": plat,
         "n_chips": n_chips,
         "e2e_with_marshalling_rows_per_s": round(e2e, 1),
         "row_path_rows_per_s": round(ref, 1),
         "executor": executor,
-    }))
+    }
+
+    if plat == "tpu":
+        # MXU secondary metric, TPU only (the add-constant headline is
+        # HBM-bound; this one exercises the matrix unit): bf16 2048^3
+        # matmul, device-resident, pipelined steady state. MFU only when
+        # the chip generation's dense-bf16 peak is known.
+        import jax.numpy as jnp
+
+        M = 2048
+        a = jax.device_put(jnp.ones((M, M), jnp.bfloat16))
+        b = jax.device_put(jnp.ones((M, M), jnp.bfloat16))
+        mm = jax.jit(lambda a, b: a @ b)
+        jax.block_until_ready(mm(a, b))
+        t0 = time.perf_counter()
+        mm_iters = 30
+        for _ in range(mm_iters):
+            o = mm(a, b)
+        jax.block_until_ready(o)
+        mm_sec = (time.perf_counter() - t0) / mm_iters
+        matmul_tflops = 2 * M ** 3 / mm_sec / 1e12
+        rec["matmul_bf16_tflops"] = round(matmul_tflops, 2)
+        kind = jax.devices()[0].device_kind
+        rec["device_kind"] = kind
+        peaks = {  # dense bf16 TFLOP/s per chip, by device_kind substring
+            "v4": 275.0, "v5 lite": 197.0, "v5e": 197.0,
+            "v5p": 459.0, "v5": 459.0, "v6 lite": 918.0, "v6e": 918.0,
+        }
+        peak = next((v for k, v in peaks.items() if k in kind.lower()),
+                    None)
+        if peak is not None:
+            rec["matmul_mfu"] = round(matmul_tflops / peak, 4)
+    print(json.dumps(rec))
 
 
 # --------------------------------------------------------------------------
