@@ -40,6 +40,7 @@ from ..frame import Block, TensorFrame
 from ..schema import Schema
 from .collectives import COMBINERS
 from .mesh import DeviceMesh
+from ..utils.tracing import span
 
 __all__ = ["DistributedFrame", "distribute", "dmap_blocks", "dfilter",
            "dsort", "dreduce_blocks", "daggregate"]
@@ -275,7 +276,8 @@ def dmap_blocks(fetches, dist: DistributedFrame, trim: bool = False,
     mesh = dist.mesh
 
     jitted = _jitted(comp)
-    out = jitted({n: dist.columns[n] for n in comp.input_names})
+    with span("dmap_blocks.dispatch"):
+        out = jitted({n: dist.columns[n] for n in comp.input_names})
     leads = {out[s.name].shape[0] for s in comp.outputs}
     if len(leads) > 1:
         raise ValueError(
@@ -363,7 +365,8 @@ def dfilter(predicate, dist: DistributedFrame) -> DistributedFrame:
                                in_specs=in_specs, out_specs=out_specs))
         cache[key] = fn
 
-    outs = fn(cnt_dev, *arrays)
+    with span("dfilter.dispatch"):
+        outs = fn(cnt_dev, *arrays)
     new_cols: Dict[str, jax.Array] = dict(zip(tensor_names, outs))
     counts = _read_global(outs[len(tensor_names)]).astype(np.int64)
     if host_names:
@@ -474,7 +477,8 @@ def dsort(keys, dist: DistributedFrame, descending: bool = False
     else:
         _dsort_cache.move_to_end(ckey)
 
-    outs = fn(valid_dev, *arrays)
+    with span("dsort.dispatch"):
+        outs = fn(valid_dev, *arrays)
     new_cols: Dict[str, jax.Array] = dict(zip(tensor_names, outs))
     if want_order:
         order_host = _read_global(outs[len(tensor_names)])
@@ -565,7 +569,8 @@ def _collective_reduce(col_combiners: Mapping[str, str],
     nv_dev = jax.make_array_from_callback(
         (mesh.num_data_shards,), mesh.row_sharding(1),
         lambda idx: dist.per_shard_valid().astype(np.int32)[idx])
-    outs = fn(nv_dev, *arrays)
+    with span("dreduce_blocks.collective_dispatch"):
+        outs = fn(nv_dev, *arrays)
     result = {}
     for name, a in zip(names, outs):
         v = np.asarray(a)
@@ -834,7 +839,8 @@ def daggregate(fetches, dist: DistributedFrame, keys,
 
     fn = jax.jit(shard_map(shard_fn, mesh=mesh.mesh,
                            in_specs=in_specs, out_specs=out_specs))
-    tables = fn(ids_dev, *arrays)
+    with span("daggregate.dispatch"):
+        tables = fn(ids_dev, *arrays)
 
     if device_keys:
         kvals, num_out = _device_key_column(dist, keys[0], uniq_dev,
@@ -970,7 +976,8 @@ def _segmented_fold(comp, names, mesh: DeviceMesh, arrays, ids_dev,
         # like _collective_cache does
         while len(cache) > 16:
             cache.popitem(last=False)
-    return fn(ids_dev, *arrays)
+    with span("daggregate.segmented_fold_dispatch"):
+        return fn(ids_dev, *arrays)
 
 
 def _generic_daggregate(fetches, dist: DistributedFrame, keys,
@@ -1124,7 +1131,8 @@ def _generic_reduce(fetches, dist: DistributedFrame) -> Dict[str, np.ndarray]:
 
         fn = jax.jit(program)
         cache[key] = fn
-    final = fn(*arrays)
+    with span("dreduce_blocks.generic_dispatch"):
+        final = fn(*arrays)
     out = {}
     for f in fetch_names:
         v = np.asarray(final[f])

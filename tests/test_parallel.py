@@ -572,3 +572,60 @@ class TestDSort:
         rows = par.dsort("i", dist, descending=True) \
             .collect_frame().collect()
         assert [r["i"] for r in rows] == [5, 3, -1, np.iinfo(np.int32).min]
+
+
+class TestHostMeshConformance:
+    """Randomized cross-check: every mesh op must agree with its host
+    twin on the same data (the ExtractNodes two-lowerings pattern applied
+    to the distribution layer)."""
+
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_random_frames_agree(self, mesh8, seed):
+        rng = np.random.default_rng(seed)
+        n = int(rng.integers(30, 200))
+        g = int(rng.integers(2, 12))
+        df = tft.analyze(tft.frame({
+            "k": rng.integers(0, g, n).astype(np.int32),
+            "x": rng.normal(size=n),
+            "v": rng.normal(size=(n, int(rng.integers(1, 4)))),
+        }, num_partitions=int(rng.integers(1, 5))))
+        dist = par.distribute(df, mesh8)
+
+        # map
+        h = tft.map_blocks(lambda x, v: {"z": x[:, None] * v}, df)
+        m = par.dmap_blocks(lambda x, v: {"z": x[:, None] * v}, dist)
+        hz = np.concatenate([b.dense("z") for b in h.blocks()])
+        mz = np.concatenate(
+            [b.dense("z") for b in m.collect_frame().blocks()])
+        np.testing.assert_allclose(mz, hz, rtol=1e-6)
+
+        # reduce (monoid + generic)
+        hs = tft.reduce_blocks(lambda x_input: {"x": x_input.sum(0)},
+                               df.select(["x"]))
+        ms = par.dreduce_blocks({"x": "sum"}, dist.select(["x"]))
+        np.testing.assert_allclose(ms["x"], hs, rtol=1e-6)
+        hm = tft.reduce_blocks(
+            lambda v_input: {"v": jnp.max(v_input, axis=0)},
+            df.select(["v"]))
+        mm = par.dreduce_blocks(
+            lambda v_input: {"v": jnp.max(v_input, axis=0)},
+            dist.select(["v"]))
+        np.testing.assert_allclose(mm["v"], hm, rtol=1e-6)
+
+        # aggregate
+        ha = tft.aggregate({"x": "sum"}, df.select(["k", "x"])
+                           .group_by("k")).collect()
+        ma = par.daggregate({"x": "sum"}, dist.select(["k", "x"]),
+                            "k").collect()
+        hd = {r["k"]: r["x"] for r in ha}
+        md = {r["k"]: r["x"] for r in ma}
+        assert set(hd) == set(md)
+        for kk in hd:
+            np.testing.assert_allclose(md[kk], hd[kk], rtol=1e-6)
+
+        # filter + sort chain
+        hf = df.filter(lambda x: x > 0.0).order_by("x").collect()
+        mf = par.dsort("x", par.dfilter(lambda x: x > 0.0, dist)) \
+            .collect_frame().collect()
+        np.testing.assert_allclose([r["x"] for r in mf],
+                                   [r["x"] for r in hf], rtol=1e-7)

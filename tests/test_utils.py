@@ -101,3 +101,35 @@ def test_profile_writes_trace(tmp_path):
     assert not tracing.enabled()
     assert list(tmp_path.rglob("*"))  # something was written
     assert tracing.timings.snapshot()  # host spans captured in-window
+
+
+def test_mesh_op_spans_recorded():
+    # TFT_TRACE spans cover the distribution layer too
+    import tensorframes_tpu as tft
+    from tensorframes_tpu import parallel as par
+    from tensorframes_tpu.utils import tracing
+
+    tracing.enable()
+    try:
+        tracing.timings.reset()
+        df = tft.frame({"k": np.arange(16, dtype=np.int32) % 3,
+                        "x": np.arange(16.0)})
+        dist = par.distribute(tft.analyze(df), par.local_mesh())
+        par.dmap_blocks(lambda x: {"z": x + 1.0}, dist)
+        par.dfilter(lambda x: x > 3.0, dist)
+        par.dsort("x", dist)
+        par.daggregate({"x": "sum"}, dist.select(["k", "x"]), "k")
+        par.dreduce_blocks({"x": "sum"}, dist.select(["x"]))
+        par.dreduce_blocks(lambda x_input: {"x": x_input.sum(0)},
+                           dist.select(["x"]))
+        import jax.numpy as jnp
+        par.daggregate(lambda x_input: {"x": jnp.sum(x_input, 0)},
+                       dist.select(["k", "x"]), "k")
+        names = set(tracing.timings.snapshot())
+        assert {"dmap_blocks.dispatch", "dfilter.dispatch",
+                "dsort.dispatch", "daggregate.dispatch",
+                "dreduce_blocks.collective_dispatch",
+                "dreduce_blocks.generic_dispatch",
+                "daggregate.segmented_fold_dispatch"} <= names, names
+    finally:
+        tracing.disable()
