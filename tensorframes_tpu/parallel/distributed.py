@@ -158,6 +158,27 @@ class DistributedFrame:
         """True (un-padded) global row count."""
         return self.num_rows
 
+    def explain(self) -> str:
+        """Schema + placement report (the mesh-side ``explain`` /
+        ``print_schema`` analogue): per-column dtype, declared shape,
+        device sharding, plus mesh/pad layout."""
+        lines = [f"DistributedFrame: {self.num_rows} rows "
+                 f"(padded {self.padded_rows}) on {self.mesh!r}",
+                 f"  validity: "
+                 + ("prefix" if self.shard_valid is None
+                    else f"per-shard {list(map(int, self.shard_valid))}")]
+        for f in self.schema:
+            col = self.columns[f.name]
+            if isinstance(col, np.ndarray):
+                place = "host (ride-along)"
+            else:
+                try:
+                    place = str(col.sharding.spec)
+                except Exception:
+                    place = type(col).__name__
+            lines.append(f"  {f.describe()} sharding={place}")
+        return "\n".join(lines)
+
     def __repr__(self):
         return (f"DistributedFrame[{', '.join(self.schema.names)}] "
                 f"rows={self.num_rows} mesh={self.mesh!r}")

@@ -629,3 +629,18 @@ class TestHostMeshConformance:
             .collect_frame().collect()
         np.testing.assert_allclose([r["x"] for r in mf],
                                    [r["x"] for r in hf], rtol=1e-7)
+
+
+def test_distributed_frame_explain(mesh8):
+    k = np.array(["a", "b"], object)
+    df = tft.analyze(tft.frame({"k": k, "x": np.arange(2.0),
+                                "v": np.ones((2, 3))}))
+    dist = par.distribute(df, mesh8)
+    out = dist.explain()
+    assert "2 rows" in out and "padded 8" in out
+    assert "prefix" in out
+    assert "host (ride-along)" in out            # string column
+    assert "x: double" in out and "v: array<double>" in out
+    assert "PartitionSpec('data'" in out
+    flt = par.dfilter(lambda x: x >= 0.0, dist)
+    assert "per-shard" in flt.explain()
