@@ -671,7 +671,8 @@ def _host_group_ids(dist: DistributedFrame, keys):
     return ids_dev, fact.uniques, fact.num_groups
 
 
-def _device_group_ids(dist: DistributedFrame, key: str, max_groups: int):
+def _device_group_ids(dist: DistributedFrame, key: str, max_groups: int,
+                      valid=None):
     """Dense group ids computed ON DEVICE for a single integer key column.
 
     The host-factorization path ships the whole key column driver-side per
@@ -682,13 +683,14 @@ def _device_group_ids(dist: DistributedFrame, key: str, max_groups: int):
     group table and a ``searchsorted`` maps rows to ids — XLA inserts the
     cross-shard gather for the sort, which IS the shuffle, on ICI.
 
-    ``max_groups`` caps the static table size (XLA needs static shapes).
-    Returns ``(ids_dev [padded] int32 row-sharded, uniques_dev
-    [max_groups+1], count_dev scalar)`` — ids are ``-1`` for pad rows;
-    overflowing the cap raises at the call site after the count readback.
+    ``max_groups`` caps the static table size (XLA needs static shapes);
+    ``valid`` (row-sharded bool [padded]) is built when absent so
+    composite-key callers upload it once. Returns the raw
+    ``(ids_dev, uniques_dev, count_dev, sentinel_hit)`` from
+    :func:`_build_device_ids` — ids are ``-1`` for pad rows; cap overflow
+    and the sentinel flag are the CALLER's to read back and raise on.
     """
     kcol = dist.columns[key]
-    mesh = dist.mesh
     if not jnp.issubdtype(kcol.dtype, jnp.integer):
         raise _ops.InvalidTypeError(
             f"device-side aggregation needs an integer key column; {key!r} "
@@ -702,18 +704,27 @@ def _device_group_ids(dist: DistributedFrame, key: str, max_groups: int):
             f"{kcol.dtype} on device, which can merge distinct keys; cast "
             f"the key to a device-exact type (e.g. int) before "
             f"distribute(), or enable x64")
-    valid_host = dist.valid_row_mask()
-    valid = jax.make_array_from_callback(
-        (dist.padded_rows,), mesh.row_sharding(1),
-        lambda idx: valid_host[idx])
-    ids, uniq, count, sentinel_hit = _build_device_ids(kcol, valid,
-                                                       max_groups)
+    if valid is None:
+        valid = _valid_dev(dist)
+    # NB: returns traced/async values incl. the sentinel flag — callers
+    # read back and raise (lets the composite path dispatch every key's
+    # program before the first synchronization)
+    return _build_device_ids(kcol, valid, max_groups)
+
+
+def _sentinel_check(sentinel_hit, key: str) -> None:
     if bool(sentinel_hit):
         raise _ops.InvalidTypeError(
             f"key column {key!r} contains the dtype's max value, which the "
             f"device path reserves as its pad sentinel; use the host path "
             f"(max_groups=None) for such keys")
-    return ids, uniq, count
+
+
+def _valid_dev(dist: DistributedFrame):
+    valid_host = dist.valid_row_mask()
+    return jax.make_array_from_callback(
+        (dist.padded_rows,), dist.mesh.row_sharding(1),
+        lambda idx: valid_host[idx])
 
 
 @functools.partial(jax.jit, static_argnums=(2,))
@@ -730,34 +741,92 @@ def _build_device_ids(kc, vm, max_groups: int):
     return ids, uniq, count, sentinel_hit
 
 
+@functools.partial(jax.jit, static_argnums=(2,))
+def _combine_ids(acc, ids_k, radix: int):
+    """Mixed-radix combination of dense per-key ids (int32 throughout —
+    the device path must work with x64 disabled, so no int64 packing)."""
+    return acc * np.int32(radix) + ids_k
+
+
 def _device_key_ids(dist: DistributedFrame, keys, max_groups: int):
-    """Shared entry to the device-keys path (monoid + generic daggregate):
-    single-key validation + ids/uniques/count on the mesh. Returns
-    ``(ids_dev, uniq_dev, count_dev, table_groups)`` where
-    ``table_groups`` is the static table size (cap + sentinel slot)."""
-    if len(keys) != 1:
-        raise _ops.InvalidTypeError(
-            "device-side aggregation (max_groups=) supports a single "
-            "key column; composite keys take the host path")
-    ids_dev, uniq_dev, count_dev = _device_group_ids(dist, keys[0],
-                                                     max_groups)
-    return ids_dev, uniq_dev, count_dev, max_groups + 1
+    """Shared entry to the device-keys path (monoid + generic daggregate).
+
+    One key: sort-unique + searchsorted on the mesh (the key never visits
+    the host). Composite keys: each key column factorizes to dense ids the
+    same way, the ids combine into one mixed-radix int32 id space
+    (``radix = max_groups + 1`` per position — every key's distinct count
+    is bounded by the final group count, so one cap serves all), and one
+    more sort-unique over the combined ids yields the dense group table.
+    All arithmetic stays int32: ``(cap+1)^k`` must fit, which bounds the
+    cap at ~46k for two keys (checked loudly; the host path has no cap).
+
+    Returns ``(ids_dev, key_table, count_dev, table_groups)`` where
+    ``key_table`` carries what :func:`_device_key_columns` needs to
+    rebuild the key columns and ``table_groups`` is the static table size
+    (cap + sentinel slot)."""
+    if len(keys) == 1:
+        ids_dev, uniq_dev, count_dev, sent = _device_group_ids(
+            dist, keys[0], max_groups)
+        _sentinel_check(sent, keys[0])
+        return ids_dev, ("single", uniq_dev), count_dev, max_groups + 1
+
+    radix = max_groups + 1
+    if radix ** len(keys) >= 2 ** 31 - 1:
+        raise ValueError(
+            f"max_groups={max_groups} with {len(keys)} key columns "
+            f"overflows the int32 combined-id space ((cap+1)^k must stay "
+            f"below 2^31); lower the cap or use the host path "
+            f"(max_groups=None)")
+    # one valid-mask upload serves every per-key program and the final
+    # combine; all dispatches go out before the first readback
+    valid = _valid_dev(dist)
+    per = [_device_group_ids(dist, k, max_groups, valid=valid)
+           for k in keys]
+    combined = None
+    for ids_k, _, _, _ in per:
+        combined = (ids_k if combined is None
+                    else _combine_ids(combined, ids_k, radix))
+    ids, uniq_c, count, _ = _build_device_ids(combined, valid, max_groups)
+    for k, (_, _, count_k, sent_k) in zip(keys, per):
+        _sentinel_check(sent_k, k)
+        if int(count_k) > max_groups:
+            # a truncated per-key table would silently merge distinct
+            # keys before the final overflow check could see them
+            raise ValueError(
+                f"more than max_groups={max_groups} distinct values in "
+                f"key column {k!r}; raise max_groups (the static table "
+                f"cap)")
+    per_uniq = [u for _, u, _, _ in per]
+    return ids, ("multi", uniq_c, per_uniq, radix), count, max_groups + 1
 
 
-def _device_key_column(dist: DistributedFrame, key: str, uniq_dev,
-                       count_dev, max_groups: int):
-    """Overflow check + host materialization of the device group table.
-    Returns ``(key_values, num_groups)``."""
+def _device_key_columns(dist: DistributedFrame, keys, key_table,
+                        count_dev, max_groups: int):
+    """Overflow check + host materialization of the device group table(s).
+    Returns ``({key name: values}, num_groups)``."""
     count = int(count_dev)
     if count > max_groups:
         raise ValueError(
             f"more than max_groups={max_groups} distinct keys in "
-            f"{key!r}; raise max_groups (the static table cap)")
-    kfld = dist.schema[key]
-    kvals = np.asarray(uniq_dev)[:count]
-    if kvals.dtype != kfld.dtype.np_storage:  # integer keys only
-        kvals = kvals.astype(kfld.dtype.np_storage)
-    return kvals, count
+            f"{keys}; raise max_groups (the static table cap)")
+
+    def cast(vals, key):
+        kfld = dist.schema[key]
+        if vals.dtype != kfld.dtype.np_storage:  # integer keys only
+            vals = vals.astype(kfld.dtype.np_storage)
+        return vals
+
+    if key_table[0] == "single":
+        return {keys[0]: cast(np.asarray(key_table[1])[:count],
+                              keys[0])}, count
+    _, uniq_c, per_uniq, radix = key_table
+    comb = np.asarray(uniq_c)[:count].astype(np.int64)
+    digits = []
+    for _ in keys:                       # least-significant digit first
+        digits.append(comb % radix)
+        comb = comb // radix
+    return {k: cast(np.asarray(per_uniq[i])[digits[len(keys) - 1 - i]], k)
+            for i, k in enumerate(keys)}, count
 
 
 def daggregate(fetches, dist: DistributedFrame, keys,
@@ -791,11 +860,13 @@ def daggregate(fetches, dist: DistributedFrame, keys,
     :class:`TensorFrame` of one row per group (keys + fetches, fetches
     sorted by name), like :func:`~tensorframes_tpu.api.aggregate`.
 
-    ``max_groups``: opt into DEVICE-side group ids for a single integer
-    key (``_device_group_ids``): the key column never visits the host —
-    at 100k+ groups the host path's driver-side transfer + lexsort
-    dominate (``benchmarks/daggregate_bench.py`` measures both). The
-    value caps the static group-table size; exceeding it raises.
+    ``max_groups``: opt into DEVICE-side group ids for integer key(s)
+    (``_device_key_ids``): the key columns never visit the host — at
+    100k+ groups the host path's driver-side transfer + lexsort dominate
+    (``benchmarks/daggregate_bench.py`` measures both). The value caps
+    the static group-table size; exceeding it raises. Composite keys
+    combine per-key dense ids in a mixed-radix int32 space, which bounds
+    the cap at ``(cap+1)^k < 2^31``.
     """
     if isinstance(keys, str):
         keys = [keys]
@@ -864,9 +935,8 @@ def daggregate(fetches, dist: DistributedFrame, keys,
         tables = fn(ids_dev, *arrays)
 
     if device_keys:
-        kvals, num_out = _device_key_column(dist, keys[0], uniq_dev,
+        cols, num_out = _device_key_columns(dist, keys, uniq_dev,
                                             count_dev, max_groups)
-        cols: Dict[str, np.ndarray] = {keys[0]: kvals}
     else:
         cols = {k: u for k, u in zip(keys, uniques)}
         num_out = num_groups
@@ -1049,9 +1119,8 @@ def _generic_daggregate(fetches, dist: DistributedFrame, keys,
                             ids_dev, table_groups)
 
     if max_groups is not None:
-        kvals, num_groups = _device_key_column(dist, keys[0], uniq_dev,
+        cols, num_groups = _device_key_columns(dist, keys, uniq_dev,
                                                count_dev, max_groups)
-        cols: Dict[str, np.ndarray] = {keys[0]: kvals}
     else:
         num_groups = table_groups
         cols = {k: u for k, u in zip(keys, uniques)}

@@ -644,3 +644,71 @@ def test_distributed_frame_explain(mesh8):
     assert "PartitionSpec('data'" in out
     flt = par.dfilter(lambda x: x >= 0.0, dist)
     assert "per-shard" in flt.explain()
+
+
+class TestDeviceKeysMultiKey:
+    def test_two_key_monoid_matches_host_path(self, mesh8):
+        rng = np.random.default_rng(41)
+        n = 3000
+        k1 = rng.integers(-5, 5, n).astype(np.int32)   # negatives too
+        k2 = rng.integers(0, 7, n).astype(np.int32)
+        x = rng.normal(size=n)
+        df = tft.frame({"k1": k1, "k2": k2, "x": x})
+        dist = par.distribute(df, mesh8)
+        host = par.daggregate({"x": "sum"}, dist, ["k1", "k2"])
+        dev = par.daggregate({"x": "sum"}, dist, ["k1", "k2"],
+                             max_groups=128)
+        h = {(r["k1"], r["k2"]): r["x"] for r in host.collect()}
+        d = {(r["k1"], r["k2"]): r["x"] for r in dev.collect()}
+        assert set(h) == set(d) and len(d) == len(
+            {(a, b) for a, b in zip(k1, k2)})
+        for kk in h:
+            np.testing.assert_allclose(d[kk], h[kk], rtol=1e-9)
+
+    def test_two_key_generic_matches_host_path(self, mesh8):
+        rng = np.random.default_rng(42)
+        n = 500
+        k1 = rng.integers(0, 4, n).astype(np.int32)
+        k2 = rng.integers(0, 3, n).astype(np.int32)
+        v = rng.normal(size=(n, 2))
+        dist = par.distribute(tft.frame({"k1": k1, "k2": k2, "v": v}),
+                              mesh8)
+        host = par.daggregate(
+            lambda v_input: {"v": jnp.sqrt((v_input ** 2).sum(0))},
+            dist, ["k1", "k2"])
+        dev = par.daggregate(
+            lambda v_input: {"v": jnp.sqrt((v_input ** 2).sum(0))},
+            dist, ["k1", "k2"], max_groups=32)
+        h = {(r["k1"], r["k2"]): r["v"] for r in host.collect()}
+        d = {(r["k1"], r["k2"]): r["v"] for r in dev.collect()}
+        assert set(h) == set(d)
+        for kk in h:
+            np.testing.assert_allclose(d[kk], h[kk], rtol=1e-6)
+
+    def test_three_keys(self, mesh8):
+        rng = np.random.default_rng(43)
+        n = 200
+        cols = {f"k{i}": rng.integers(0, 3, n).astype(np.int32)
+                for i in range(3)}
+        cols["x"] = rng.normal(size=n)
+        dist = par.distribute(tft.frame(cols), mesh8)
+        dev = par.daggregate({"x": "max"}, dist, ["k0", "k1", "k2"],
+                             max_groups=27).collect()
+        for r in dev:
+            sel = ((cols["k0"] == r["k0"]) & (cols["k1"] == r["k1"])
+                   & (cols["k2"] == r["k2"]))
+            np.testing.assert_allclose(r["x"], cols["x"][sel].max(),
+                                       rtol=1e-9)
+
+    def test_cap_overflow_errors(self, mesh8):
+        n = 100
+        k1 = np.arange(n, dtype=np.int32)      # 100 distinct
+        k2 = np.zeros(n, np.int32)
+        dist = par.distribute(tft.frame({"k1": k1, "k2": k2,
+                                         "x": np.ones(n)}), mesh8)
+        with pytest.raises(ValueError, match="distinct"):
+            par.daggregate({"x": "sum"}, dist, ["k1", "k2"],
+                           max_groups=10)
+        with pytest.raises(ValueError, match="int32 combined-id"):
+            par.daggregate({"x": "sum"}, dist, ["k1", "k2"],
+                           max_groups=100_000)
