@@ -189,18 +189,23 @@ def write_npz(df: TensorFrame, path: str) -> None:
 
 
 def read_csv(path: str, num_partitions: int = 1,
-             columns: Optional[Sequence[str]] = None) -> TensorFrame:
+             columns: Optional[Sequence[str]] = None,
+             dtypes: Optional[dict] = None) -> TensorFrame:
     """Load a CSV (header row required) as a TensorFrame.
 
     Parsing rides pandas (baked in); dtypes map through the same policy
     as :func:`from_pandas` — float/int/bool columns become tensor
     columns, everything else (strings) becomes object pass-through
-    columns.
+    columns. ``dtypes`` (column -> numpy dtype) pins parse dtypes — e.g.
+    ``{"key": "int32"}`` for columns that will become device-side group
+    keys (x64 is off on TPU, so int64 keys would hit the narrowing
+    guard).
     """
     import pandas as pd
 
     pdf = pd.read_csv(
-        path, usecols=list(columns) if columns is not None else None)
+        path, usecols=list(columns) if columns is not None else None,
+        dtype=dtypes)
     if columns is not None:
         pdf = pdf[list(columns)]  # usecols returns file order; honor ours
     return from_pandas(pdf, num_partitions=num_partitions)

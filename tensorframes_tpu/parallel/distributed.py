@@ -871,6 +871,8 @@ def daggregate(fetches, dist: DistributedFrame, keys,
     if isinstance(keys, str):
         keys = [keys]
     keys = list(keys)
+    if not keys:
+        raise ValueError("daggregate needs at least one key column")
     schema = dist.schema
     for k in keys:
         if k not in schema:
@@ -1110,8 +1112,9 @@ def _generic_daggregate(fetches, dist: DistributedFrame, keys,
     _ops._validate_reduce(comp, value_schema, ("_input",), rank_delta=1)
     names = sorted(comp.output_names)
 
-    # device-side keys: ids + group table built on the mesh, the key
-    # column never visits the host (single integer key only)
+    # device-side keys (max_groups=): ids + group table built on the
+    # mesh, the key column(s) never visit the host (composite keys
+    # combine in the mixed-radix id space, _device_key_ids)
     ids_dev, uniques, uniq_dev, count_dev, table_groups = _cached_group_ids(
         dist, keys, max_groups)
     final = _segmented_fold(comp, names, mesh,

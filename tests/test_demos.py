@@ -175,3 +175,29 @@ def test_train_lm_corpus_is_frame_partitioned():
     diffs = np.diff(toks, axis=1) % 16
     assert set(np.unique(diffs)) <= {1, 2}
     assert (diffs == diffs[:, :1]).all()
+
+
+# -- analytics pipeline (csv -> filter -> mesh aggregate -> rank) -----------
+
+def test_analytics_pipeline_matches_numpy(tmp_path):
+    from demos import analytics as an
+
+    csv_path = str(tmp_path / "readings.csv")
+    an.make_csv(csv_path, n=3000, sites=3, sensors=4, seed=5)
+    ranked = an.pipeline(csv_path)
+    rows = ranked.collect()
+
+    # numpy recomputation from the raw file
+    raw = np.genfromtxt(csv_path, delimiter=",", names=True)
+    keep = raw["value"] >= 0
+    ref = {}
+    for s, d, v in zip(raw["site"][keep].astype(int),
+                       raw["sensor"][keep].astype(int),
+                       raw["value"][keep]):
+        ref[(s, d)] = ref.get((s, d), 0.0) + v
+    got = {(r["site"], r["sensor"]): r["value"] for r in rows}
+    assert set(got) == set(ref)
+    for k in ref:
+        assert got[k] == pytest.approx(ref[k], rel=1e-5)
+    totals = [r["value"] for r in rows]
+    assert totals == sorted(totals, reverse=True)

@@ -712,3 +712,11 @@ class TestDeviceKeysMultiKey:
         with pytest.raises(ValueError, match="int32 combined-id"):
             par.daggregate({"x": "sum"}, dist, ["k1", "k2"],
                            max_groups=100_000)
+
+
+def test_daggregate_empty_keys_rejected(mesh8):
+    dist = par.distribute(tft.frame({"x": np.ones(8)}), mesh8)
+    with pytest.raises(ValueError, match="at least one key"):
+        par.daggregate({"x": "sum"}, dist, [])
+    with pytest.raises(ValueError, match="at least one key"):
+        par.daggregate({"x": "sum"}, dist, [], max_groups=4)
