@@ -54,8 +54,10 @@ def test_daggregate_bench_light():
         capture_output=True, text=True, timeout=300, env=_CPU_ENV)
     assert proc.returncode == 0, proc.stderr[-2000:]
     lines = [json.loads(ln) for ln in proc.stdout.strip().splitlines()]
-    suffixes = {"_".join(r["metric"].rsplit("_", 2)[-2:]) for r in lines}
-    assert suffixes == {"host_keys", "device_keys"}
+    metrics = {r["metric"].split("x", 1)[1].split("_", 1)[1]
+               for r in lines}
+    assert metrics == {"host_keys", "host_keys_warm", "device_keys",
+                       "device_keys_warm", "multikey_device"}, metrics
 
 
 def test_tpu_pallas_smoke_fails_gracefully_off_chip():
