@@ -26,6 +26,7 @@ with ``platform`` and (on failure) ``error`` fields.
 """
 
 import json
+import os
 import subprocess
 import sys
 import time
@@ -33,8 +34,12 @@ import time
 N_ROWS = 1_000_000
 WARMUP = 3
 ITERS = 20
+PROBE_TIMEOUT_S = 25  # tiny dispatch: client init + one add; wedge hangs it
 TPU_TIMEOUT_S = 420   # first TPU compile is 20-40s; a wedged grant hangs
 CPU_TIMEOUT_S = 300
+CHIP_RESULTS = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)),
+    "benchmarks", "chip_results.jsonl")
 
 
 # --------------------------------------------------------------------------
@@ -198,11 +203,91 @@ def _attempt(platform: str, timeout_s: int):
     return None, f"{platform}: produced no JSON line"
 
 
+def _probe_tpu() -> "str | None":
+    """Cheap liveness probe; returns None if healthy, else the reason.
+
+    A wedged tunnel grant hangs (never errors), so before committing the
+    full TPU_TIMEOUT_S budget we spend at most PROBE_TIMEOUT_S on a
+    one-element dispatch in a throwaway subprocess (TERM-first kill, same
+    rationale as _attempt — a SIGKILLed PJRT client wedges the lease).
+    """
+    # the tunnelled grant reports platform 'axon' (the proxy plugin) or
+    # 'tpu' depending on the layer answering — accept both, like
+    # run_chip_suite.sh's probe
+    code = ("import jax; d = jax.devices(); "
+            "import jax.numpy as jnp; "
+            "print((jnp.ones(()) + 1).item(), d[0].platform)")
+    proc = subprocess.Popen(
+        [sys.executable, "-c", code],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        start_new_session=True)
+    import signal
+    try:
+        stdout, _ = proc.communicate(timeout=PROBE_TIMEOUT_S)
+    except subprocess.TimeoutExpired:
+        for sig, grace in ((signal.SIGTERM, 10), (signal.SIGKILL, 5)):
+            try:
+                os.killpg(proc.pid, sig)
+            except (ProcessLookupError, PermissionError):
+                pass
+            try:
+                proc.communicate(timeout=grace)
+                break
+            except subprocess.TimeoutExpired:
+                continue
+        return f"probe timed out after {PROBE_TIMEOUT_S}s (grant wedged?)"
+    if proc.returncode != 0:
+        return f"probe rc={proc.returncode}"
+    if not any(p in (stdout or "") for p in ("tpu", "axon")):
+        return f"probe saw no tpu device ({(stdout or '').strip()[:80]})"
+    return None
+
+
+def _last_tpu_evidence() -> "dict | None":
+    """Freshest chip-certified headline from benchmarks/chip_results.jsonl.
+
+    When the grant is down at driver time, the round's real chip state
+    lives in the suite log written while a grant was live; surface it in
+    the one JSON line instead of silently under-reporting (round-3 weak
+    #1). Capture time = the log's mtime (records carry ``captured_at``
+    only from round 4 on).
+    """
+    try:
+        best = None
+        with open(CHIP_RESULTS) as f:
+            for line in f:
+                try:
+                    r = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if (r.get("platform") in ("tpu", "axon")
+                        and "error" not in r
+                        and r.get("metric") == "map_blocks_add_const_1M_rows"):
+                    best = r  # later lines are fresher appends
+        if best is None:
+            return None
+        out = {k: best[k] for k in
+               ("metric", "value", "unit", "vs_baseline", "n_chips")
+               if k in best}
+        out["captured_at"] = best.get("captured_at") or time.strftime(
+            "%Y-%m-%dT%H:%M:%SZ",
+            time.gmtime(os.path.getmtime(CHIP_RESULTS)))
+        return out
+    except OSError:
+        return None
+
+
 def main() -> int:
     errors = []
-    rec, err = _attempt("tpu", TPU_TIMEOUT_S)
+    probe_fail = _probe_tpu()
+    if probe_fail is None:
+        rec, err = _attempt("tpu", TPU_TIMEOUT_S)
+        if rec is None:
+            errors.append(err)
+    else:
+        rec = None
+        errors.append(f"tpu skipped: {probe_fail}")
     if rec is None:
-        errors.append(err)
         rec, err = _attempt("cpu", CPU_TIMEOUT_S)
         if rec is not None:
             rec["error"] = f"tpu attempt failed, cpu fallback ({errors[0]})"
@@ -216,6 +301,10 @@ def main() -> int:
             "platform": "none",
             "error": "; ".join(errors),
         }
+    if rec.get("platform") != "tpu":
+        last = _last_tpu_evidence()
+        if last is not None:
+            rec["last_tpu"] = last
     print(json.dumps(rec))
     return 0
 

@@ -21,13 +21,31 @@ fi
 # checkout doesn't burn its one grant on a "libtfrpjrt.so missing" step
 make -C native -j4 >/dev/null 2>&1 || true
 
+stamp() {  # annotate each JSON line with capture time (bench.py last_tpu reads it)
+  python -c '
+import sys, json, time
+for line in sys.stdin:
+    s = line.strip()
+    if not s:
+        continue
+    try:
+        r = json.loads(s)
+        if isinstance(r, dict):
+            r.setdefault("captured_at",
+                         time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()))
+        print(json.dumps(r), flush=True)
+    except ValueError:
+        print(s, flush=True)
+'
+}
+
 run() {  # run <label> <timeout_s> <cmd...>
   local label=$1 t=$2; shift 2
   echo "== $label =="
   # SIGTERM first and only escalate to SIGKILL after a 20s grace: a
   # KILLed PJRT client leaves the server-side session lease held and the
   # relay wedges for the rest of the round (observed r2 and r3)
-  timeout -k 20 "$t" "$@" 2>>"$OUT.err" | tee -a "$OUT" || \
+  timeout -k 20 "$t" "$@" 2>>"$OUT.err" | stamp | tee -a "$OUT" || \
     echo "{\"step\": \"$label\", \"error\": \"rc=$? (timeout or failure)\"}" | tee -a "$OUT"
 }
 
