@@ -508,13 +508,22 @@ class TensorFrame:
             merged = Block.concat(self.blocks(), self._schema)
             n = merged.num_rows
             # np.lexsort: LAST key is primary; stable. Descending negates
-            # each key's dense rank (works for strings too) instead of
-            # reversing the result, which would un-stabilize ties.
+            # the key instead of reversing the result, which would
+            # un-stabilize ties. Float keys negate the values directly so
+            # NaN stays LAST (np.lexsort sinks NaN; dsort's descending
+            # negation behaves the same) — rank-negation via np.unique
+            # would rank NaN highest and float NaN rows would surface
+            # first, diverging from the mesh sort. Non-float keys
+            # (strings, ints) negate the dense rank, which is
+            # overflow-safe and works for objects.
             keys = []
             for c in reversed(cols):
                 k = np.asarray(merged.columns[c])
                 if descending:
-                    k = -np.unique(k, return_inverse=True)[1]
+                    if k.dtype.kind == "f":
+                        k = -k
+                    else:
+                        k = -np.unique(k, return_inverse=True)[1]
                 keys.append(k)
             order = np.lexsort(keys)
             out_cols: Dict[str, Column] = {}

@@ -194,6 +194,18 @@ class TestOrderBy:
         rows = df.order_by("x").collect()
         np.testing.assert_array_equal(rows[0]["v"], [1.0, 1.0])
 
+    def test_descending_float_nan_stays_last(self):
+        # NaN placement must agree with the mesh dsort: descending on a
+        # float key sinks NaN rows to the END (value negation), not the
+        # front (which rank-negation via np.unique would produce)
+        import tensorframes_tpu as tft
+
+        x = np.array([3.0, np.nan, 1.0, 2.0])
+        df = tft.frame({"x": x})
+        got = [r["x"] for r in df.order_by("x", descending=True).collect()]
+        assert got[:3] == [3.0, 2.0, 1.0]
+        assert np.isnan(got[3])
+
     def test_validation(self):
         import tensorframes_tpu as tft
 
