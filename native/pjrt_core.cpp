@@ -205,6 +205,14 @@ struct ClientIface {
   // dispatch of the reference's executor fleet, in-process).
   virtual ExeIface* compile_n(std::string_view module, int n_replicas,
                               std::string* err) = 0;
+  // GSPMD-partitioned: ONE logical program over n_partitions devices
+  // (num_replicas=1, use_spmd_partitioning on); the module carries
+  // mhlo.sharding annotations from a jax mesh lowering and XLA's SPMD
+  // partitioner emits the per-device program + collectives. This is the
+  // mesh layer's executor: the distributed half of the framework running
+  // in C++, not just the per-partition half.
+  virtual ExeIface* compile_spmd(std::string_view module, int n_partitions,
+                                 std::string* err) = 0;
   // data: n_replicas * nargs host pointers, replica-major; every replica
   // shares the same shapes. Results are replica-major too
   // (n_replicas * n_outputs entries).
@@ -379,10 +387,14 @@ struct CppClient : ClientIface {
   }
 
   ExeIface* compile_xla(xla::XlaComputation xc, std::string* err,
-                        int n_replicas = 1) {
+                        int n_replicas = 1, int n_partitions = 1) {
     xla::CompileOptions opts;
     if (n_replicas > 1) {
       opts.executable_build_options.set_num_replicas(n_replicas);
+    }
+    if (n_partitions > 1) {
+      opts.executable_build_options.set_num_partitions(n_partitions);
+      opts.executable_build_options.set_use_spmd_partitioning(true);
     }
     auto exe_or = client->CompileAndLoad(xc, opts);
     if (!exe_or.ok()) { *err = exe_or.status().ToString(); return nullptr; }
@@ -405,33 +417,43 @@ struct CppClient : ClientIface {
     return compile_xla(std::move(xc), err, n_replicas);
   }
 
+  ExeIface* compile_spmd(std::string_view module, int n_partitions,
+                         std::string* err) override {
+    if (n_partitions < 1 || n_partitions > device_count()) {
+      *err = "n_partitions " + std::to_string(n_partitions) +
+             " out of range (1.." + std::to_string(device_count()) + ")";
+      return nullptr;
+    }
+    xla::XlaComputation xc;
+    auto st = xla::ParseMlirModuleStringAndConvertToXlaComputation(
+        module, xc, /*use_tuple_args=*/false, /*return_tuple=*/false);
+    if (!st.ok()) { *err = st.ToString(); return nullptr; }
+    return compile_xla(std::move(xc), err, /*n_replicas=*/1, n_partitions);
+  }
+
   ResultsIface* execute_replicated(ExeIface* exe_i, int n_replicas,
                                    int nargs, const int* dtypes,
                                    const int* ndims, const long long* dims,
                                    const void* const* data,
                                    std::string* err) override {
     auto* exe = static_cast<CppExe*>(exe_i);
-    auto da = exe->exe->device_assignment();
-    if (n_replicas < 1 || n_replicas > da.replica_count()) {
-      *err = "n_replicas " + std::to_string(n_replicas) +
-             " does not match the executable's replica count " +
-             std::to_string(da.replica_count());
+    // the executable's own devices, in execution order — covers both
+    // replicated (n replicas x 1 partition) and GSPMD-partitioned
+    // (1 replica x n partitions) executables; Execute's argument lists
+    // are positional over this same sequence
+    auto exe_devices = exe->exe->addressable_devices();
+    if (n_replicas < 1 ||
+        n_replicas != static_cast<int>(exe_devices.size())) {
+      *err = "n devices " + std::to_string(n_replicas) +
+             " does not match the executable's device count " +
+             std::to_string(exe_devices.size());
       return nullptr;
     }
     std::vector<std::vector<std::unique_ptr<xla::PjRtBuffer>>> in_bufs(
         n_replicas);
     std::vector<std::vector<xla::PjRtBuffer*>> arg_lists(n_replicas);
     for (int r = 0; r < n_replicas; ++r) {
-      int dev_id = da(r, 0);
-      xla::PjRtDevice* device = nullptr;
-      for (auto* d : client->addressable_devices()) {
-        if (d->id() == dev_id) { device = d; break; }
-      }
-      if (!device) {
-        *err = "replica " + std::to_string(r) + ": device " +
-               std::to_string(dev_id) + " not addressable";
-        return nullptr;
-      }
+      xla::PjRtDevice* device = exe_devices[r];
       auto ms_or = device->default_memory_space();
       if (!ms_or.ok()) { *err = ms_or.status().ToString(); return nullptr; }
       const long long* d = dims;
@@ -570,6 +592,21 @@ const char kCompileOptionsProto[] = {0x1a, 0x04, 0x20, 0x01, 0x28, 0x01};
 std::string compile_options_proto(int n_replicas) {
   std::string p(kCompileOptionsProto, sizeof(kCompileOptionsProto));
   p[3] = static_cast<char>(n_replicas);
+  return p;
+}
+
+// executable_build_options { num_replicas (4) = 1; num_partitions (5) = n;
+// use_spmd_partitioning (6) = true } — the GSPMD compile request
+// (n < 128 keeps every varint single-byte).
+std::string compile_options_proto_spmd(int n_partitions) {
+  std::string ebo;
+  ebo += '\x20'; ebo += '\x01';                           // num_replicas=1
+  ebo += '\x28'; ebo += static_cast<char>(n_partitions);  // num_partitions
+  ebo += '\x30'; ebo += '\x01';                           // use_spmd=true
+  std::string p;
+  p += '\x1a';                                            // field 3, LEN
+  p += static_cast<char>(ebo.size());
+  p += ebo;
   return p;
 }
 
@@ -741,7 +778,8 @@ struct CApiClient : ClientIface {
   }
 
   ExeIface* compile_fmt(std::string_view module, const char* format,
-                        std::string* err, int n_replicas = 1) {
+                        std::string* err, int n_replicas = 1,
+                        int n_partitions = 1) {
     PJRT_Program prog;
     std::memset(&prog, 0, sizeof(prog));
     prog.struct_size = PJRT_Program_STRUCT_SIZE;
@@ -750,7 +788,9 @@ struct CApiClient : ClientIface {
     prog.format = format;
     prog.format_size = std::strlen(format);
 
-    std::string opts = compile_options_proto(n_replicas);
+    std::string opts = n_partitions > 1
+        ? compile_options_proto_spmd(n_partitions)
+        : compile_options_proto(n_replicas);
     PJRT_Client_Compile_Args ca;
     std::memset(&ca, 0, sizeof(ca));
     ca.struct_size = PJRT_Client_Compile_Args_STRUCT_SIZE;
@@ -777,6 +817,17 @@ struct CApiClient : ClientIface {
       return nullptr;
     }
     return compile_fmt(module, "mlir", err, n_replicas);
+  }
+
+  ExeIface* compile_spmd(std::string_view module, int n_partitions,
+                         std::string* err) override {
+    if (n_partitions < 1 || n_partitions > 127 ||
+        n_partitions > device_count()) {
+      *err = "n_partitions " + std::to_string(n_partitions) +
+             " out of range (1.." + std::to_string(device_count()) + ")";
+      return nullptr;
+    }
+    return compile_fmt(module, "mlir", err, /*n_replicas=*/1, n_partitions);
   }
 
   ResultsIface* execute_replicated(ExeIface* exe_i, int n_replicas,
@@ -1177,6 +1228,23 @@ tfr_pjrt_exe* tfr_pjrt_compile_n(tfr_pjrt_client* c,
   ExeIface* e = c->impl->compile_n(
       std::string_view(module_bytes, static_cast<size_t>(module_len)),
       n_replicas, &errmsg);
+  if (!e) {
+    set_err(err, errlen, errmsg);
+    return nullptr;
+  }
+  auto* out = new tfr_pjrt_exe();
+  out->impl.reset(e);
+  return out;
+}
+
+tfr_pjrt_exe* tfr_pjrt_compile_spmd(tfr_pjrt_client* c,
+                                    const char* module_bytes,
+                                    long module_len, int n_partitions,
+                                    char* err, int errlen) {
+  std::string errmsg;
+  ExeIface* e = c->impl->compile_spmd(
+      std::string_view(module_bytes, static_cast<size_t>(module_len)),
+      n_partitions, &errmsg);
   if (!e) {
     set_err(err, errlen, errmsg);
     return nullptr;

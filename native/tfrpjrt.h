@@ -86,6 +86,21 @@ tfr_pjrt_exe* tfr_pjrt_compile_n(tfr_pjrt_client* c,
                                  const char* module_bytes, long module_len,
                                  int n_replicas, char* err, int errlen);
 
+/* GSPMD-partitioned compile: num_replicas = 1, num_partitions =
+ * n_partitions, SPMD partitioning ON. The module is a jax mesh lowering
+ * (GSPMD flavor): GLOBAL-shaped parameters/results annotated with
+ * mhlo.sharding attributes; XLA's SPMD partitioner splits it into the
+ * per-device program, inserting the ICI/host collectives the shardings
+ * imply. Execute with tfr_pjrt_execute_replicated, n = n_partitions; each
+ * device's argument is its SHARD of the global array (dims describe the
+ * shard — all shards equal-shaped, row-axis padding is the caller's job),
+ * and results come back device-major as shards (replicated outputs: one
+ * full copy per device). */
+tfr_pjrt_exe* tfr_pjrt_compile_spmd(tfr_pjrt_client* c,
+                                    const char* module_bytes,
+                                    long module_len, int n_partitions,
+                                    char* err, int errlen);
+
 /* Execute a replicated executable across its devices in ONE call.
  * data holds n_replicas * nargs host pointers, replica-major; every
  * replica shares the same shapes (dtypes/ndims/dims as in

@@ -106,6 +106,10 @@ def _load() -> Optional[ctypes.CDLL]:
     lib.tfr_pjrt_compile_n.argtypes = [vp, ctypes.c_char_p, ctypes.c_long,
                                        ci, ctypes.c_char_p, ci]
     lib.tfr_pjrt_compile_n.restype = vp
+    lib.tfr_pjrt_compile_spmd.argtypes = [vp, ctypes.c_char_p,
+                                          ctypes.c_long, ci,
+                                          ctypes.c_char_p, ci]
+    lib.tfr_pjrt_compile_spmd.restype = vp
     lib.tfr_pjrt_execute_replicated.argtypes = [
         vp, vp, ci, ci, ctypes.POINTER(ci), ctypes.POINTER(ci),
         ctypes.POINTER(cll), ctypes.POINTER(vp), ctypes.c_char_p, ci]
@@ -330,6 +334,25 @@ class PjrtCoreClient:
                 f"replicated compile failed: "
                 f"{err.value.decode(errors='replace')}")
         return PjrtReplicatedExecutable(self, h, n_replicas)
+
+    def compile_spmd(self, stablehlo: bytes,
+                     n_partitions: int) -> "PjrtReplicatedExecutable":
+        """GSPMD-partitioned compile: ONE logical program spanning
+        ``n_partitions`` devices. ``stablehlo`` is a jax mesh lowering
+        (GSPMD flavor, ``mhlo.sharding``-annotated global shapes); XLA's
+        SPMD partitioner inside the native core derives the per-device
+        program and its collectives. Execute with per-device SHARDS
+        (device-major, equal shapes); sharded outputs come back as
+        per-device shards, replicated outputs as one copy per device."""
+        err = ctypes.create_string_buffer(_ERRLEN)
+        h = self._lib.tfr_pjrt_compile_spmd(self._client, stablehlo,
+                                            len(stablehlo), n_partitions,
+                                            err, _ERRLEN)
+        if not h:
+            raise PjrtCoreError(
+                f"spmd compile failed: "
+                f"{err.value.decode(errors='replace')}")
+        return PjrtReplicatedExecutable(self, h, n_partitions)
 
     def close(self):
         if self._client:

@@ -84,8 +84,11 @@ class DistributedFrame:
         # group-id factorizations memoized per key tuple: frames are
         # immutable (every op returns a new frame), so repeated
         # aggregations over the same keys skip the host transfer +
-        # lexsort (host path) / sort-unique program (device path)
-        self._group_ids_cache: Dict[tuple, tuple] = {}
+        # lexsort (host path) / sort-unique program (device path).
+        # LRU-capped: entries hold device arrays sized like the frame, so
+        # a long-lived frame swept over many key tuples / caps must not
+        # retain HBM indefinitely (same policy as _dsort_cache).
+        self._group_ids_cache: "OrderedDict[tuple, tuple]" = OrderedDict()
 
     @property
     def padded_rows(self) -> int:
@@ -98,6 +101,20 @@ class DistributedFrame:
         if self.shard_valid is not None:
             return np.asarray(self.shard_valid, np.int64)
         rows_per = self.padded_rows // S
+        if rows_per * S != self.padded_rows:
+            # a global (row_aligned=False) result need not tile the data
+            # axis (e.g. ONE summary row on an 8-shard mesh); such frames
+            # carry no pad rows, and XLA lays the array out in ceil-div
+            # chunks
+            if self.num_rows != self.padded_rows:
+                raise ValueError(
+                    f"frame rows ({self.padded_rows}) do not tile the "
+                    f"{S}-shard data axis yet only {self.num_rows} are "
+                    f"valid — pad to a multiple of the shard count")
+            chunk = -(-self.padded_rows // S)
+            starts = np.minimum(np.arange(S) * chunk, self.padded_rows)
+            ends = np.minimum(starts + chunk, self.padded_rows)
+            return (ends - starts).astype(np.int64)
         out = np.full(S, rows_per, np.int64)
         full, tail = divmod(self.num_rows, rows_per)
         out[full:] = 0
@@ -109,6 +126,9 @@ class DistributedFrame:
         """Host bool mask [padded_rows]: True where the row is real."""
         S = self.mesh.num_data_shards
         rows_per = self.padded_rows // S
+        if rows_per * S != self.padded_rows:
+            self.per_shard_valid()  # validates num_rows == padded_rows
+            return np.ones(self.padded_rows, bool)
         idx = np.arange(self.padded_rows) % rows_per
         return idx < np.repeat(self.per_shard_valid(), rows_per)
 
@@ -296,6 +316,25 @@ def dmap_blocks(fetches, dist: DistributedFrame, trim: bool = False,
     out_schema = _ops._validate_map(comp, schema, block_level=True, trim=trim)
     mesh = dist.mesh
 
+    # TFT_EXECUTOR=pjrt: row-aligned maps run as ONE GSPMD-partitioned
+    # executable inside the native C++ core (trim/global programs and
+    # unsupported dtypes fall back to the jax dispatch below)
+    nm = _native_mesh(mesh) if not trim else None
+    if nm is not None:
+        try:
+            outs_np = nm.dmap(comp, dist)
+        except Exception as e:
+            _native_mesh_fallback(e)
+            outs_np = None
+        if outs_np is not None:
+            cols = dict(dist.columns)
+            for spec in comp.outputs:
+                a = outs_np[spec.name]
+                cols[spec.name] = jax.device_put(
+                    a, mesh.row_sharding(a.ndim))
+            return DistributedFrame(mesh, out_schema, cols, dist.num_rows,
+                                    shard_valid=dist.shard_valid)
+
     jitted = _jitted(comp)
     with span("dmap_blocks.dispatch"):
         out = jitted({n: dist.columns[n] for n in comp.input_names})
@@ -346,6 +385,15 @@ def dfilter(predicate, dist: DistributedFrame) -> DistributedFrame:
     """
     schema = dist.schema
     comp = _ops._filter_computation(predicate, schema)
+    bad = [n for n in comp.input_names
+           if (f := schema.get(n)) is not None and not f.dtype.tensor]
+    if bad:
+        raise _ops.InvalidTypeError(
+            f"dfilter predicate reads host-side (non-tensor) column(s) "
+            f"{bad}: string columns ride along on the mesh but cannot "
+            f"enter the sharded program. Filter on the host instead "
+            f"(tensorframes_tpu.filter_rows / TensorFrame.filter) before "
+            f"distribute().")
     pname = comp.output_names[0]
     mesh = dist.mesh
     axis = mesh.data_axis
@@ -537,6 +585,52 @@ from collections import OrderedDict
 _collective_cache: "OrderedDict[tuple, object]" = OrderedDict()
 _COLLECTIVE_CACHE_CAP = 64
 
+_native_mesh_warned = False
+
+
+def _native_mesh(mesh: DeviceMesh):
+    """The native GSPMD mesh executor when ``TFT_EXECUTOR=pjrt`` routes
+    mesh ops through the C++ core, else ``None`` (the jax path)."""
+    import os
+
+    if os.environ.get("TFT_EXECUTOR") != "pjrt":
+        return None
+    from . import native_mesh
+
+    return native_mesh.executor_for(mesh)
+
+
+def _native_mesh_fallback(e: Exception):
+    global _native_mesh_warned
+    if not _native_mesh_warned:
+        from ..utils.logging import get_logger
+
+        get_logger("native_mesh").warning(
+            "native mesh dispatch failed (%s); falling back to the jax "
+            "path for this and subsequent calls that hit the same error",
+            e)
+        _native_mesh_warned = True
+
+
+def _collective_shard_fn(names, combs, axis):
+    """The per-shard masked-reduce + collective program — ONE source of
+    truth shared by the jax ``shard_map`` path and the native GSPMD path."""
+
+    def shard_fn(nv, *shards):
+        outs = []
+        rows = shards[0].shape[0]
+        valid = jnp.arange(rows) < nv[0]
+        for name, s in zip(names, shards):
+            c = combs[name]
+            mask = valid.reshape((rows,) + (1,) * (s.ndim - 1))
+            neutral = jnp.asarray(c.neutral(s.dtype))
+            masked = jnp.where(mask, s, neutral)
+            local = c.local(masked, 0)
+            outs.append(c.collective(local, axis))
+        return tuple(outs)
+
+    return shard_fn
+
 
 def _collective_reduce(col_combiners: Mapping[str, str],
                        dist: DistributedFrame) -> Dict[str, np.ndarray]:
@@ -558,40 +652,39 @@ def _collective_reduce(col_combiners: Mapping[str, str],
     key = (mesh.mesh, axis,
            tuple((n, col_combiners[n], a.shape, str(a.dtype))
                  for n, a in zip(names, arrays)))
-    fn = _collective_cache.get(key)
-    if fn is not None:
-        _collective_cache.move_to_end(key)
-    else:
-        # per-shard valid-row counts ride in sharded over the axis: pads are
-        # masked wherever they fall (a multi-host frame pads per process,
-        # not in a global suffix)
-        in_specs = (P(axis),) + tuple(
-            P(axis, *([None] * (a.ndim - 1))) for a in arrays)
-        out_specs = tuple(P() for _ in arrays)
+    # per-shard valid-row counts ride in sharded over the axis: pads are
+    # masked wherever they fall (a multi-host frame pads per process,
+    # not in a global suffix)
+    in_specs = (P(axis),) + tuple(
+        P(axis, *([None] * (a.ndim - 1))) for a in arrays)
 
-        def shard_fn(nv, *shards):
-            outs = []
-            rows = shards[0].shape[0]
-            valid = jnp.arange(rows) < nv[0]
-            for name, s in zip(names, shards):
-                c = combs[name]
-                mask = valid.reshape((rows,) + (1,) * (s.ndim - 1))
-                neutral = jnp.asarray(c.neutral(s.dtype))
-                masked = jnp.where(mask, s, neutral)
-                local = c.local(masked, 0)
-                outs.append(c.collective(local, axis))
-            return tuple(outs)
-
-        fn = jax.jit(shard_map(shard_fn, mesh=mesh.mesh,
-                               in_specs=in_specs, out_specs=out_specs))
-        _collective_cache[key] = fn
-        while len(_collective_cache) > _COLLECTIVE_CACHE_CAP:
-            _collective_cache.popitem(last=False)
-    nv_dev = jax.make_array_from_callback(
-        (mesh.num_data_shards,), mesh.row_sharding(1),
-        lambda idx: dist.per_shard_valid().astype(np.int32)[idx])
-    with span("dreduce_blocks.collective_dispatch"):
-        outs = fn(nv_dev, *arrays)
+    outs = None
+    nm = _native_mesh(mesh)
+    if nm is not None:
+        try:
+            outs = nm.dreduce_collective(
+                _collective_shard_fn(names, combs, axis), in_specs, names,
+                dist, dist.per_shard_valid(), key)
+        except Exception as e:
+            _native_mesh_fallback(e)
+            outs = None
+    if outs is None:
+        fn = _collective_cache.get(key)
+        if fn is not None:
+            _collective_cache.move_to_end(key)
+        else:
+            out_specs = tuple(P() for _ in arrays)
+            fn = jax.jit(shard_map(
+                _collective_shard_fn(names, combs, axis), mesh=mesh.mesh,
+                in_specs=in_specs, out_specs=out_specs))
+            _collective_cache[key] = fn
+            while len(_collective_cache) > _COLLECTIVE_CACHE_CAP:
+                _collective_cache.popitem(last=False)
+        nv_dev = jax.make_array_from_callback(
+            (mesh.num_data_shards,), mesh.row_sharding(1),
+            lambda idx: dist.per_shard_valid().astype(np.int32)[idx])
+        with span("dreduce_blocks.collective_dispatch"):
+            outs = fn(nv_dev, *arrays)
     result = {}
     for name, a in zip(names, outs):
         v = np.asarray(a)
@@ -611,19 +704,35 @@ def _cached_group_ids(dist: DistributedFrame, keys, max_groups):
     """
     if max_groups is not None:
         ckey = ("device", tuple(keys), max_groups)
-        hit = dist._group_ids_cache.get(ckey)
+        hit = _group_ids_cache_get(dist, ckey)
         if hit is None:
             hit = _device_key_ids(dist, keys, max_groups)
-            dist._group_ids_cache[ckey] = hit
+            _group_ids_cache_put(dist, ckey, hit)
         ids_dev, uniq_dev, count_dev, num_groups = hit
         return ids_dev, None, uniq_dev, count_dev, num_groups
     ckey = ("host", tuple(keys))
-    hit = dist._group_ids_cache.get(ckey)
+    hit = _group_ids_cache_get(dist, ckey)
     if hit is None:
         hit = _host_group_ids(dist, keys)
-        dist._group_ids_cache[ckey] = hit
+        _group_ids_cache_put(dist, ckey, hit)
     ids_dev, uniques, num_groups = hit
     return ids_dev, uniques, None, None, num_groups
+
+
+_GROUP_IDS_CACHE_CAP = 8
+
+
+def _group_ids_cache_get(dist: DistributedFrame, ckey: tuple):
+    hit = dist._group_ids_cache.get(ckey)
+    if hit is not None:
+        dist._group_ids_cache.move_to_end(ckey)
+    return hit
+
+
+def _group_ids_cache_put(dist: DistributedFrame, ckey: tuple, hit: tuple):
+    dist._group_ids_cache[ckey] = hit
+    while len(dist._group_ids_cache) > _GROUP_IDS_CACHE_CAP:
+        dist._group_ids_cache.popitem(last=False)
 
 
 def _host_group_ids(dist: DistributedFrame, keys):

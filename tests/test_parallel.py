@@ -473,6 +473,27 @@ class TestDFilter:
         out = par.dfilter(lambda x: x < 0.0, dist)
         assert out.count() == 0
 
+    def test_host_column_predicate_typed_error(self, mesh8):
+        # a predicate selecting a string (host-side) column must raise a
+        # typed error, not a bare KeyError from inside shard_map tracing
+        from tensorframes_tpu import dtypes as _dt
+        from tensorframes_tpu.computation import Computation, TensorSpec
+        from tensorframes_tpu.engine.ops import InvalidTypeError
+        from tensorframes_tpu.shape import Shape, Unknown
+
+        k = np.array(["a", "b"], object)
+        dist = par.distribute(tft.frame({"k": k, "x": np.arange(2.0)}),
+                              mesh8)
+        # lambda path: rejected at trace time by the computation builder
+        with pytest.raises(InvalidTypeError, match="non-tensor"):
+            par.dfilter(lambda k: (k != 0).astype(np.int32), dist)
+        # pre-built Computation path (trace bypassed): dfilter's own guard
+        comp = Computation.trace(
+            lambda k: {"keep": (k > 0).astype(np.int32)},
+            [TensorSpec("k", _dt.double, Shape(Unknown))])
+        with pytest.raises(InvalidTypeError, match="host-side"):
+            par.dfilter(comp, dist)
+
     def test_dfilter_reuses_compiled_program(self, mesh8):
         # the predicate's Computation (and so its shard_map jit cache)
         # must be reused across calls — a fresh trace per call would pay
@@ -644,6 +665,23 @@ def test_distributed_frame_explain(mesh8):
     assert "PartitionSpec('data'" in out
     flt = par.dfilter(lambda x: x >= 0.0, dist)
     assert "per-shard" in flt.explain()
+
+
+def test_group_ids_cache_lru_capped(mesh8):
+    # the per-frame factorization memo holds device arrays sized like the
+    # frame; sweeping one long-lived frame over many max_groups caps must
+    # not retain them all (ADVICE r3: cap it like _dsort_cache)
+    from tensorframes_tpu.parallel.distributed import (
+        _GROUP_IDS_CACHE_CAP, _cached_group_ids)
+
+    k = np.arange(64, dtype=np.int32) % 4
+    dist = par.distribute(tft.frame({"k": k, "x": np.ones(64)}), mesh8)
+    for cap in range(4, 4 + _GROUP_IDS_CACHE_CAP + 4):
+        _cached_group_ids(dist, ["k"], cap)
+    assert len(dist._group_ids_cache) == _GROUP_IDS_CACHE_CAP
+    # freshest entry survives and is reused (LRU, not clear-all)
+    newest = ("device", ("k",), 4 + _GROUP_IDS_CACHE_CAP + 3)
+    assert newest in dist._group_ids_cache
 
 
 class TestDeviceKeysMultiKey:
