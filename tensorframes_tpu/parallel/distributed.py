@@ -460,15 +460,27 @@ def dsort(keys, dist: DistributedFrame, descending: bool = False
           ) -> DistributedFrame:
     """Rows globally sorted by scalar key column(s), on the mesh.
 
-    One compiled program: pad/invalid rows get a sentinel key so they sink
-    to the end, a stable ``argsort`` chain (last key first) computes the
-    global order, and every column gathers through it. XLA/GSPMD
-    partitions the sort itself (on today's compilers that means gathering
-    the key column — sorting is not shardable for free; the VALUE columns
-    still move only once, through the final sharded gather). The result
-    has prefix validity: pad rows are all at the tail, whatever the input
-    layout (so ``dsort`` also normalizes a ``dfilter``/multi-host mask
-    layout back to prefix semantics).
+    Multi-shard frames sort by **columnsort** (Leighton's 8-step
+    sorting-network generalization): four LOCAL per-shard sorts
+    interleaved with three static exchanges (two ``all_to_all`` reshuffles
+    and a half-block ``ppermute`` shift). Every step has static shapes
+    and per-device O(m log m) work — no shard ever sorts (or even holds)
+    the global array, unlike a GSPMD-partitioned global ``argsort``,
+    which gathers the key column to every device and replicates the full
+    n·log n sort. Stability and pad handling ride in the sort key itself:
+    a validity flag is the most significant key (frame pads and the
+    internal columnsort padding sink to the global tail), the original
+    row id is the least significant (stable ties), and each user key is
+    order-transformed for ``descending`` (float negation; bitwise-not
+    for ints, which never overflows). Correctness needs
+    rows-per-shard ≥ 2(S-1)² and divisibility by 2S, achieved by padding
+    inside the program, with the final global slice restoring the frame's
+    layout. Single-shard meshes and frames whose rows do not tile the
+    data axis use a plain local-sort program instead.
+
+    The result has prefix validity: pad rows are all at the tail,
+    whatever the input layout (so ``dsort`` also normalizes a
+    ``dfilter``/multi-host mask layout back to prefix semantics).
 
     Keys must be device (numeric) columns; sort by a string key on the
     host via ``TensorFrame.order_by`` instead. Host-side string
@@ -490,6 +502,7 @@ def dsort(keys, dist: DistributedFrame, descending: bool = False
             raise _ops.InvalidShapeError(
                 f"dsort key {k!r} must be a scalar column")
     mesh = dist.mesh
+    S = mesh.num_data_shards
     tensor_names = [f.name for f in schema if f.dtype.tensor]
     host_names = [f.name for f in schema if not f.dtype.tensor]
     arrays = [dist.columns[n] for n in tensor_names]
@@ -500,7 +513,38 @@ def dsort(keys, dist: DistributedFrame, descending: bool = False
         lambda idx: valid_host[idx])
 
     want_order = bool(host_names)
-    ckey = (mesh.mesh, tuple(keys), descending, want_order,
+    if S > 1 and dist.padded_rows % S == 0:
+        outs = _dsort_columnsort(dist, keys, descending, tensor_names,
+                                 arrays, valid_dev, want_order)
+    else:
+        outs = _dsort_local(dist, keys, descending, tensor_names, arrays,
+                            valid_dev, want_order)
+    new_cols: Dict[str, jax.Array] = dict(zip(tensor_names, outs))
+    if want_order:
+        order_host = _read_global(outs[len(tensor_names)])
+        for n in host_names:
+            new_cols[n] = dist.columns[n][order_host]
+    return DistributedFrame(mesh, schema, new_cols, dist.num_rows)
+
+
+def _key_transform(kv, descending: bool):
+    """Order-reversing transforms with no overflow for descending: float
+    negation, and bitwise-not for ints (~k = -k-1 is strictly decreasing
+    for signed AND unsigned — raw negation wraps uint 0 onto itself and
+    overflows iinfo.min)."""
+    if not descending:
+        return kv
+    return -kv if jnp.issubdtype(kv.dtype, jnp.floating) else ~kv
+
+
+def _dsort_local(dist, keys, descending, tensor_names, arrays, valid_dev,
+                 want_order):
+    """Fallback sort program (single-shard meshes / non-tiling frames):
+    one jit, global stable argsort chain; on a multi-shard mesh GSPMD
+    would gather the key column, which is why multi-shard frames take
+    :func:`_dsort_columnsort` instead."""
+    mesh = dist.mesh
+    ckey = ("local", mesh.mesh, tuple(keys), descending, want_order,
             tuple((n, a.shape, str(a.dtype))
                   for n, a in zip(tensor_names, arrays)))
     fn = _dsort_cache.get(ckey)
@@ -510,15 +554,7 @@ def dsort(keys, dist: DistributedFrame, descending: bool = False
             order = None
             # stable argsort chain, LAST key first -> first key primary
             for k in reversed(keys):
-                kv = named[k]
-                if descending:
-                    # order-reversing transforms with no overflow: float
-                    # negation, and bitwise-not for ints (~k = -k-1 is
-                    # strictly decreasing for signed AND unsigned — raw
-                    # negation wraps uint 0 onto itself and overflows
-                    # iinfo.min)
-                    kv = (-kv if jnp.issubdtype(kv.dtype, jnp.floating)
-                          else ~kv)
+                kv = _key_transform(named[k], descending)
                 if order is not None:
                     kv = jnp.take(kv, order, axis=0)
                     step = jnp.argsort(kv, stable=True)
@@ -547,13 +583,163 @@ def dsort(keys, dist: DistributedFrame, descending: bool = False
         _dsort_cache.move_to_end(ckey)
 
     with span("dsort.dispatch"):
-        outs = fn(valid_dev, *arrays)
-    new_cols: Dict[str, jax.Array] = dict(zip(tensor_names, outs))
-    if want_order:
-        order_host = _read_global(outs[len(tensor_names)])
-        for n in host_names:
-            new_cols[n] = dist.columns[n][order_host]
-    return DistributedFrame(mesh, schema, new_cols, dist.num_rows)
+        return fn(valid_dev, *arrays)
+
+
+def _dsort_columnsort(dist, keys, descending, tensor_names, arrays,
+                      valid_dev, want_order):
+    """Columnsort over the data axis (see :func:`dsort` docstring).
+
+    Shards are the matrix "columns" (r rows each); the 8 steps:
+    1. sort columns; 2. deal rows round-robin across shards
+    (``all_to_all``); 3. sort; 4. inverse deal (contiguous chunks out,
+    interleave in); 5. sort; 6. shift half-blocks to the next shard
+    (``ppermute``); 7. sort the shifted column (the conceptual extra
+    column s is ``[last shard's bottom, +inf]``, already sorted — free);
+    8. unshift. Requires r ≥ 2(S-1)² and 2S | r, met by padding each
+    shard with flag-2 sentinel rows inside the program; the final global
+    slice drops them (they sort strictly after the frame's own pad
+    rows). A flag column (−5 min-sentinel < 0 real < 1 frame-pad <
+    2 internal-pad < 9 max-sentinel) is the most significant sort key
+    and the original global row id the least, so the whole pipeline is
+    stable and pad-safe; row ids double as the host-column permutation.
+    """
+    mesh = dist.mesh
+    axis = mesh.data_axis
+    S = mesh.num_data_shards
+    padded = dist.padded_rows
+    r = padded // S
+    # internal per-shard row count: multiple of 2S, >= 2(S-1)^2 (Leighton's
+    # validity condition), >= r
+    need = max(r, 2 * (S - 1) * (S - 1))
+    rp = ((need + 2 * S - 1) // (2 * S)) * (2 * S)
+    h = rp // 2
+    idx_dt = jnp.int32 if padded < 2 ** 31 else jnp.int64
+
+    ckey = ("columnsort", mesh.mesh, tuple(keys), descending, want_order,
+            rp, tuple((n, a.shape, str(a.dtype))
+                      for n, a in zip(tensor_names, arrays)))
+    fn = _dsort_cache.get(ckey)
+    if fn is None:
+        key_idx = [tensor_names.index(k) for k in keys]
+
+        def colsort(flag, rowid, cols):
+            """One column (shard-local) sort by (flag, keys..., rowid)."""
+            order = jnp.argsort(rowid, stable=True)
+            for ki in reversed(key_idx):
+                kv = jnp.take(_key_transform(cols[ki], descending), order,
+                              axis=0)
+                order = jnp.take(order, jnp.argsort(kv, stable=True),
+                                 axis=0)
+            fl = jnp.take(flag, order, axis=0)
+            order = jnp.take(order, jnp.argsort(fl, stable=True), axis=0)
+            return (jnp.take(flag, order, axis=0),
+                    jnp.take(rowid, order, axis=0),
+                    [jnp.take(c, order, axis=0) for c in cols])
+
+        def deal(a):
+            # step 2: row i -> shard i%S, landing at j*(rp/S) + i//S from
+            # source shard j (column-major read, row-major reshape)
+            a2 = a.reshape((rp // S, S) + a.shape[1:]).swapaxes(0, 1)
+            a2 = jax.lax.all_to_all(a2, axis, 0, 0, tiled=False)
+            return a2.reshape((rp,) + a.shape[1:])
+
+        def undeal(a):
+            # step 4: contiguous chunk c -> shard c, received rows
+            # interleave back (row-major read, column-major reshape)
+            a2 = a.reshape((S, rp // S) + a.shape[1:])
+            a2 = jax.lax.all_to_all(a2, axis, 0, 0, tiled=False)
+            return a2.swapaxes(0, 1).reshape((rp,) + a.shape[1:])
+
+        fwd = [(j, j + 1) for j in range(S - 1)]
+        bwd = [(j + 1, j) for j in range(S - 1)]
+
+        def shard_fn(valid, *cols):
+            me = jax.lax.axis_index(axis)
+            # flags: 0 real, 1 frame pad; internal pad rows (flag 2) are
+            # appended to reach rp
+            flag = jnp.where(valid, jnp.int8(0), jnp.int8(1))
+            # widen axis_index before the multiply: me*r in int32 wraps
+            # for frames at/above 2^31 padded rows (idx_dt is int64 then)
+            rowid = me.astype(idx_dt) * r + jnp.arange(r, dtype=idx_dt)
+            pad_n = rp - r
+            flag = jnp.concatenate([flag, jnp.full(pad_n, 2, jnp.int8)])
+            rowid = jnp.concatenate(
+                [rowid, jnp.zeros(pad_n, idx_dt)])
+            cs = [jnp.concatenate(
+                [c, jnp.zeros((pad_n,) + c.shape[1:], c.dtype)])
+                for c in cols]
+
+            flag, rowid, cs = colsort(flag, rowid, cs)          # 1
+            flag, rowid = deal(flag), deal(rowid)               # 2
+            cs = [deal(c) for c in cs]
+            flag, rowid, cs = colsort(flag, rowid, cs)          # 3
+            flag, rowid = undeal(flag), undeal(rowid)           # 4
+            cs = [undeal(c) for c in cs]
+            flag, rowid, cs = colsort(flag, rowid, cs)          # 5
+
+            # 6: shifted column = [prev shard's bottom | own top]. Shard 0
+            # receives no message and must see a MIN sentinel half: flags
+            # travel offset by +16, so ppermute's zero-fill decodes to -16
+            # (< every real flag) while real flags restore exactly. The
+            # sentinel rows sort to shard 0's B1 top, which step 8 never
+            # reads (only B1 bottoms and RIGHTWARD-shifted tops survive).
+            prev_flag = (jax.lax.ppermute(flag[h:] + jnp.int8(16), axis,
+                                          fwd) - jnp.int8(16))
+            b1_flag = jnp.concatenate([prev_flag, flag[:h]])
+            b1_rowid = jnp.concatenate(
+                [jax.lax.ppermute(rowid[h:], axis, fwd), rowid[:h]])
+            b1_cs = [jnp.concatenate(
+                [jax.lax.ppermute(c[h:], axis, fwd), c[:h]])
+                for c in cs]
+            b1_flag, b1_rowid, b1_cs = colsort(b1_flag, b1_rowid, b1_cs)  # 7
+            # the conceptual extra column S is [last shard's bottom | +inf
+            # sentinel] — both parts already sorted, so it needs no sort
+
+            # 8: unshift — own top = B1 bottom; own bottom = next shard's
+            # B1 top (last shard: the extra column's top = its own step-5
+            # bottom). ppermute zero-fill is overwritten by the where.
+            last = me == S - 1
+
+            def unshift(b1, own_step5):
+                nxt = jax.lax.ppermute(b1[:h], axis, bwd)
+                bottom = jnp.where(last, own_step5[h:], nxt)
+                return jnp.concatenate([b1[h:], bottom])
+
+            out_flag = unshift(b1_flag, flag)
+            out_rowid = unshift(b1_rowid, rowid)
+            out_cs = [unshift(b, c) for b, c in zip(b1_cs, cs)]
+            del out_flag  # flags exist only to steer the sort
+            return tuple(out_cs) + ((out_rowid,) if want_order else ())
+
+        in_specs = (P(axis),) + tuple(
+            P(axis, *([None] * (a.ndim - 1))) for a in arrays)
+        out_specs = tuple(
+            P(axis, *([None] * (a.ndim - 1))) for a in arrays)
+        if want_order:
+            out_specs = out_specs + (P(axis),)
+        prog = shard_map(shard_fn, mesh=mesh.mesh, in_specs=in_specs,
+                         out_specs=out_specs)
+
+        def full(valid, *cols):
+            outs = prog(valid, *cols)
+            # drop the internal padding: the global [S*rp] result is
+            # sorted with flag-2 rows strictly after the frame's own pad
+            # rows, so the first `padded` rows ARE the frame's layout
+            return tuple(o[:padded] for o in outs)
+
+        shardings = tuple(mesh.row_sharding(a.ndim) for a in arrays)
+        if want_order:
+            shardings = shardings + (mesh.row_sharding(1),)
+        fn = jax.jit(full, out_shardings=shardings)
+        _dsort_cache[ckey] = fn
+        while len(_dsort_cache) > _DSORT_CACHE_CAP:
+            _dsort_cache.popitem(last=False)
+    else:
+        _dsort_cache.move_to_end(ckey)
+
+    with span("dsort.columnsort_dispatch"):
+        return fn(valid_dev, *arrays)
 
 
 def dreduce_blocks(fetches, dist: DistributedFrame):

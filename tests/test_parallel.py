@@ -179,13 +179,16 @@ def test_daggregate_pad_rows_excluded(mesh8):
     assert len(rows) == 1 and rows[0]["x"] == 10.0
 
 
-def test_daggregate_validation(mesh8):
-    from tensorframes_tpu.engine.ops import InputNotFoundError
+def test_daggregate_unused_value_column_ignored(mesh8):
+    # ride-along tolerance (the reduce contract,
+    # BasicOperationsSuite.scala:178-187): `extra` drops out of the result
     df = tft.frame({"key": np.zeros(4, np.int64), "x": np.arange(4.0),
                     "extra": np.arange(4.0)})
     dist = par.distribute(df, mesh8)
-    with pytest.raises(InputNotFoundError, match="not consumed"):
-        par.daggregate({"x": "sum"}, dist, "key")
+    out = par.daggregate({"x": "sum"}, dist, "key")
+    rows = out.collect()
+    assert len(rows) == 1 and rows[0]["x"] == pytest.approx(6.0)
+    assert "extra" not in [n for n in out.schema.names]
 
 
 def test_daggregate_generic_computation_matches_host(mesh8):
@@ -665,6 +668,73 @@ def test_distributed_frame_explain(mesh8):
     assert "PartitionSpec('data'" in out
     flt = par.dfilter(lambda x: x >= 0.0, dist)
     assert "per-shard" in flt.explain()
+
+
+class TestColumnsort:
+    """Stress the multi-shard columnsort path specifically (8 shards:
+    every run exercises deal/undeal all_to_alls, the half-block shift,
+    and the internal sentinel padding, since 2(S-1)^2 = 98 > most test
+    frames' rows-per-shard)."""
+
+    def test_randomized_against_numpy(self, mesh8):
+        rng = np.random.default_rng(1234)
+        for n in (16, 97, 800, 4096):
+            x = rng.normal(size=n)
+            dist = par.distribute(tft.frame({"x": x}), mesh8)
+            rows = par.dsort("x", dist).collect_frame().collect()
+            np.testing.assert_allclose(
+                [r["x"] for r in rows], np.sort(x), rtol=0)
+
+    def test_randomized_multikey_stability(self, mesh8):
+        rng = np.random.default_rng(5)
+        n = 1000
+        k1 = rng.integers(0, 7, n)
+        k2 = rng.integers(0, 5, n).astype(np.float64)
+        tag = np.arange(n, dtype=np.float64)  # original position
+        dist = par.distribute(
+            tft.frame({"k1": k1, "k2": k2, "tag": tag}), mesh8)
+        rows = par.dsort(["k1", "k2"], dist).collect_frame().collect()
+        got = [(r["k1"], r["k2"], r["tag"]) for r in rows]
+        order = np.lexsort((tag, k2, k1))  # lexsort: last key primary
+        want = [(k1[i], k2[i], tag[i]) for i in order]
+        assert got == want  # exact, including stable tie order
+
+    def test_randomized_descending_ints(self, mesh8):
+        rng = np.random.default_rng(6)
+        v = rng.integers(np.iinfo(np.int64).min,
+                         np.iinfo(np.int64).max, 700, dtype=np.int64)
+        dist = par.distribute(
+            tft.frame({"v": v, "x": np.zeros(700)}), mesh8)
+        rows = par.dsort("v", dist, descending=True) \
+            .collect_frame().collect()
+        assert [r["v"] for r in rows] == sorted(v.tolist(), reverse=True)
+
+    def test_after_dfilter_mask_layout(self, mesh8):
+        # dfilter leaves per-shard validity; columnsort must sink exactly
+        # the invalid rows, restoring prefix layout
+        rng = np.random.default_rng(7)
+        x = rng.normal(size=500)
+        dist = par.distribute(tft.frame({"x": x}), mesh8)
+        flt = par.dfilter(lambda x: x > 0.0, dist)
+        out = par.dsort("x", flt, descending=True)
+        assert out.shard_valid is None
+        rows = out.collect_frame().collect()
+        want = sorted((v for v in x if v > 0), reverse=True)
+        np.testing.assert_allclose([r["x"] for r in rows], want, rtol=0)
+
+    def test_vector_and_string_riders(self, mesh8):
+        rng = np.random.default_rng(8)
+        n = 300
+        x = rng.permutation(n).astype(np.float64)
+        v = np.stack([x * 2, x * 3], axis=1)
+        s = np.array([f"s{int(i)}" for i in x], object)
+        df = tft.analyze(tft.frame({"x": x, "v": v, "s": s}))
+        dist = par.distribute(df, mesh8)
+        rows = par.dsort("x", dist).collect_frame().collect()
+        for i, r in enumerate(rows):
+            assert r["x"] == float(i)
+            np.testing.assert_allclose(r["v"], [i * 2.0, i * 3.0])
+            assert r["s"] == f"s{i}"
 
 
 def test_group_ids_cache_lru_capped(mesh8):
