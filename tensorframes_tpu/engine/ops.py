@@ -261,9 +261,17 @@ def _validate_reduce(comp: Computation, schema: Schema,
                 f"{missing}")
     unused = [n for n in schema.names if n not in consumed]
     if unused:
-        raise InputNotFoundError(
-            f"Columns {unused} are not consumed by the reduction; drop them "
-            f"with select() first (every column must back a fetch)")
+        # the reference tolerates ride-along columns a reduction does not
+        # consume (BasicOperationsSuite.scala:178-187: a string `key2`
+        # rides along silently and reduce_sum over `x` returns Row(4.1)) —
+        # match that contract, but with a warning instead of silence: an
+        # unconsumed column in a reduce has repeatedly been a user bug in
+        # the reference's own demos (geom_mean.py). The columns simply do
+        # not appear in the result row.
+        _log.warning(
+            "Columns %s are not consumed by the reduction and will be "
+            "ignored (select() the fetch-backing columns to silence this)",
+            unused)
 
 
 # ---------------------------------------------------------------------------
@@ -634,6 +642,79 @@ def _factorize_keys(key_arrays: Sequence[np.ndarray]) -> KeyFactorization:
                             order, np.flatnonzero(changed))
 
 
+def _blockwise_key_factorization(blocks, keys):
+    """Global key→dense-id factorization WITHOUT concatenating the frame.
+
+    The reference streamed partitions through the UDAF shuffle and never
+    held the whole dataset in one buffer; ``Block.concat`` of the frame
+    made host aggregation peak at ~3× the column bytes (round-3 weak #5).
+    Instead: factorize each block locally (lexsort over that block only),
+    merge the SMALL per-block unique-key tables into the global table,
+    and remap each block's local ids. Peak extra memory is one block's
+    sort copy plus the per-row id arrays (int32 where they fit).
+
+    Returns ``(ids_blocks, uniques, num_groups)`` — one dense-id array
+    per block (aligned with the block's rows), the global unique key
+    columns (lexicographically sorted, the output key order), and the
+    group count. Empty blocks get empty id arrays.
+    """
+    # per block keep ONLY (uniques, int32 local ids): a retained
+    # KeyFactorization would pin its int64 ids AND order arrays (2x 8
+    # bytes/row across all blocks — the very footprint this path removes)
+    per_block = []
+    for b in blocks:
+        if b.num_rows == 0:
+            per_block.append(None)
+            continue
+        f = _factorize_keys([b.dense(k) for k in keys])
+        local_dt = np.int32 if f.num_groups < 2 ** 31 else np.int64
+        per_block.append((f.uniques, f.ids.astype(local_dt)))
+        del f
+    nonempty = [p for p in per_block if p is not None]
+    if not nonempty:
+        return [np.empty(0, np.int64) for _ in blocks], \
+            [np.empty(0) for _ in keys], 0
+    if len(nonempty) == 1:
+        uniques, ids = nonempty[0]
+        return [ids if p is not None else np.empty(0, ids.dtype)
+                for p in per_block], list(uniques), len(uniques[0])
+    cat = [np.concatenate([u[i] for u, _ in nonempty])
+           for i in range(len(keys))]
+    gf = _factorize_keys(cat)  # tables only: small
+    id_dt = np.int32 if gf.num_groups < 2 ** 31 else np.int64
+    ids_blocks = []
+    off = 0
+    for i, p in enumerate(per_block):
+        if p is None:
+            ids_blocks.append(np.empty(0, id_dt))
+            continue
+        uniques_b, local_ids = p
+        g = len(uniques_b[0])
+        local_to_global = gf.ids[off:off + g].astype(id_dt)
+        ids_blocks.append(local_to_global[local_ids])
+        per_block[i] = None  # release the local ids as we go
+        off += g
+    return ids_blocks, list(gf.uniques), gf.num_groups
+
+
+def _fact_from_global_ids(ids: np.ndarray) -> KeyFactorization:
+    """A KeyFactorization over PRE-ASSIGNED global group ids (one block's
+    rows): segments are the groups present in the block, ``uniques[0]``
+    their GLOBAL ids, while ``.ids`` are re-densified LOCAL ids (0..G_b-1)
+    — consumers scatter into [G_b]-sized tables."""
+    order = np.argsort(ids, kind="stable")
+    sorted_ids = ids[order]
+    changed = np.zeros(len(ids), dtype=bool)
+    changed[0] = True
+    changed[1:] = sorted_ids[1:] != sorted_ids[:-1]
+    starts = np.flatnonzero(changed)
+    dense_sorted = np.cumsum(changed) - 1
+    dense = np.empty(len(ids), dense_sorted.dtype)
+    dense[order] = dense_sorted
+    return KeyFactorization(dense, [sorted_ids[starts]], len(starts),
+                            order, starts)
+
+
 def _validate_monoid_fetches(col_combiners: Mapping[str, str],
                              value_names: Sequence[str],
                              drop_hint: str) -> None:
@@ -647,9 +728,12 @@ def _validate_monoid_fetches(col_combiners: Mapping[str, str],
             f"columns: {list(value_names)}")
     unused = [n for n in value_names if n not in col_combiners]
     if unused:
-        raise InputNotFoundError(
-            f"Columns {unused} are not consumed by the aggregation; drop "
-            f"them {drop_hint} (every column must back a fetch)")
+        # same ride-along tolerance as _validate_reduce (the reference's
+        # reduce contract, BasicOperationsSuite.scala:178-187): columns no
+        # fetch consumes drop out of the result, with a warning
+        _log.warning(
+            "Columns %s are not consumed by the aggregation and will be "
+            "ignored (drop them %s to silence this)", unused, drop_hint)
     for name, cname in col_combiners.items():
         if cname not in _known:
             raise ValueError(
@@ -685,10 +769,12 @@ def _monoid_aggregate(col_combiners: Mapping[str, str],
     _validate_monoid_fetches(col_combiners, value_names,
                              "with select() first")
 
-    merged = Block.concat(df.blocks(), df.schema)
-    for k in keys:
-        if merged.is_ragged(k) or merged.dense(k).ndim != 1:
-            raise InvalidTypeError(f"Key column {k!r} must be scalar-typed")
+    blocks = df.blocks()
+    for b in blocks:
+        for k in keys:
+            if b.num_rows and (b.is_ragged(k) or b.dense(k).ndim != 1):
+                raise InvalidTypeError(
+                    f"Key column {k!r} must be scalar-typed")
     fetch_names = sorted(col_combiners)
     out_fields = [df.schema[k] for k in keys] + [
         Field(f, df.schema[f].dtype,
@@ -696,25 +782,38 @@ def _monoid_aggregate(col_combiners: Mapping[str, str],
               .with_lead(Unknown),
               sql_rank=df.schema[f].sql_rank)
         for f in fetch_names]
-    n = merged.num_rows
+    n = sum(b.num_rows for b in blocks)
     if n == 0:
         return TensorFrame.from_blocks(
             [Block({f.name: np.empty((0,), f.dtype.np_storage)
                     for f in out_fields}, 0)], Schema(out_fields))
 
-    fact = _factorize_keys([merged.dense(k) for k in keys])
-    ids, uniques, num_groups = fact.ids, fact.uniques, fact.num_groups
+    # blockwise: per-block segment-reduce partials combined with the
+    # monoid — the frame is never concatenated (bounded host memory)
+    ids_blocks, uniques, num_groups = _blockwise_key_factorization(
+        blocks, keys)
+    combine_np = {"sum": np.add, "prod": np.multiply,
+                  "min": np.minimum, "max": np.maximum}
     cols: Dict[str, np.ndarray] = {k: u for k, u in zip(keys, uniques)}
     with span("aggregate.segment_reduce"):
         for f in fetch_names:
             field = df.schema[f]
-            vals = merged.dense(f)
             dd = _dt.device_dtype(field.dtype)
-            if vals.dtype != dd:
-                from .. import native as _native
-                vals = _native.convert(vals, dd)
-            out = np.asarray(_segment_reduce(
-                col_combiners[f], vals, ids, num_groups))
+            out = None
+            for b, ids in zip(blocks, ids_blocks):
+                if b.num_rows == 0:
+                    continue
+                vals = b.dense(f)
+                if vals.dtype != dd:
+                    from .. import native as _native
+                    vals = _native.convert(vals, dd)
+                part = np.asarray(_segment_reduce(
+                    col_combiners[f], vals, ids, num_groups))
+                # groups absent from a block hold the combiner's neutral
+                # element (segment_* identity), so the pairwise combine
+                # is exact
+                out = part if out is None \
+                    else combine_np[col_combiners[f]](out, part)
             if out.dtype != field.dtype.np_storage \
                     and field.dtype is not _dt.bfloat16:
                 out = out.astype(field.dtype.np_storage)
@@ -888,94 +987,107 @@ def aggregate(fetches: Fetches, grouped: GroupedFrame,
         return ex.run(comp, {f + "_input": block[f] for f in fetch_names},
                       pad_ok=False)
 
-    merged = Block.concat(df.blocks(), df.schema)
-    for k in keys:
-        if merged.is_ragged(k):
-            raise InvalidTypeError(f"Key column {k!r} must be scalar-typed")
-    key_arrays = [merged.dense(k) for k in keys]
-    for k, a in zip(keys, key_arrays):
-        if a.ndim != 1:
-            raise InvalidTypeError(f"Key column {k!r} must be scalar-typed")
+    blocks = df.blocks()
+    for b in blocks:
+        for k in keys:
+            if b.num_rows and (b.is_ragged(k) or b.dense(k).ndim != 1):
+                raise InvalidTypeError(
+                    f"Key column {k!r} must be scalar-typed")
 
-    n = merged.num_rows
+    n = sum(b.num_rows for b in blocks)
+    out_fields = [df.schema[k] for k in keys] + [
+        Field(s.name, s.dtype,
+              block_shape=s.shape.prepend(Unknown),
+              sql_rank=s.shape.ndim)
+        for s in comp.outputs]
     if n == 0:
-        out_fields = [df.schema[k] for k in keys] + [
-            Field(s.name, s.dtype,
-                  block_shape=s.shape.prepend(Unknown),
-                  sql_rank=s.shape.ndim)
-            for s in comp.outputs]
         return TensorFrame.from_blocks(
             [Block({f.name: np.empty((0,), f.dtype.np_storage)
                     for f in out_fields}, 0)], Schema(out_fields))
 
-    # sort-by-key "shuffle", then contiguous segments per distinct key
-    fact = _factorize_keys(key_arrays)
-    order, seg_starts = fact.order, fact.seg_starts
-    seg_ends = np.append(seg_starts[1:], n)
-
+    # blockwise "shuffle": the frame is never concatenated. Each block is
+    # sorted by GLOBAL group id and reduced to one partial row per group
+    # present in it; the per-block partials then combine through one more
+    # pass of the same machinery (legal under the algebraic-regrouping
+    # contract, ``core.py:96-97`` — the reference's UDAF merge() does
+    # exactly this with executor-side partial buffers,
+    # ``DebugRowOps.scala:617-662``). Peak host memory is one block's
+    # sorted copy + the id arrays, not 3x the frame.
+    ids_blocks, uniques, num_groups = _blockwise_key_factorization(
+        blocks, keys)
     from .. import native as _native
-    fetch_blocks = {f: _native.gather_rows(merged.dense(f), order)
-                    for f in fetch_names}
 
-    # deserialized computations (exported.call) have no vmap batching rule,
-    # so the vmapped fold cannot run them; they keep the compaction path
-    if use_segmented_fold and getattr(comp, "_native_dynamic", None) is None:
-        # Default path: ONE compiled device program for all groups — a
-        # segmented associative_scan whose operator is the user
-        # computation on two-row blocks (legal under the same
-        # regrouping contract buffered compaction relies on,
-        # ``core.py:96-97``), instead of O(groups) per-group Python
-        # dispatches. A non-default executor (explicit, or
-        # TFT_EXECUTOR=pjrt) keeps the CompactionBuffer path so the
-        # computation runs through that executor.
-        cols = _aggregate_segmented_fold(comp, fetch_names, fetch_blocks,
-                                         fact, df.schema)
-        for k, u in zip(keys, fact.uniques):
-            cols[k] = u
-        out_fields = [df.schema[k] for k in keys] + [
-            Field(s.name, s.dtype, block_shape=s.shape.prepend(Unknown),
-                  sql_rank=s.shape.ndim)
-            for s in comp.outputs]
-        return TensorFrame.from_blocks(
-            [Block(cols, len(seg_starts))], Schema(out_fields))
+    use_fold = (use_segmented_fold
+                and getattr(comp, "_native_dynamic", None) is None)
 
-    out_rows: Dict[str, List[np.ndarray]] = {f: [] for f in fetch_names}
-    # Ingest each segment in power-of-two-sized chunks (capped): any length
-    # decomposes into <= log2(cap) + n/cap chunks, so the whole aggregation
-    # touches only O(log) distinct compile signatures, shared across groups,
-    # and dispatch count is O(n / cap + log n) per group instead of the
-    # reference's O(n / 10). Combine order is contractually unspecified
-    # (core.py:96-97), so regrouping the ingestion is legal; the partials
-    # buffer still compacts every `buffer_size` rows (the UDAF contract).
-    chunk_cap = 1 << 16
-    for a, bnd in zip(seg_starts, seg_ends):
-        buf = CompactionBuffer(fetch_names, reduce_fn, buffer_size)
-        c, rem = a, bnd - a
-        while rem >= chunk_cap:
-            buf.update_block({f: fetch_blocks[f][c:c + chunk_cap]
-                              for f in fetch_names}, chunk_cap)
-            c += chunk_cap
-            rem -= chunk_cap
-        p = chunk_cap >> 1
-        while rem:
-            if rem >= p:
-                buf.update_block({f: fetch_blocks[f][c:c + p]
-                                  for f in fetch_names}, p)
-                c += p
-                rem -= p
-            p >>= 1
-        result = buf.evaluate()
+    def block_partials(fetch_b, fact_b):
+        """One partial row per group present, in segment order."""
+        if use_fold:
+            # ONE compiled device program for the block's groups — a
+            # segmented associative_scan whose operator is the user
+            # computation on two-row blocks. A non-default executor
+            # (explicit, or TFT_EXECUTOR=pjrt) keeps the
+            # CompactionBuffer path so the computation runs through that
+            # executor; deserialized computations (exported.call) have
+            # no vmap batching rule and also keep it.
+            return _aggregate_segmented_fold(comp, fetch_names, fetch_b,
+                                             fact_b, df.schema)
+        # CompactionBuffer path: ingest each segment in power-of-two
+        # chunks (capped), so the whole aggregation touches O(log)
+        # distinct compile signatures and O(rows/cap + log rows)
+        # dispatches per group instead of the reference's O(rows/10);
+        # the partials buffer still compacts every `buffer_size` rows
+        # (the UDAF contract).
+        seg_starts = fact_b.seg_starts
+        seg_ends = np.append(seg_starts[1:], len(fact_b.ids))
+        chunk_cap = 1 << 16
+        out_rows: Dict[str, List[np.ndarray]] = {f: [] for f in
+                                                 fetch_names}
+        for a, bnd in zip(seg_starts, seg_ends):
+            buf = CompactionBuffer(fetch_names, reduce_fn, buffer_size)
+            c, rem = a, bnd - a
+            while rem >= chunk_cap:
+                buf.update_block({f: fetch_b[f][c:c + chunk_cap]
+                                  for f in fetch_names}, chunk_cap)
+                c += chunk_cap
+                rem -= chunk_cap
+            p = chunk_cap >> 1
+            while rem:
+                if rem >= p:
+                    buf.update_block({f: fetch_b[f][c:c + p]
+                                      for f in fetch_names}, p)
+                    c += p
+                    rem -= p
+                p >>= 1
+            result = buf.evaluate()
+            for f in fetch_names:
+                out_rows[f].append(result[f])
+        return {f: np.stack(out_rows[f]) for f in fetch_names}
+
+    partial_gids: List[np.ndarray] = []
+    partial_rows: Dict[str, List[np.ndarray]] = {f: [] for f in
+                                                 fetch_names}
+    for b, ids in zip(blocks, ids_blocks):
+        if b.num_rows == 0:
+            continue
+        fact_b = _fact_from_global_ids(ids)
+        fetch_b = {f: _native.gather_rows(b.dense(f), fact_b.order)
+                   for f in fetch_names}
+        cols_b = block_partials(fetch_b, fact_b)
+        partial_gids.append(fact_b.uniques[0])
         for f in fetch_names:
-            out_rows[f].append(result[f])
+            partial_rows[f].append(cols_b[f])
 
-    cols: Dict[str, np.ndarray] = {}
-    for k, u in zip(keys, fact.uniques):
+    if len(partial_gids) == 1:
+        cols = {f: partial_rows[f][0] for f in fetch_names}
+    else:
+        ids2 = np.concatenate(partial_gids)
+        fact2 = _fact_from_global_ids(ids2)
+        fetch2 = {f: np.concatenate(partial_rows[f])[fact2.order]
+                  for f in fetch_names}
+        cols = block_partials(fetch2, fact2)
+
+    for k, u in zip(keys, uniques):
         cols[k] = u
-    for f in fetch_names:
-        cols[f] = np.stack(out_rows[f])
-    out_fields = [df.schema[k] for k in keys] + [
-        Field(s.name, s.dtype, block_shape=s.shape.prepend(Unknown),
-              sql_rank=s.shape.ndim)
-        for s in comp.outputs]
-    return TensorFrame.from_blocks([Block(cols, len(seg_starts))],
+    return TensorFrame.from_blocks([Block(cols, num_groups)],
                                    Schema(out_fields))

@@ -505,8 +505,16 @@ class TensorFrame:
         parts = num_partitions or self._num_partitions
 
         def run() -> List[Block]:
-            merged = Block.concat(self.blocks(), self._schema)
-            n = merged.num_rows
+            # Blockwise: only the KEY columns are ever concatenated; the
+            # value columns gather from their blocks one OUTPUT block at a
+            # time. Peak host memory is input + output + keys, not the 3x
+            # a whole-frame merge costs (the reference streamed partitions
+            # and never held the dataset in one buffer).
+            blocks = self.blocks()
+            sizes = [b.num_rows for b in blocks]
+            offsets = np.concatenate([[0], np.cumsum(sizes)]).astype(
+                np.int64)
+            n = int(offsets[-1])
             # np.lexsort: LAST key is primary; stable. Descending negates
             # the key instead of reversing the result, which would
             # un-stabilize ties. Float keys negate the values directly so
@@ -518,23 +526,53 @@ class TensorFrame:
             # overflow-safe and works for objects.
             keys = []
             for c in reversed(cols):
-                k = np.asarray(merged.columns[c])
+                parts_c = [np.asarray(b.columns[c]) for b in blocks
+                           if b.num_rows]
+                k = (np.concatenate(parts_c) if parts_c
+                     else np.empty(0))
                 if descending:
                     if k.dtype.kind == "f":
                         k = -k
                     else:
                         k = -np.unique(k, return_inverse=True)[1]
                 keys.append(k)
-            order = np.lexsort(keys)
-            out_cols: Dict[str, Column] = {}
-            for name, c in merged.columns.items():
-                if isinstance(c, np.ndarray):
-                    out_cols[name] = c[order]
-                else:  # ragged list columns reorder by index
-                    out_cols[name] = [c[i] for i in order]
+            order = np.lexsort(keys) if n else np.empty(0, np.int64)
+            del keys
+            if n < 2 ** 31:
+                order = order.astype(np.int32)  # halve the index footprint
             spans = _split_even(n, parts)
-            return [Block({k: v[a:b] for k, v in out_cols.items()}, b - a)
-                    for a, b in spans]
+            out_blocks = []
+            col_srcs = {name: [b.columns[name] for b in blocks]
+                        for name in self._schema.names}
+            col_dense = {name: all(isinstance(s, np.ndarray)
+                                   for s in srcs)
+                         for name, srcs in col_srcs.items()}
+            for a, e in spans:
+                # per-span index mapping: global blk_of/loc arrays would
+                # add another ~1x of int64 per 8-byte row
+                osel = order[a:e]
+                bsel = np.searchsorted(offsets[1:], osel,
+                                       side="right").astype(np.int32)
+                lsel = osel - offsets[bsel]
+                # source-block masks computed once per span, shared by
+                # every column
+                span_blocks = np.unique(bsel)
+                masks = [(bi, bsel == bi) for bi in span_blocks]
+                cols_out: Dict[str, Column] = {}
+                for name in self._schema.names:
+                    srcs = col_srcs[name]
+                    if col_dense[name] and srcs:
+                        first = srcs[bsel[0]] if e > a else srcs[0]
+                        out_a = np.empty((e - a,) + first.shape[1:],
+                                         first.dtype)
+                        for bi, m in masks:
+                            out_a[m] = srcs[bi][lsel[m]]
+                        cols_out[name] = out_a
+                    else:  # ragged list columns reorder by index
+                        cols_out[name] = [srcs[bi][i]
+                                          for bi, i in zip(bsel, lsel)]
+                out_blocks.append(Block(cols_out, e - a))
+            return out_blocks
 
         return TensorFrame(self._schema, run, parts,
                            plan=f"order_by{cols}({self._plan})")

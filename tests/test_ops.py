@@ -245,10 +245,23 @@ def test_reduce_blocks_multiple_fetches():
     assert out[1] == pytest.approx(10.0)
 
 
-def test_reduce_blocks_unused_column_rejected():
-    df = tft.frame({"x": np.arange(4.0), "junk": np.arange(4.0)})
-    with pytest.raises(InputNotFoundError, match="not consumed"):
-        tft.reduce_blocks(lambda x_input: {"x": jnp.sum(x_input)}, df)
+def test_reduce_blocks_unused_column_ignored():
+    # ported reference scenario (BasicOperationsSuite.scala:178-187):
+    # a string ride-along column the reduction does not consume is
+    # ignored — reduce_sum over x returns 4.1, key2 simply drops out
+    df = tft.frame({"key2": np.array(["1", "2", "3"], object),
+                    "x": np.array([1.0, 1.1, 2.0])})
+    out = tft.reduce_blocks(lambda x_input: {"x": jnp.sum(x_input)}, df)
+    assert float(out) == pytest.approx(4.1)
+
+
+def test_reduce_blocks_unused_numeric_column_ignored_multipartition():
+    # reference BasicOperationsSuite.scala:189-198: same tolerance with
+    # an explicit 2-partition frame forcing the cross-partition combine
+    df = tft.frame({"x": np.array([1.0, 2.0]),
+                    "junk": np.array([7.0, 8.0])}, num_partitions=2)
+    out = tft.reduce_blocks(lambda x_input: {"x": jnp.sum(x_input)}, df)
+    assert float(out) == pytest.approx(3.0)
 
 
 def test_reduce_blocks_missing_input_for_fetch():
@@ -378,12 +391,16 @@ def test_aggregate_monoid_unknown_column_and_combiner():
         tft.aggregate({"x": "mean"}, df.group_by("key"))
 
 
-def test_aggregate_unused_value_column_rejected():
+def test_aggregate_unused_value_column_ignored():
+    # consistent with the reduce ride-along contract: the extra value
+    # column drops out of the per-group result rows
     df = tft.frame({"key": np.zeros(3, np.int64), "x": np.arange(3.0),
                     "extra": np.arange(3.0)})
-    with pytest.raises(InputNotFoundError, match="not consumed"):
-        tft.aggregate(lambda x_input: {"x": jnp.sum(x_input, axis=0)},
-                      df.group_by("key"))
+    out = tft.aggregate(lambda x_input: {"x": jnp.sum(x_input, axis=0)},
+                        df.group_by("key"))
+    rows = out.collect()
+    assert len(rows) == 1 and rows[0]["x"] == pytest.approx(3.0)
+    assert "extra" not in out.schema.names
 
 
 # ---------------------------------------------------------------------------
