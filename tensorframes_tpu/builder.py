@@ -61,13 +61,47 @@ class OpBuilder:
         self._grouped = grouped
         self._trim = trim
         self._comp: Optional[Computation] = None
+        self._raw_module: Optional[bytes] = None
+        self._sig_inputs: Optional[Sequence[TensorSpec]] = None
+        self._sig_outputs: Optional[Sequence[TensorSpec]] = None
         self._fetches: Optional[Sequence[str]] = None
         self._shapes: Dict[str, Shape] = {}
 
     # -- configuration -----------------------------------------------------
     def graph(self, data: bytes) -> "OpBuilder":
-        """Attach the serialized computation (the ``.graph(bytes)`` leg)."""
-        self._comp = Computation.deserialize(data)
+        """Attach the serialized computation (the ``.graph(bytes)`` leg).
+
+        ``data`` is either this library's ``TFTPU1`` blob
+        (self-describing) or a BARE StableHLO/MLIR module produced by any
+        exporter (``jax.jit(fn).lower(...).as_text()``, a portable
+        bytecode artifact, ...) — the foreign-graph entry the reference
+        had via raw ``GraphDef`` bytes. Bare modules carry no signature,
+        so call :meth:`signature` with the input (and optionally output)
+        specs before :meth:`build`.
+        """
+        if isinstance(data, str):
+            data = data.encode()
+        if data.startswith(b"TFTPU"):
+            self._comp = Computation.deserialize(data)
+            self._raw_module = None
+        elif data.startswith(b"ML\xefR") or b"func.func" in data[:4096] \
+                or data.lstrip()[:6] == b"module":
+            self._raw_module = data
+            self._comp = None
+        else:
+            # let deserialize produce its canonical error
+            self._comp = Computation.deserialize(data)
+            self._raw_module = None
+        return self
+
+    def signature(self, inputs: Sequence[TensorSpec],
+                  outputs: Optional[Sequence[TensorSpec]] = None
+                  ) -> "OpBuilder":
+        """Declare a bare module's signature (explicit TensorSpecs; the
+        ShapeDescription role for foreign graphs). Outputs may be omitted
+        — they are then inferred from the module's ``@main`` results."""
+        self._sig_inputs = list(inputs)
+        self._sig_outputs = list(outputs) if outputs is not None else None
         return self
 
     def computation(self, comp: Computation) -> "OpBuilder":
@@ -91,6 +125,13 @@ class OpBuilder:
 
     # -- build -------------------------------------------------------------
     def _resolved(self) -> Computation:
+        if self._comp is None and self._raw_module is not None:
+            if self._sig_inputs is None:
+                raise ValueError(
+                    "A bare StableHLO module carries no signature; call "
+                    ".signature(inputs=[TensorSpec...]) before .build()")
+            self._comp = Computation.from_stablehlo(
+                self._raw_module, self._sig_inputs, self._sig_outputs)
         if self._comp is None:
             raise ValueError("No computation attached; call .graph(bytes) "
                              "or .computation(comp) first")
