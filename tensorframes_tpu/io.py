@@ -8,9 +8,12 @@ wants, so reading maps row groups to partitions with zero row-at-a-time
 work (the reference's convert/convertBack hot loop,
 ``DataOps.scala:158-283``, does not exist on this path at all).
 
-Scope (honest): scalar columns (float/double/int/long/bool/string) and
-fixed-size-list columns (vector cells). Ragged lists are rejected with a
-clear error — the engine's ragged support is for in-memory frames.
+Scope: scalar columns (float/double/int/long/bool/string),
+fixed-size-list columns (vector cells), and variable-length list columns
+— the latter load as RAGGED columns (one numpy cell per row, the
+engine's in-memory ragged format: ``map_rows`` consumes them directly,
+``pad_column`` densifies them for block ops; ``read_parquet(...,
+pad_ragged=...)`` does that at load time).
 
 All entry points are lazy-import (pyarrow/pandas only load when used) so
 the core package stays dependency-light.
@@ -50,21 +53,29 @@ def _column_to_numpy(col, name: str) -> np.ndarray:
             width = lengths[0]
             flat = col.flatten().to_numpy(zero_copy_only=False)
             return np.asarray(flat).reshape(len(col), width)
-        raise ValueError(
-            f"column {name!r}: ragged list values (lengths "
-            f"{sorted(lengths)[:5]}...); only fixed-width vector columns "
-            f"load from parquet")
+        # variable-length lists -> a RAGGED column: one numpy cell per
+        # row, sliced zero-copy-ish out of the arrow value buffer
+        flat = np.asarray(col.flatten().to_numpy(zero_copy_only=False))
+        offs = np.asarray(col.offsets)
+        return [flat[offs[i]:offs[i + 1]] for i in range(len(col))]
     if pa.types.is_string(t) or pa.types.is_large_string(t):
         return np.asarray(col.to_pylist(), dtype=object)
     return col.to_numpy(zero_copy_only=False)
 
 
 def read_parquet(path: str, columns: Optional[Sequence[str]] = None,
-                 num_partitions: Optional[int] = None) -> TensorFrame:
+                 num_partitions: Optional[int] = None,
+                 pad_ragged=False) -> TensorFrame:
     """Read a parquet file into a TensorFrame, row groups → partitions.
 
     ``num_partitions=None`` keeps the file's row-group structure (the
     natural block layout); an explicit value re-blocks after load.
+
+    Variable-length list columns become RAGGED columns (usable by
+    ``map_rows``/``pad_column`` directly). ``pad_ragged=True`` pads every
+    ragged column at load (``pad_column`` semantics: dense ``[rows, L]``
+    plus ``_mask``/``_len`` columns); a sequence of names pads just
+    those.
     """
     import pyarrow as pa
     import pyarrow.parquet as pq
@@ -88,21 +99,57 @@ def read_parquet(path: str, columns: Optional[Sequence[str]] = None,
             empty = pf.schema_arrow.empty_table()
             blocks = [{n: _column_to_numpy(empty.column(n), n)
                        for n in names}]
-    first = TensorFrame.from_columns(blocks[0])
-    if len(blocks) > 1:
-        from .frame import Block
-
+    if not names:  # explicit empty selection: an empty frame
+        return TensorFrame.from_columns({})
+    ragged_names = [n for n in names
+                    if any(isinstance(b[n], list) for b in blocks)]
+    if not ragged_names:
+        first = TensorFrame.from_columns(blocks[0])
         schema = first.schema
-        fblocks = [Block({n: b[n] for n in names},
-                         len(next(iter(b.values())))) for b in blocks]
-        first = TensorFrame.from_blocks(fblocks, schema)
-    if num_partitions is not None:
-        from .frame import Block as _B
+    else:
+        # a row group whose lists HAPPEN to share one length decodes
+        # dense; normalize those columns back to ragged cells so every
+        # block agrees with the schema
+        for b in blocks:
+            for n in ragged_names:
+                if not isinstance(b[n], list):
+                    b[n] = list(b[n])
+        from . import dtypes as _dt
+        from .schema import Field, Schema
 
-        merged = _B.concat(first.blocks(), first.schema)
-        cols = {n: merged.dense(n) for n in names}
-        first = TensorFrame.from_columns(cols, schema=first.schema,
-                                         num_partitions=num_partitions)
+        fields = []
+        for n in names:
+            if n in ragged_names:
+                # dtype probe over ALL blocks: the first one may hold
+                # only empty cells
+                probe = next(
+                    (c for b in blocks for c in b[n] if len(c)),
+                    np.empty(0))
+                fields.append(Field(n, _dt.from_numpy(probe.dtype),
+                                    sql_rank=1))
+            else:
+                fields.append(
+                    Schema.from_numpy_columns(
+                        {n: blocks[0][n]}).fields[0])
+        schema = Schema(fields)
+    from .frame import Block
+
+    fblocks = [Block({n: b[n] for n in names},
+                     len(b[names[0]])) for b in blocks]
+    first = TensorFrame.from_blocks(fblocks, schema)
+    if num_partitions is not None:
+        merged = Block.concat(first.blocks(), first.schema)
+        from .frame import _split_even
+
+        spans = _split_even(merged.num_rows, num_partitions)
+        fblocks = [Block({n: merged.columns[n][a:b] for n in names},
+                         b - a) for a, b in spans]
+        first = TensorFrame.from_blocks(fblocks, schema)
+    if pad_ragged:
+        to_pad = ragged_names if pad_ragged is True else [
+            n for n in pad_ragged]
+        for n in to_pad:
+            first = first.pad_column(n)
     return first
 
 
@@ -116,6 +163,16 @@ def write_parquet(df: TensorFrame, path: str) -> None:
         for b in df.blocks():
             arrays = {}
             for name in df.schema.names:
+                if b.is_ragged(name):
+                    # ragged 1-d cells -> a variable-length list column
+                    cells = b.columns[name]
+                    if any(np.asarray(c).ndim != 1 for c in cells):
+                        raise ValueError(
+                            f"column {name!r}: only 1-d ragged cells map "
+                            f"to parquet lists")
+                    arrays[name] = pa.array(
+                        [np.asarray(c).tolist() for c in cells])
+                    continue
                 a = b.dense(name)
                 if a.ndim == 1:
                     arrays[name] = pa.array(a.tolist() if a.dtype == object

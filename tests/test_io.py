@@ -100,3 +100,58 @@ class TestCsv:
         p = str(tmp_path / "t.csv")
         tft.io.write_csv(tft.frame({"x": np.arange(3.0)}), p)
         assert tft.io.read_csv(p, columns=[]).schema.names == []
+
+
+class TestRaggedParquet:
+    """Variable-length list columns load as ragged columns (round-3 weak
+    #7: they used to be rejected outright)."""
+
+    def _write_ragged(self, tmp_path):
+        df = tft.frame(
+            [(np.arange(i + 1, dtype=np.float64), float(i))
+             for i in range(6)],
+            columns=["v", "x"], num_partitions=2)
+        p = str(tmp_path / "ragged.parquet")
+        tio.write_parquet(df, p)
+        return p
+
+    def test_round_trip_ragged(self, tmp_path):
+        p = self._write_ragged(tmp_path)
+        df = tio.read_parquet(p)
+        rows = df.collect()
+        assert len(rows) == 6
+        for i, r in enumerate(rows):
+            np.testing.assert_array_equal(r["v"], np.arange(i + 1))
+            assert r["x"] == float(i)
+
+    def test_ragged_feeds_map_rows(self, tmp_path):
+        p = self._write_ragged(tmp_path)
+        # analyze() stamps the ragged column's shape metadata (Unknown
+        # inner dim) exactly as the reference required for variable rows
+        df = tft.analyze(tio.read_parquet(p))
+        out = tft.map_rows(lambda v: {"s": v.sum()}, df.select("v"))
+        rows = out.collect()
+        assert [r["s"] for r in rows] == [
+            float(np.arange(i + 1).sum()) for i in range(6)]
+
+    def test_pad_ragged_then_map_blocks(self, tmp_path):
+        p = self._write_ragged(tmp_path)
+        df = tio.read_parquet(p, pad_ragged=True)
+        assert set(df.columns) >= {"v", "v_mask", "v_len"}
+        out = tft.map_blocks(
+            lambda v, v_mask: {"s": (v * v_mask).sum(axis=1)}, df)
+        rows = out.collect()
+        assert [r["s"] for r in rows] == [
+            float(np.arange(i + 1).sum()) for i in range(6)]
+
+    def test_pad_ragged_subset_list(self, tmp_path):
+        p = self._write_ragged(tmp_path)
+        df = tio.read_parquet(p, pad_ragged=["v"])
+        assert "v_mask" in df.columns
+
+    def test_repartition_keeps_ragged(self, tmp_path):
+        p = self._write_ragged(tmp_path)
+        df = tio.read_parquet(p, num_partitions=3)
+        assert df.num_partitions == 3
+        rows = df.collect()
+        np.testing.assert_array_equal(rows[4]["v"], np.arange(5))
