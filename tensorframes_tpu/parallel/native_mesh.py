@@ -127,14 +127,15 @@ class NativeMeshExecutor:
         self.dispatch_count = 0
 
     def _cache_put(self, cache: OrderedDict, key, entry, cap: int):
-        """Insert under self._lock with LRU eviction; evicted executables
-        are closed (they hold native device buffers)."""
+        """Insert under self._lock with LRU eviction. Evicted executables
+        are NOT closed here: another thread may have read the entry and be
+        mid-execute outside the lock; dropping the cache reference lets
+        the executable's own ``__del__`` free the native handle once the
+        last reference (including that thread's) is gone."""
         cache[key] = entry
         cache.move_to_end(key)
         while len(cache) > cap:
-            _, old = cache.popitem(last=False)
-            if old is not _NOT_ROUTABLE and old is not None:
-                old[0].close()
+            cache.popitem(last=False)
 
     # -- shard marshalling -------------------------------------------------
     @staticmethod

@@ -32,6 +32,7 @@ import numpy as np  # noqa: E402
 
 def main() -> int:
     pid, nproc, port = (int(a) for a in sys.argv[1:4])
+    ckpt_dir = sys.argv[4] if len(sys.argv) > 4 else None
     from tensorframes_tpu import parallel as par
 
     par.initialize(coordinator_address=f"localhost:{port}",
@@ -119,6 +120,39 @@ def main() -> int:
     top = srt.collect_frame().collect()
     np.testing.assert_allclose([r["x"] for r in top],
                                np.sort(x_g[x_g < 500])[::-1], rtol=1e-12)
+
+    # 9. COMPOSITE device-side keys across processes (mixed-radix int32
+    # combination inside one jitted program over the sharded key columns)
+    k2_local = (np.arange(n_local) % 3).astype(np.int64)
+    dist2 = par.distribute_local(
+        {"k": k_local, "k2": k2_local, "x": x_local}, mesh)
+    k2_g = np.concatenate([(np.arange(23) % 3), (np.arange(17) % 3)])
+    agg4 = par.daggregate({"x": "sum"}, dist2, ["k", "k2"],
+                          max_groups=16).collect()
+    assert len(agg4) == len({(a, b) for a, b in zip(k_g, k2_g)})
+    for r in agg4:
+        sel = (k_g == r["k"]) & (k2_g == r["k2"])
+        np.testing.assert_allclose(r["x"], x_g[sel].sum(), rtol=1e-12)
+
+    # 10. checkpoint save + resume-on-mesh with BOTH processes
+    # participating: each host writes/reads only its shards (orbax), and
+    # the restored arrays carry the original shardings
+    if ckpt_dir:
+        from tensorframes_tpu.utils import checkpoint as ckpt
+
+        state = {"x": dist.columns["x"], "v": dist.columns["v"]}
+        ckpt.save(ckpt_dir, state)
+        like = jax.tree.map(
+            lambda a: jax.device_put(jnp.zeros(a.shape, a.dtype),
+                                     a.sharding), state)
+        restored = ckpt.restore(ckpt_dir, like=like)
+        for name in state:
+            a, b = state[name], restored[name]
+            assert b.sharding == a.sharding, (name, b.sharding)
+            for so, sn in zip(a.addressable_shards,
+                              b.addressable_shards):
+                np.testing.assert_array_equal(np.asarray(so.data),
+                                              np.asarray(sn.data))
 
     print(f"[worker {pid}] OK", flush=True)
     return 0

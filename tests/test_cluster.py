@@ -26,13 +26,14 @@ def _free_port() -> int:
 
 
 @pytest.mark.slow
-def test_two_process_cluster():
+def test_two_process_cluster(tmp_path):
     port = _free_port()
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)  # worker sets its own device count
+    ckpt_dir = str(tmp_path / "cluster_ckpt")
     procs = [
         subprocess.Popen(
-            [sys.executable, WORKER, str(pid), "2", str(port)],
+            [sys.executable, WORKER, str(pid), "2", str(port), ckpt_dir],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
             env=env)
         for pid in range(2)
