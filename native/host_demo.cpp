@@ -1,0 +1,225 @@
+// Second-host-language demo: a C++ program as the EXECUTOR HOST.
+//
+// The reference's native core served a JVM host through javacpp
+// (PythonInterface.scala:23-81 -> TensorFlowOps.scala:46-64); the claim
+// "any host can call this framework's core through the C ABI" is proven
+// here the same way: this program contains NO Python and NO jax. It
+//
+//   1. reads a TFTPU1 blob (a computation serialized by the Python
+//      DRIVER via Computation.serialize()),
+//   2. parses the blob's JSON header with a few string scans (the format
+//      is this framework's own, tensorframes_tpu/computation.py:246-341:
+//      magic + header length + JSON + raw StableHLO module + jax.export
+//      payload),
+//   3. compiles the raw dynamic-shape module at a concrete row count
+//      through tfr_pjrt_compile_dynamic (shape refinement happens inside
+//      the native core), and
+//   4. executes it on rows it fabricates, printing the outputs.
+//
+// Usage: host_demo <blob-path> <rows>
+// Exit 0 and a final "HOST_DEMO_OK" line on success.
+//
+// Build: make -C native host_demo    (links libtfrpjrt.so)
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <fstream>
+#include <string>
+#include <vector>
+
+#include "tfrpjrt.h"
+
+namespace {
+
+// -- minimal header scanning (our own fixed format, not general JSON) ----
+
+long scan_long(const std::string& s, const std::string& key, long fallback) {
+  auto pos = s.find("\"" + key + "\":");
+  if (pos == std::string::npos) return fallback;
+  pos = s.find(':', pos);
+  return std::strtol(s.c_str() + pos + 1, nullptr, 10);
+}
+
+// ["cpu", "tpu"] -> "cpu,tpu"
+std::string scan_string_list_csv(const std::string& s,
+                                 const std::string& key) {
+  auto pos = s.find("\"" + key + "\":");
+  if (pos == std::string::npos) return "";
+  auto open = s.find('[', pos);
+  auto close = s.find(']', open);
+  if (open == std::string::npos || close == std::string::npos) return "";
+  std::string out;
+  size_t i = open;
+  while (i < close) {
+    auto q1 = s.find('"', i);
+    if (q1 == std::string::npos || q1 > close) break;
+    auto q2 = s.find('"', q1 + 1);
+    if (q2 == std::string::npos || q2 > close) break;  // unterminated
+    if (!out.empty()) out += ",";
+    out += s.substr(q1 + 1, q2 - q1 - 1);
+    i = q2 + 1;
+  }
+  return out;
+}
+
+int dtype_code_from_name(const std::string& name) {
+  if (name == "float32") return TFR_F32;
+  if (name == "float64") return TFR_F64;
+  if (name == "int32") return TFR_I32;
+  if (name == "int64") return TFR_I64;
+  if (name == "bool") return TFR_PRED;
+  return 0;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc < 3) {
+    std::fprintf(stderr, "usage: %s <tftpu1-blob> <rows>\n", argv[0]);
+    return 2;
+  }
+  const char* blob_path = argv[1];
+  const long rows = std::strtol(argv[2], nullptr, 10);
+
+  std::ifstream f(blob_path, std::ios::binary);
+  if (!f) {
+    std::fprintf(stderr, "cannot read %s\n", blob_path);
+    return 2;
+  }
+  std::string blob((std::istreambuf_iterator<char>(f)),
+                   std::istreambuf_iterator<char>());
+  const std::string magic("TFTPU1\0", 7);  // _MAGIC, computation.py:46
+  if (blob.size() < magic.size() + 4 ||
+      blob.compare(0, magic.size(), magic) != 0) {
+    std::fprintf(stderr, "not a TFTPU1 blob\n");
+    return 2;
+  }
+  unsigned int hlen = 0;
+  std::memcpy(&hlen, blob.data() + magic.size(), 4);  // little-endian host
+  const size_t payload_off = magic.size() + 4 + hlen;
+  if (payload_off > blob.size()) {
+    std::fprintf(stderr, "truncated TFTPU1 blob (header says %u bytes)\n",
+                 hlen);
+    return 2;
+  }
+  const std::string header = blob.substr(magic.size() + 4, hlen);
+
+  const long module_len = scan_long(header, "module_len", -1);
+  const long cc_version = scan_long(header, "cc_version", -1);
+  const std::string platforms = scan_string_list_csv(header, "platforms");
+  const std::string arg_dtype_name =
+      scan_string_list_csv(header, "arg_dtypes");  // first entry wins below
+  if (module_len < 0 || cc_version < 0) {
+    std::fprintf(stderr, "blob has no native section (pre-native format?)\n");
+    return 2;
+  }
+  std::string first_dtype = arg_dtype_name.substr(
+      0, arg_dtype_name.find(','));
+  const int dtype = dtype_code_from_name(first_dtype);
+  if (dtype == 0) {
+    std::fprintf(stderr, "unsupported arg dtype %s\n", first_dtype.c_str());
+    return 2;
+  }
+  std::fprintf(stderr,
+               "[host_demo] header: module_len=%ld cc_version=%ld "
+               "platforms=%s arg_dtype=%s\n",
+               module_len, cc_version, platforms.c_str(),
+               first_dtype.c_str());
+
+  char err[4096] = {0};
+  tfr_pjrt_client* client = tfr_pjrt_client_create("cpu", err, sizeof(err));
+  if (!client) {
+    std::fprintf(stderr, "client create failed: %s\n", err);
+    return 1;
+  }
+  char plat[64] = {0};
+  tfr_pjrt_client_platform(client, plat, sizeof(plat));
+  std::fprintf(stderr, "[host_demo] platform=%s devices=%d\n", plat,
+               tfr_pjrt_client_device_count(client));
+
+  // one [rows] argument of the header's dtype; refinement of the
+  // symbolic row dim happens inside the core
+  int dtypes[1] = {dtype};
+  int ndims[1] = {1};
+  long long dims[1] = {rows};
+  tfr_pjrt_exe* exe = tfr_pjrt_compile_dynamic(
+      client, blob.data() + payload_off, module_len,
+      static_cast<int>(cc_version), platforms.c_str(), plat, 1, dtypes,
+      ndims, dims, err, sizeof(err));
+  if (!exe) {
+    std::fprintf(stderr, "compile failed: %s\n", err);
+    tfr_pjrt_client_destroy(client);
+    return 1;
+  }
+
+  // fabricate 0..rows-1 in the argument's OWN dtype — handing the core a
+  // wrong-typed buffer would over/under-read (int64 vs float32 sizes)
+  std::vector<double> x64(rows);
+  std::vector<float> x32(rows);
+  std::vector<long long> i64(rows);
+  std::vector<int> i32(rows);
+  std::vector<unsigned char> b8(rows);
+  for (long i = 0; i < rows; ++i) {
+    x64[i] = i; x32[i] = float(i); i64[i] = i; i32[i] = int(i);
+    b8[i] = static_cast<unsigned char>(i & 1);
+  }
+  const void* arg = nullptr;
+  switch (dtype) {
+    case TFR_F64: arg = x64.data(); break;
+    case TFR_F32: arg = x32.data(); break;
+    case TFR_I64: arg = i64.data(); break;
+    case TFR_I32: arg = i32.data(); break;
+    case TFR_PRED: arg = b8.data(); break;
+  }
+  const void* data[1] = {arg};
+  tfr_pjrt_results* res = tfr_pjrt_execute(client, exe, 1, dtypes, ndims,
+                                           dims, data, err, sizeof(err));
+  if (!res) {
+    std::fprintf(stderr, "execute failed: %s\n", err);
+    tfr_pjrt_exe_destroy(exe);
+    tfr_pjrt_client_destroy(client);
+    return 1;
+  }
+  const int n_out = tfr_pjrt_results_count(res);
+  std::fprintf(stderr, "[host_demo] %d output(s)\n", n_out);
+  for (int i = 0; i < n_out; ++i) {
+    int odt = 0, ondim = 0;
+    long long odims[8] = {0};
+    if (tfr_pjrt_result_meta(res, i, &odt, &ondim, odims)) {
+      std::fprintf(stderr, "result meta failed\n");
+      return 1;
+    }
+    long long elems = 1;
+    for (int d = 0; d < ondim; ++d) elems *= odims[d];
+    if (odt == TFR_F64) {
+      std::vector<double> out(elems);
+      if (tfr_pjrt_result_read(res, i, out.data(), elems * 8, err,
+                               sizeof(err))) {
+        std::fprintf(stderr, "result read failed: %s\n", err);
+        return 1;
+      }
+      std::printf("out[%d] dtype=f64 elems=%lld first=%.6f last=%.6f\n", i,
+                  elems, out.empty() ? 0.0 : out.front(),
+                  out.empty() ? 0.0 : out.back());
+    } else if (odt == TFR_F32) {
+      std::vector<float> out(elems);
+      if (tfr_pjrt_result_read(res, i, out.data(), elems * 4, err,
+                               sizeof(err))) {
+        std::fprintf(stderr, "result read failed: %s\n", err);
+        return 1;
+      }
+      std::printf("out[%d] dtype=f32 elems=%lld first=%.6f last=%.6f\n", i,
+                  elems, out.empty() ? 0.f : out.front(),
+                  out.empty() ? 0.f : out.back());
+    } else {
+      std::printf("out[%d] dtype_code=%d elems=%lld (not printed)\n", i,
+                  odt, elems);
+    }
+  }
+  tfr_pjrt_results_destroy(res);
+  tfr_pjrt_exe_destroy(exe);
+  tfr_pjrt_client_destroy(client);
+  std::printf("HOST_DEMO_OK\n");
+  return 0;
+}
