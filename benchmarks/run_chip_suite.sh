@@ -8,6 +8,14 @@ set -uo pipefail
 cd "$(dirname "$0")/.."
 
 OUT=benchmarks/chip_results.jsonl
+
+# persistent compilation cache: a relay drop mid-suite must not restart
+# every compile from zero on the retry (jax warns + continues if the
+# plugin cannot serialize executables)
+export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-$PWD/benchmarks/.jax_cache}"
+export JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=0
+mkdir -p "$JAX_COMPILATION_CACHE_DIR"
+
 probe() {
   timeout 90 python -c "import jax; assert jax.devices()[0].platform in ('tpu','axon')" 2>/dev/null
 }

@@ -113,14 +113,37 @@ def config4_resnet_mfu(batch: int = 32, image: int = 224,
     rec = {"metric": "resnet50_infer", "value": sec, "unit": "s/batch",
            "images": batch, "images_per_s": batch / sec,
            "platform": jax.default_backend()}
-    compiled, flops = _compile_with_flops(
-        lambda p, x: model.apply(p, x), params, imgs)
-    if compiled is not None:
-        dev_sec = _steady_state(compiled, params, imgs)
+    # STAGED device-resident path: six per-stage compiles instead of one
+    # ResNet-sized module — the single-module remote_compile has broken
+    # the tunnel relay mid-response (r3); the chain's composition equals
+    # apply(), so FLOPs and MFU are the same math
+    compiled_stages = []
+    flops = 0.0
+    x = jax.device_put(imgs)
+    params_dev = jax.device_put(params)
+    ok = True
+    for i, f in enumerate(model.stage_fns()):
+        comp, fl = _compile_with_flops(f, params_dev, x)
+        if comp is None:
+            ok = False
+            break
+        compiled_stages.append(comp)
+        flops += fl
+        x = comp(params_dev, x)  # doubles as the warmup pass
+    if ok:
+        jax.block_until_ready(x)
+
+        def chain(p, a):
+            for comp in compiled_stages:
+                a = comp(p, a)
+            return a
+
+        dev_sec = _steady_state(chain, params_dev, imgs)
         rec.update(
             device_resident_s_per_batch=dev_sec,
             device_resident_images_per_s=batch / dev_sec,
             flops_per_batch=flops,
+            staged_compiles=len(compiled_stages),
             mfu=round(_mfu(flops, dev_sec), 4) if flops else None)
     return rec
 

@@ -80,7 +80,13 @@ int main(int argc, char** argv) {
     return 2;
   }
   const char* blob_path = argv[1];
-  const long rows = std::strtol(argv[2], nullptr, 10);
+  char* rows_end = nullptr;
+  const long rows = std::strtol(argv[2], &rows_end, 10);
+  if (rows_end == argv[2] || *rows_end != '\0' || rows <= 0) {
+    std::fprintf(stderr, "rows must be a positive integer, got %s\n",
+                 argv[2]);
+    return 2;
+  }
 
   std::ifstream f(blob_path, std::ios::binary);
   if (!f) {
@@ -112,6 +118,12 @@ int main(int argc, char** argv) {
       scan_string_list_csv(header, "arg_dtypes");  // first entry wins below
   if (module_len < 0 || cc_version < 0) {
     std::fprintf(stderr, "blob has no native section (pre-native format?)\n");
+    return 2;
+  }
+  if (payload_off + static_cast<size_t>(module_len) > blob.size()) {
+    std::fprintf(stderr,
+                 "truncated TFTPU1 blob (module section says %ld bytes)\n",
+                 module_len);
     return 2;
   }
   std::string first_dtype = arg_dtype_name.substr(

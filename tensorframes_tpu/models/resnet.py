@@ -110,18 +110,48 @@ class ResNet50:
         return jax.nn.relu(x + y)
 
     def apply(self, params: Params, images: jax.Array) -> jax.Array:
-        """images: [N, H, W, 3] (NHWC) -> logits [N, num_classes]."""
-        x = images.astype(self.dtype)
-        x = jax.nn.relu(_affine(_conv(x, params["stem"]["conv"], 2),
-                                params["stem"]["bn"]))
-        x = jax.lax.reduce_window(
-            x, -jnp.inf, jax.lax.max, (1, 3, 3, 1), (1, 2, 2, 1), "SAME")
-        for stage_i, stage in enumerate(params["stages"]):
-            for block_i, blk in enumerate(stage):
-                stride = 2 if (block_i == 0 and stage_i > 0) else 1
-                x = self._bottleneck(x, blk, stride)
-        x = jnp.mean(x, axis=(1, 2))  # global average pool
-        return x @ params["head"]["w"] + params["head"]["b"]
+        """images: [N, H, W, 3] (NHWC) -> logits [N, num_classes].
+
+        Defined as the composition of :meth:`stage_fns`, so the staged
+        (per-stage-compiled) path can never diverge from this one."""
+        x = images
+        for f in self.stage_fns():
+            x = f(params, x)
+        return x
+
+    def stage_fns(self):
+        """The forward pass as a chain of per-stage callables
+        ``f(params, x) -> x`` whose composition equals :meth:`apply`.
+
+        Staged compilation exists for relay-fragile transports: shipping
+        ResNet-50 as ONE StableHLO module has broken this environment's
+        tunnelled `remote_compile` mid-response (BASELINE.md config 4,
+        r3); six ~5x-smaller payloads survive where one large one dies,
+        and with the persistent compilation cache a dropped attempt
+        resumes from the stages already compiled instead of from zero.
+        """
+        def stem(params, x):
+            x = x.astype(self.dtype)
+            x = jax.nn.relu(_affine(_conv(x, params["stem"]["conv"], 2),
+                                    params["stem"]["bn"]))
+            return jax.lax.reduce_window(
+                x, -jnp.inf, jax.lax.max, (1, 3, 3, 1), (1, 2, 2, 1),
+                "SAME")
+
+        def make_stage(stage_i):
+            def stage(params, x):
+                for block_i, blk in enumerate(params["stages"][stage_i]):
+                    stride = 2 if (block_i == 0 and stage_i > 0) else 1
+                    x = self._bottleneck(x, blk, stride)
+                return x
+            return stage
+
+        def head(params, x):
+            x = jnp.mean(x, axis=(1, 2))
+            return x @ params["head"]["w"] + params["head"]["b"]
+
+        return [stem] + [make_stage(i) for i in range(len(_STAGES))] \
+            + [head]
 
     # -- DataFrame formulation (the BASELINE workload) ----------------------
     def infer_via_frame(self, params: Params, df, image_col: str = "image",

@@ -81,6 +81,20 @@ class TestResNet50:
         logits2 = jax.jit(model.apply)(params, jnp.ones((2, 32, 32, 3)))
         np.testing.assert_allclose(np.asarray(logits), np.asarray(logits2))
 
+    def test_stage_chain_equals_apply(self):
+        # staged compilation (relay-survivable config 4): composing the
+        # per-stage callables must be bit-identical to apply()
+        model = ResNet50(num_classes=7)
+        params = model.init()
+        x = jnp.linspace(-1, 1, 2 * 32 * 32 * 3).reshape(
+            (2, 32, 32, 3)).astype(jnp.float32)
+        full = jax.jit(model.apply)(params, x)
+        y = x
+        for f in model.stage_fns():
+            y = jax.jit(f)(params, y)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(full),
+                                   rtol=1e-6, atol=1e-6)
+
     def test_infer_via_frame(self, rng):
         model = ResNet50(num_classes=5)
         params = model.init()

@@ -8,9 +8,7 @@ the Python driver and runs it through the C ABI (``tfrpjrt.h``).
 
 import os
 import subprocess
-import sys
 
-import numpy as np
 import pytest
 
 NATIVE = os.path.join(os.path.dirname(os.path.dirname(
