@@ -551,24 +551,21 @@ def _dsort_local(dist, keys, descending, tensor_names, arrays, valid_dev,
     if fn is None:
         def program(valid, *cols):
             named = dict(zip(tensor_names, cols))
-            order = None
-            # stable argsort chain, LAST key first -> first key primary
-            for k in reversed(keys):
-                kv = _key_transform(named[k], descending)
-                if order is not None:
-                    kv = jnp.take(kv, order, axis=0)
-                    step = jnp.argsort(kv, stable=True)
-                    order = jnp.take(order, step, axis=0)
-                else:
-                    order = jnp.argsort(kv, stable=True)
-            # final primary pass: pad/invalid rows sink stably to the tail.
-            # No value sentinel is involved, so real rows keyed NaN / +inf /
-            # iinfo.max cannot be displaced into the pad region — NaNs end
-            # up last WITHIN the valid prefix (argsort's NaN ordering),
-            # pads strictly after.
-            inv = jnp.take((~valid).astype(jnp.int8), order, axis=0)
-            step = jnp.argsort(inv, stable=True)
-            order = jnp.take(order, step, axis=0)
+            n = valid.shape[0]
+            # ONE fused lexicographic lax.sort: (invalid, keys...,
+            # original position). The validity flag is the primary key so
+            # pad/invalid rows sink stably to the tail with no value
+            # sentinel — real rows keyed NaN / +inf / iinfo.max cannot be
+            # displaced into the pad region (NaNs end up last WITHIN the
+            # valid prefix, XLA's float total order), pads strictly
+            # after. The position key makes the tuple a total order, so
+            # ties keep original order (stable).
+            pos = jnp.arange(n)
+            ops = ((~valid).astype(jnp.int8),) + tuple(
+                _key_transform(named[k], descending) for k in keys
+            ) + (pos,)
+            sorted_ops = jax.lax.sort(ops, num_keys=len(ops))
+            order = sorted_ops[-1]
             outs = tuple(jnp.take(c, order, axis=0) for c in cols)
             return outs + ((order,) if want_order else ())
 
@@ -624,17 +621,23 @@ def _dsort_columnsort(dist, keys, descending, tensor_names, arrays,
         key_idx = [tensor_names.index(k) for k in keys]
 
         def colsort(flag, rowid, cols):
-            """One column (shard-local) sort by (flag, keys..., rowid)."""
-            order = jnp.argsort(rowid, stable=True)
-            for ki in reversed(key_idx):
-                kv = jnp.take(_key_transform(cols[ki], descending), order,
-                              axis=0)
-                order = jnp.take(order, jnp.argsort(kv, stable=True),
-                                 axis=0)
-            fl = jnp.take(flag, order, axis=0)
-            order = jnp.take(order, jnp.argsort(fl, stable=True), axis=0)
-            return (jnp.take(flag, order, axis=0),
-                    jnp.take(rowid, order, axis=0),
+            """One column (shard-local) sort by (flag, keys..., rowid).
+
+            ONE fused ``lax.sort`` with ``num_keys`` (XLA sorts the
+            lexicographic tuple in a single pass) instead of a stable
+            argsort-per-key chain — the chain cost K+2 sorts plus
+            gathers per step and dominated the columnsort wall. rowid is
+            unique, so the tuple is a total order and stability is
+            implied. Payload columns (incl. vector cells, which XLA Sort
+            cannot carry alongside rank-1 keys) gather through the
+            sorted positions."""
+            m = flag.shape[0]
+            ops = (flag,) + tuple(
+                _key_transform(cols[ki], descending) for ki in key_idx
+            ) + (rowid, jnp.arange(m, dtype=rowid.dtype))
+            sorted_ops = jax.lax.sort(ops, num_keys=len(ops) - 1)
+            order = sorted_ops[-1]
+            return (sorted_ops[0], sorted_ops[-2],
                     [jnp.take(c, order, axis=0) for c in cols])
 
         def deal(a):
