@@ -414,8 +414,14 @@ def dfilter(predicate, dist: DistributedFrame) -> DistributedFrame:
     key = (mesh.mesh, axis, rows_per,
            tuple((n, a.shape, str(a.dtype))
                  for n, a in zip(tensor_names, arrays)))
-    fn = cache.get(key)
-    if fn is None:
+
+    in_specs = (P(axis),) + tuple(
+        P(axis, *([None] * (a.ndim - 1))) for a in arrays)
+    out_specs = tuple(
+        P(axis, *([None] * (a.ndim - 1))) for a in arrays
+    ) + (P(axis), P(axis))
+
+    def build_prog():
         def shard_fn(cnt, *cols):
             local = dict(zip(tensor_names, cols))
             m = comp.fn({n: local[n] for n in in_names})[pname]
@@ -425,17 +431,37 @@ def dfilter(predicate, dist: DistributedFrame) -> DistributedFrame:
             permuted = tuple(jnp.take(c, order, axis=0) for c in cols)
             return permuted + (jnp.sum(keep, dtype=jnp.int32)[None], keep)
 
-        in_specs = (P(axis),) + tuple(
-            P(axis, *([None] * (a.ndim - 1))) for a in arrays)
-        out_specs = tuple(
-            P(axis, *([None] * (a.ndim - 1))) for a in arrays
-        ) + (P(axis), P(axis))
-        fn = jax.jit(shard_map(shard_fn, mesh=mesh.mesh,
-                               in_specs=in_specs, out_specs=out_specs))
-        cache[key] = fn
+        return shard_map(shard_fn, mesh=mesh.mesh, in_specs=in_specs,
+                         out_specs=out_specs)
 
-    with span("dfilter.dispatch"):
-        outs = fn(cnt_dev, *arrays)
+    # TFT_EXECUTOR=pjrt: per-shard mask + compaction as one GSPMD
+    # executable in the native core
+    outs = None
+    nm = _native_mesh(mesh)
+    if nm is not None:
+        in_shardings = (mesh.row_sharding(1),) + tuple(
+            mesh.row_sharding(a.ndim) for a in arrays)
+        out_shardings = tuple(
+            mesh.row_sharding(a.ndim) for a in arrays
+        ) + (mesh.row_sharding(1), mesh.row_sharding(1))
+        try:
+            outs_np = nm.run_sharded(
+                ("dfilter",) + key, build_prog,
+                [cnt_dev] + list(arrays), in_shardings,
+                list(out_shardings), mesh, owner=comp)
+        except Exception as e:
+            _native_mesh_fallback(e)
+            outs_np = None
+        if outs_np is not None:
+            outs = [jax.device_put(a, s)
+                    for a, s in zip(outs_np, out_shardings)]
+    if outs is None:
+        fn = cache.get(key)
+        if fn is None:
+            fn = jax.jit(build_prog())
+            cache[key] = fn
+        with span("dfilter.dispatch"):
+            outs = fn(cnt_dev, *arrays)
     new_cols: Dict[str, jax.Array] = dict(zip(tensor_names, outs))
     counts = _read_global(outs[len(tensor_names)]).astype(np.int64)
     if host_names:
@@ -616,8 +642,8 @@ def _dsort_columnsort(dist, keys, descending, tensor_names, arrays,
     ckey = ("columnsort", mesh.mesh, tuple(keys), descending, want_order,
             rp, tuple((n, a.shape, str(a.dtype))
                       for n, a in zip(tensor_names, arrays)))
-    fn = _dsort_cache.get(ckey)
-    if fn is None:
+
+    def build_full():
         key_idx = [tensor_names.index(k) for k in keys]
 
         def colsort(flag, rowid, cols):
@@ -731,10 +757,34 @@ def _dsort_columnsort(dist, keys, descending, tensor_names, arrays,
             # rows, so the first `padded` rows ARE the frame's layout
             return tuple(o[:padded] for o in outs)
 
-        shardings = tuple(mesh.row_sharding(a.ndim) for a in arrays)
-        if want_order:
-            shardings = shardings + (mesh.row_sharding(1),)
-        fn = jax.jit(full, out_shardings=shardings)
+        return full
+
+    out_shardings = tuple(mesh.row_sharding(a.ndim) for a in arrays)
+    if want_order:
+        out_shardings = out_shardings + (mesh.row_sharding(1),)
+
+    # TFT_EXECUTOR=pjrt: the whole columnsort pipeline — local sorts AND
+    # the all_to_all/ppermute exchanges — compiles as one GSPMD
+    # executable in the native C++ core
+    nm = _native_mesh(mesh)
+    if nm is not None:
+        in_shardings = (mesh.row_sharding(1),) + tuple(
+            mesh.row_sharding(a.ndim) for a in arrays)
+        try:
+            outs_np = nm.run_sharded(
+                ("dsort",) + ckey[1:], build_full,
+                [valid_dev] + list(arrays), in_shardings,
+                list(out_shardings), mesh)
+        except Exception as e:
+            _native_mesh_fallback(e)
+            outs_np = None
+        if outs_np is not None:
+            return tuple(jax.device_put(a, s)
+                         for a, s in zip(outs_np, out_shardings))
+
+    fn = _dsort_cache.get(ckey)
+    if fn is None:
+        fn = jax.jit(build_full(), out_shardings=out_shardings)
         _dsort_cache[ckey] = fn
         while len(_dsort_cache) > _DSORT_CACHE_CAP:
             _dsort_cache.popitem(last=False)

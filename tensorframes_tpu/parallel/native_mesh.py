@@ -17,9 +17,12 @@ same logical programs ``dmap_blocks`` / ``dreduce_blocks`` build are
 
 Routing: ``TFT_EXECUTOR=pjrt`` (the same switch that routes the host
 engine through the native core) enables this path for single-process
-meshes; anything the native route cannot express (trim/global outputs,
-bfloat16/string columns, multi-host frames) falls back to the in-process
-jax dispatch with identical semantics. The device-resident benchmark loops
+meshes, covering row-aligned ``dmap_blocks``, the collective
+``dreduce_blocks``, the full ``dsort`` columnsort pipeline (local sorts
+AND all_to_all/ppermute exchanges in one executable), and ``dfilter`` —
+anything the native route cannot express (trim/global outputs,
+bfloat16 columns, multi-host frames) falls back to the in-process jax
+dispatch with identical semantics. The device-resident benchmark loops
 keep using the jax path — data staying in jax Arrays is the point there;
 the native mesh path demonstrates (and tests, cpu:4 parity vs jax) that
 the C ABI can host the sharded programs themselves.
@@ -152,6 +155,8 @@ class NativeMeshExecutor:
     @staticmethod
     def _assemble(shards: List[np.ndarray], sharding, shape, dtype,
                   dev_order) -> np.ndarray:
+        if getattr(sharding, "is_fully_replicated", False):
+            return shards[0]  # every device holds the whole array
         out = np.empty(shape, dtype)
         imap = sharding.devices_indices_map(shape)
         for piece, d in zip(shards, dev_order):
@@ -247,6 +252,101 @@ class NativeMeshExecutor:
                 oav.dtype, dev_order)
         return result
 
+    # -- generic sharded program -------------------------------------------
+    def run_sharded(self, cache_key, build_fn, host_args, in_shardings,
+                    out_shardings, mesh, owner=None, out_check=None):
+        """Compile-or-reuse ONE GSPMD program and execute it natively.
+
+        ``build_fn() -> traceable fn`` over positional args matching
+        ``host_args``/``in_shardings``; ``out_shardings`` is a list (or a
+        callable of the out avals returning one). ``out_check(out_avals)
+        -> bool`` vetoes routing from the abstract output shapes (e.g.
+        dmap's row-alignment requirement). Results come back as GLOBAL
+        numpy arrays assembled from the per-device shards. Returns
+        ``None`` when not routable — the verdict (including a FAILED
+        compile: a backend without a lowering for some collective must
+        not pay a full re-trace per call before the jax fallback) is
+        cached. ``owner`` (e.g. a live Computation) keys the cache on the
+        owning object instead of the executor-wide LRU, dying with it.
+        """
+        import jax
+
+        n_total = mesh.num_devices
+        with self._lock:
+            if owner is not None:
+                cache = getattr(owner, "_tft_native_mesh_cache", None)
+                if cache is None:
+                    cache = owner._tft_native_mesh_cache = OrderedDict()
+                cap = self.COMP_CACHE_CAP
+            else:
+                cache = self._cache
+                cap = self.CACHE_CAP
+            entry = cache.get(cache_key)
+            if entry is not None:
+                cache.move_to_end(cache_key)
+        if entry is _NOT_ROUTABLE:
+            return None
+        host_args = [np.asarray(a) for a in host_args]
+        if entry is None:
+            fn = build_fn()
+            avals = [jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s)
+                     for a, s in zip(host_args, in_shardings)]
+            routable = all(self._supported(a.dtype) for a in host_args)
+            out_avals = out_sh = None
+            if routable:
+                out_avals = jax.eval_shape(fn, *avals)
+                if not isinstance(out_avals, (list, tuple)):
+                    out_avals = (out_avals,)
+                routable = all(self._supported(o.dtype)
+                               for o in out_avals)
+                if routable and out_check is not None:
+                    routable = bool(out_check(out_avals))
+                if routable:
+                    out_sh = (out_shardings(out_avals)
+                              if callable(out_shardings)
+                              else out_shardings)
+            if not routable:
+                with self._lock:
+                    self._cache_put(cache, cache_key, _NOT_ROUTABLE, cap)
+                return None
+            with self._lock:
+                entry = cache.get(cache_key)
+                if entry is None or entry is _NOT_ROUTABLE:
+                    try:
+                        with _shardy_off():
+                            # out_shardings FORCED: ops that post-process
+                            # a shard_map result (e.g. dsort's global
+                            # slice) would otherwise let GSPMD pick
+                            # replicated outputs, and the per-device
+                            # buffers would not be the shards the
+                            # assembler expects
+                            text = jax.jit(
+                                fn, out_shardings=tuple(out_sh),
+                            ).lower(*avals).as_text().encode()
+                        exe = self.client.compile_spmd(text, n_total)
+                    except Exception:
+                        # latch: don't re-trace/re-lower on every call
+                        # just to fail again
+                        self._cache_put(cache, cache_key, _NOT_ROUTABLE,
+                                        cap)
+                        raise
+                    entry = (exe, out_avals, out_sh)
+                    self._cache_put(cache, cache_key, entry, cap)
+                    self.compile_count += 1
+        exe, out_avals, out_sh = entry
+        dev_order = list(mesh.mesh.devices.flat)
+        per_arg = [self._split(a, s, dev_order)
+                   for a, s in zip(host_args, in_shardings)]
+        args_per_dev = [[shards[p] for shards in per_arg]
+                        for p in range(n_total)]
+        with span("native_mesh.sharded_dispatch"):
+            outs = exe.execute(args_per_dev)
+        result = [self._assemble([outs[p][i] for p in range(n_total)],
+                                 sh, oav.shape, oav.dtype, dev_order)
+                  for i, (oav, sh) in enumerate(zip(out_avals, out_sh))]
+        self.dispatch_count += 1  # after assembly: failures don't count
+        return result
+
     # -- collective reduce -------------------------------------------------
     def dreduce_collective(self, shard_fn, in_specs, names, dist,
                            nv_host: np.ndarray, cache_key
@@ -257,58 +357,22 @@ class NativeMeshExecutor:
         specs the jax path wraps in ``shard_map`` — one source of truth
         for masking/combiner semantics. ``cache_key`` is the caller's
         stable program key (the ``_collective_cache`` key: mesh + columns
-        + combiners + shapes). Outputs are replicated; device 0's copy is
-        returned (one numpy array per reduced column).
+        + combiners + shapes). Outputs are replicated (one numpy array
+        per reduced column).
         """
-        import jax
         from jax import shard_map
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         mesh = dist.mesh
-        n_total = mesh.num_devices
-        key = ("dreduce", cache_key)
-        with self._lock:
-            entry = self._cache.get(key)
-            if entry is not None:
-                self._cache.move_to_end(key)
-        if entry is _NOT_ROUTABLE:
-            return None
-        arrays_host = [np.asarray(dist.columns[n]) for n in names]
         in_shardings = [NamedSharding(mesh.mesh, s) for s in in_specs]
-        host_args = [nv_host.astype(np.int32)] + arrays_host
-        if entry is None:
-            avals = [jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s)
-                     for a, s in zip(host_args, in_shardings)]
-            out_specs = tuple(P() for _ in names)
-            prog = shard_map(shard_fn, mesh=mesh.mesh,
-                             in_specs=tuple(in_specs), out_specs=out_specs)
-            routable = all(self._supported(a.dtype) for a in arrays_host)
-            if routable:
-                out_avals = jax.eval_shape(prog, *avals)
-                routable = all(self._supported(o.dtype)
-                               for o in out_avals)
-            if not routable:
-                with self._lock:
-                    self._cache_put(self._cache, key, _NOT_ROUTABLE,
-                                    self.CACHE_CAP)
-                return None
-            with self._lock:
-                entry = self._cache.get(key)
-                if entry is None or entry is _NOT_ROUTABLE:
-                    with _shardy_off():
-                        text = jax.jit(prog).lower(
-                            *avals).as_text().encode()
-                    exe = self.client.compile_spmd(text, n_total)
-                    entry = (exe,)
-                    self._cache_put(self._cache, key, entry,
-                                    self.CACHE_CAP)
-                    self.compile_count += 1
-        dev_order = list(mesh.mesh.devices.flat)
-        per_arg = [self._split(a, s, dev_order)
-                   for a, s in zip(host_args, in_shardings)]
-        args_per_dev = [[shards[p] for shards in per_arg]
-                        for p in range(n_total)]
-        with span("native_mesh.dreduce_dispatch"):
-            outs = entry[0].execute(args_per_dev)
-        self.dispatch_count += 1
-        return list(outs[0])  # replicated outputs: device 0's copy
+        host_args = [nv_host.astype(np.int32)] + [dist.columns[n]
+                                                 for n in names]
+
+        def build():
+            return shard_map(shard_fn, mesh=mesh.mesh,
+                             in_specs=tuple(in_specs),
+                             out_specs=tuple(P() for _ in names))
+
+        out_shardings = [NamedSharding(mesh.mesh, P()) for _ in names]
+        return self.run_sharded(("dreduce", cache_key), build, host_args,
+                                in_shardings, out_shardings, mesh)

@@ -159,6 +159,48 @@ class TestNativeDreduce:
         np.testing.assert_array_equal(native["x"], ref["x"])
 
 
+class TestNativeDsortDfilter:
+    def test_dsort_parity_with_jax_path(self, mesh4, pjrt_routing):
+        import os
+
+        rng = np.random.default_rng(21)
+        x = rng.normal(size=600)
+        x[::71] = np.nan
+        dist = par.distribute(tft.frame({"x": x}), mesh4)
+        ex = _executor(mesh4)
+        before = ex.dispatch_count
+        out = par.dsort("x", dist, descending=True)
+        assert ex.dispatch_count == before + 1  # columnsort ran natively
+        got = np.asarray(out.columns["x"])
+        os.environ.pop("TFT_EXECUTOR", None)
+        ref = par.dsort("x", par.distribute(tft.frame({"x": x}), mesh4),
+                        descending=True)
+        np.testing.assert_array_equal(got, np.asarray(ref.columns["x"]))
+
+    def test_dsort_collect_with_string_riders(self, mesh4, pjrt_routing):
+        k = np.array([f"s{i}" for i in range(10)], object)
+        x = np.arange(10, dtype=np.float64)[::-1].copy()
+        dist = par.distribute(tft.frame({"k": k, "x": x}), mesh4)
+        rows = par.dsort("x", dist).collect_frame().collect()
+        assert [r["k"] for r in rows] == [f"s{i}" for i in range(9, -1, -1)]
+
+    def test_dfilter_parity_and_chain(self, mesh4, pjrt_routing):
+        x = np.arange(40, dtype=np.float64)
+        dist = par.distribute(tft.frame({"x": x}), mesh4)
+        ex = _executor(mesh4)
+        before = ex.dispatch_count
+        flt = par.dfilter(lambda x: x % 3.0 == 0.0, dist)
+        assert ex.dispatch_count == before + 1
+        assert flt.count() == 14
+        # chain into a native reduce and a native sort
+        red = par.dreduce_blocks({"x": "sum"}, flt.select("x"))
+        np.testing.assert_allclose(red["x"], x[x % 3 == 0].sum())
+        srt = par.dsort("x", flt, descending=True)
+        rows = srt.collect_frame().collect()
+        assert [r["x"] for r in rows] == sorted(
+            x[x % 3 == 0].tolist(), reverse=True)
+
+
 class TestRoutingGuards:
     def test_off_without_env(self, mesh4, monkeypatch):
         monkeypatch.delenv("TFT_EXECUTOR", raising=False)
