@@ -40,6 +40,7 @@ from ..frame import Block, TensorFrame
 from ..schema import Schema
 from .collectives import COMBINERS
 from .mesh import DeviceMesh
+from ..utils.logging import get_logger
 from ..utils.tracing import span
 
 __all__ = ["DistributedFrame", "distribute", "dmap_blocks", "dfilter",
@@ -534,15 +535,22 @@ def dsort(keys, dist: DistributedFrame, descending: bool = False
     arrays = [dist.columns[n] for n in tensor_names]
 
     valid_host = dist.valid_row_mask()
-    valid_dev = jax.make_array_from_callback(
-        (dist.padded_rows,), mesh.row_sharding(1),
-        lambda idx: valid_host[idx])
+    if dist.padded_rows % S == 0:
+        valid_dev = jax.make_array_from_callback(
+            (dist.padded_rows,), mesh.row_sharding(1),
+            lambda idx: valid_host[idx])
+    else:
+        # non-tiling (trim/global-result) frames cannot carry an evenly
+        # row-sharded mask; the local program runs replicated for them
+        valid_dev = jax.device_put(valid_host, mesh.replicated())
 
     want_order = bool(host_names)
     if S > 1 and dist.padded_rows % S == 0:
         outs = _dsort_columnsort(dist, keys, descending, tensor_names,
                                  arrays, valid_dev, want_order)
     else:
+        if S > 1:
+            _warn_dsort_gather(dist, S)
         outs = _dsort_local(dist, keys, descending, tensor_names, arrays,
                             valid_dev, want_order)
     new_cols: Dict[str, jax.Array] = dict(zip(tensor_names, outs))
@@ -551,6 +559,29 @@ def dsort(keys, dist: DistributedFrame, descending: bool = False
         for n in host_names:
             new_cols[n] = dist.columns[n][order_host]
     return DistributedFrame(mesh, schema, new_cols, dist.num_rows)
+
+
+_dsort_gather_warned = False
+
+
+def _warn_dsort_gather(dist, S: int):
+    """Warn ONCE when a multi-shard frame takes the local-argsort program.
+
+    The local program's GSPMD lowering gathers the key column to every
+    device — the exact pathology columnsort exists to kill — so its
+    silent return on an S>1 mesh (rows not tiling the data axis, e.g. a
+    trim/global map result) must be visible. One warning per process,
+    like the native-mesh fallback."""
+    global _dsort_gather_warned
+    if _dsort_gather_warned:
+        return
+    get_logger("dsort").warning(
+        "dsort on a %d-shard mesh fell back to the global-argsort program "
+        "because the frame's %d rows do not tile the data axis — GSPMD "
+        "will gather the key column to every device. Pad or repartition "
+        "the frame to a multiple of the shard count to get columnsort "
+        "(warned once)", S, dist.padded_rows)
+    _dsort_gather_warned = True
 
 
 def _key_transform(kv, descending: bool):
@@ -595,9 +626,17 @@ def _dsort_local(dist, keys, descending, tensor_names, arrays, valid_dev,
             outs = tuple(jnp.take(c, order, axis=0) for c in cols)
             return outs + ((order,) if want_order else ())
 
-        shardings = tuple(mesh.row_sharding(a.ndim) for a in arrays)
+        if dist.padded_rows % mesh.num_data_shards == 0:
+            shard_of = mesh.row_sharding
+        else:
+            # uneven row counts cannot be expressed as a row sharding
+            # (jit rejects non-divisible out_shardings); these frames are
+            # small global results, so replication is the honest layout
+            def shard_of(_ndim):
+                return mesh.replicated()
+        shardings = tuple(shard_of(a.ndim) for a in arrays)
         if want_order:
-            shardings = shardings + (mesh.row_sharding(1),)
+            shardings = shardings + (shard_of(1),)
         fn = jax.jit(program, out_shardings=shardings)
         _dsort_cache[ckey] = fn
         while len(_dsort_cache) > _DSORT_CACHE_CAP:
@@ -699,13 +738,22 @@ def _dsort_columnsort(dist, keys, descending, tensor_names, arrays,
                 [c, jnp.zeros((pad_n,) + c.shape[1:], c.dtype)])
                 for c in cols]
 
-            flag, rowid, cs = colsort(flag, rowid, cs)          # 1
-            flag, rowid = deal(flag), deal(rowid)               # 2
-            cs = [deal(c) for c in cs]
-            flag, rowid, cs = colsort(flag, rowid, cs)          # 3
-            flag, rowid = undeal(flag), undeal(rowid)           # 4
-            cs = [undeal(c) for c in cs]
-            flag, rowid, cs = colsort(flag, rowid, cs)          # 5
+            # named_scope per step: the whole pipeline is ONE compiled
+            # program, so host spans cannot see the rounds — the scopes
+            # label them in jax profiler traces instead (the measured
+            # per-step costs live in benchmarks/dsort_steps_bench.py)
+            with jax.named_scope("columnsort.s1_sort"):
+                flag, rowid, cs = colsort(flag, rowid, cs)      # 1
+            with jax.named_scope("columnsort.s2_deal"):
+                flag, rowid = deal(flag), deal(rowid)           # 2
+                cs = [deal(c) for c in cs]
+            with jax.named_scope("columnsort.s3_sort"):
+                flag, rowid, cs = colsort(flag, rowid, cs)      # 3
+            with jax.named_scope("columnsort.s4_undeal"):
+                flag, rowid = undeal(flag), undeal(rowid)       # 4
+                cs = [undeal(c) for c in cs]
+            with jax.named_scope("columnsort.s5_sort"):
+                flag, rowid, cs = colsort(flag, rowid, cs)      # 5
 
             # 6: shifted column = [prev shard's bottom | own top]. Shard 0
             # receives no message and must see a MIN sentinel half: flags
@@ -713,15 +761,18 @@ def _dsort_columnsort(dist, keys, descending, tensor_names, arrays,
             # (< every real flag) while real flags restore exactly. The
             # sentinel rows sort to shard 0's B1 top, which step 8 never
             # reads (only B1 bottoms and RIGHTWARD-shifted tops survive).
-            prev_flag = (jax.lax.ppermute(flag[h:] + jnp.int8(16), axis,
-                                          fwd) - jnp.int8(16))
-            b1_flag = jnp.concatenate([prev_flag, flag[:h]])
-            b1_rowid = jnp.concatenate(
-                [jax.lax.ppermute(rowid[h:], axis, fwd), rowid[:h]])
-            b1_cs = [jnp.concatenate(
-                [jax.lax.ppermute(c[h:], axis, fwd), c[:h]])
-                for c in cs]
-            b1_flag, b1_rowid, b1_cs = colsort(b1_flag, b1_rowid, b1_cs)  # 7
+            with jax.named_scope("columnsort.s6_shift"):
+                prev_flag = (jax.lax.ppermute(
+                    flag[h:] + jnp.int8(16), axis, fwd) - jnp.int8(16))
+                b1_flag = jnp.concatenate([prev_flag, flag[:h]])
+                b1_rowid = jnp.concatenate(
+                    [jax.lax.ppermute(rowid[h:], axis, fwd), rowid[:h]])
+                b1_cs = [jnp.concatenate(
+                    [jax.lax.ppermute(c[h:], axis, fwd), c[:h]])
+                    for c in cs]
+            with jax.named_scope("columnsort.s7_sort"):
+                b1_flag, b1_rowid, b1_cs = colsort(
+                    b1_flag, b1_rowid, b1_cs)                   # 7
             # the conceptual extra column S is [last shard's bottom | +inf
             # sentinel] — both parts already sorted, so it needs no sort
 
@@ -735,9 +786,10 @@ def _dsort_columnsort(dist, keys, descending, tensor_names, arrays,
                 bottom = jnp.where(last, own_step5[h:], nxt)
                 return jnp.concatenate([b1[h:], bottom])
 
-            out_flag = unshift(b1_flag, flag)
-            out_rowid = unshift(b1_rowid, rowid)
-            out_cs = [unshift(b, c) for b, c in zip(b1_cs, cs)]
+            with jax.named_scope("columnsort.s8_unshift"):
+                out_flag = unshift(b1_flag, flag)
+                out_rowid = unshift(b1_rowid, rowid)
+                out_cs = [unshift(b, c) for b, c in zip(b1_cs, cs)]
             del out_flag  # flags exist only to steer the sort
             return tuple(out_cs) + ((out_rowid,) if want_order else ())
 
@@ -1215,6 +1267,15 @@ def daggregate(fetches, dist: DistributedFrame, keys,
     the static group-table size; exceeding it raises. Composite keys
     combine per-key dense ids in a mixed-radix int32 space, which bounds
     the cap at ``(cap+1)^k < 2^31``.
+
+    Under ``TFT_EXECUTOR=pjrt`` the aggregation program runs in the
+    native C++ core, whose dispatch marshals ids and value columns
+    through host numpy per call (the documented correctness-proof
+    trade, ``native_mesh`` module docstring) — so the device-residency
+    promises above (values stay on their shards; ``max_groups`` keys
+    never visit the host) hold on the default jax dispatch, not on the
+    native route. Latency-sensitive iterative workloads should keep the
+    jax path for this op.
     """
     if isinstance(keys, str):
         keys = [keys]
@@ -1253,36 +1314,75 @@ def daggregate(fetches, dist: DistributedFrame, keys,
         P(axis, *([None] * (a.ndim - 1))) for a in arrays)
     out_specs = tuple(P() for _ in fetch_names)
 
-    def shard_fn(ids_local, *vals_local):
-        outs = []
-        for f, v in zip(fetch_names, vals_local):
-            cname = col_combiners[f]
-            if cname == "sum":
-                local = _segsum(v, ids_local, num_groups)
-            else:
-                # mask pad/out-of-range rows to the combiner's neutral and
-                # clamp their id to 0 so XLA's segment primitive sees only
-                # in-range indices
-                c = COMBINERS[cname]
-                valid = ids_local >= 0
-                vmask = valid.reshape((-1,) + (1,) * (v.ndim - 1))
-                neutral = jnp.asarray(c.neutral(v.dtype))
-                masked = jnp.where(vmask, v, neutral)
-                safe_ids = jnp.where(valid, ids_local, 0)
-                seg = {"min": jax.ops.segment_min,
-                       "max": jax.ops.segment_max,
-                       "prod": jax.ops.segment_prod}[cname]
-                local = seg(masked, safe_ids, num_segments=num_groups)
-                # a group absent from this shard holds the identity; for
-                # min/max that identity is +-inf, which the cross-shard
-                # collective absorbs (every group exists somewhere)
-            outs.append(COMBINERS[cname].collective(local, axis))
-        return tuple(outs)
+    def make_shard_fn(seg_impl):
+        def shard_fn(ids_local, *vals_local):
+            outs = []
+            for f, v in zip(fetch_names, vals_local):
+                cname = col_combiners[f]
+                if cname == "sum":
+                    local = _segsum(v, ids_local, num_groups, impl=seg_impl)
+                else:
+                    # mask pad/out-of-range rows to the combiner's neutral
+                    # and clamp their id to 0 so XLA's segment primitive
+                    # sees only in-range indices
+                    c = COMBINERS[cname]
+                    valid = ids_local >= 0
+                    vmask = valid.reshape((-1,) + (1,) * (v.ndim - 1))
+                    neutral = jnp.asarray(c.neutral(v.dtype))
+                    masked = jnp.where(vmask, v, neutral)
+                    safe_ids = jnp.where(valid, ids_local, 0)
+                    seg = {"min": jax.ops.segment_min,
+                           "max": jax.ops.segment_max,
+                           "prod": jax.ops.segment_prod}[cname]
+                    local = seg(masked, safe_ids, num_segments=num_groups)
+                    # a group absent from this shard holds the identity;
+                    # for min/max that identity is +-inf, which the
+                    # cross-shard collective absorbs (every group exists
+                    # somewhere)
+                outs.append(COMBINERS[cname].collective(local, axis))
+            return tuple(outs)
+        return shard_fn
 
-    fn = jax.jit(shard_map(shard_fn, mesh=mesh.mesh,
-                           in_specs=in_specs, out_specs=out_specs))
-    with span("daggregate.dispatch"):
-        tables = fn(ids_dev, *arrays)
+    # TFT_EXECUTOR=pjrt: the per-shard segment reduce + collective runs as
+    # ONE GSPMD executable in the native C++ core (the last mesh op to
+    # gain the route — reference property: every UDAF compaction ran in
+    # the C++ session, DebugRowOps.scala:617-662). The XLA scatter-add
+    # segment_sum flavor is forced: the Pallas flavor lowers to Mosaic
+    # custom calls the native core's backends cannot compile.
+    pkey = ("daggregate", mesh.mesh, axis, num_groups,
+            tuple((f, col_combiners[f]) for f in fetch_names),
+            tuple((a.shape, str(a.dtype)) for a in arrays))
+    tables = None
+    nm = _native_mesh(mesh)
+    if nm is not None:
+        def build_prog():
+            return shard_map(make_shard_fn("xla"), mesh=mesh.mesh,
+                             in_specs=in_specs, out_specs=out_specs)
+
+        in_shardings = [mesh.row_sharding(1)] + [
+            mesh.row_sharding(a.ndim) for a in arrays]
+        out_shardings = [mesh.replicated() for _ in fetch_names]
+        try:
+            tables = nm.run_sharded(pkey, build_prog,
+                                    [ids_dev] + list(arrays),
+                                    in_shardings, out_shardings, mesh)
+        except Exception as e:
+            _native_mesh_fallback(e)
+            tables = None
+    if tables is None:
+        # cache the jitted program (the closure is fresh per call, so
+        # jax's own jit cache would miss and retrace every dispatch)
+        fn = _collective_cache.get(pkey)
+        if fn is not None:
+            _collective_cache.move_to_end(pkey)
+        else:
+            fn = jax.jit(shard_map(make_shard_fn(None), mesh=mesh.mesh,
+                                   in_specs=in_specs, out_specs=out_specs))
+            _collective_cache[pkey] = fn
+            while len(_collective_cache) > _COLLECTIVE_CACHE_CAP:
+                _collective_cache.popitem(last=False)
+        with span("daggregate.dispatch"):
+            tables = fn(ids_dev, *arrays)
 
     if device_keys:
         cols, num_out = _device_key_columns(dist, keys, uniq_dev,
@@ -1402,6 +1502,35 @@ def _segmented_fold(comp, names, mesh: DeviceMesh, arrays, ids_dev,
         # at-least-once application of the computation (host parity for
         # single-row groups, where the scan never ran the combiner)
         return single_v(acc)
+
+    # TFT_EXECUTOR=pjrt: the whole generic-aggregation program — per-shard
+    # sort + segmented scan + scatter AND the cross-shard masked fold —
+    # compiles as one GSPMD executable in the native C++ core (cached on
+    # the Computation; un-routable programs latch to the jax path)
+    nm = _native_mesh(mesh)
+    if nm is not None:
+        def build_prog():
+            def prog(ids, *cols):
+                out = program(ids, *cols)
+                return tuple(out[f] for f in names)
+            return prog
+
+        in_shardings = [mesh.row_sharding(1)] + [
+            mesh.row_sharding(a.ndim) for a in arrays]
+        out_shardings = [mesh.replicated() for _ in names]
+        nkey = ("dagg_generic", mesh.mesh, axis, G,
+                tuple((f, a.shape, str(a.dtype))
+                      for f, a in zip(names, arrays)))
+        try:
+            outs = nm.run_sharded(nkey, build_prog,
+                                  [ids_dev] + list(arrays),
+                                  in_shardings, out_shardings, mesh,
+                                  owner=comp)
+        except Exception as e:
+            _native_mesh_fallback(e)
+            outs = None
+        if outs is not None:
+            return dict(zip(names, outs))
 
     cache = getattr(comp, "_tft_segfold_cache", None)
     if cache is None:

@@ -19,10 +19,14 @@ Routing: ``TFT_EXECUTOR=pjrt`` (the same switch that routes the host
 engine through the native core) enables this path for single-process
 meshes, covering row-aligned ``dmap_blocks``, the collective
 ``dreduce_blocks``, the full ``dsort`` columnsort pipeline (local sorts
-AND all_to_all/ppermute exchanges in one executable), and ``dfilter`` —
-anything the native route cannot express (trim/global outputs,
-bfloat16 columns, multi-host frames) falls back to the in-process jax
-dispatch with identical semantics. The device-resident benchmark loops
+AND all_to_all/ppermute exchanges in one executable), ``dfilter``, and
+both ``daggregate`` paths — the monoid segment-reduce (with the XLA
+scatter-add ``segment_sum`` flavor: the Pallas flavor lowers to Mosaic
+custom calls outside the native backends' vocabulary) and the generic
+sorted-scan fold — so every mesh op now reaches the C++ core. Anything
+the native route cannot express (trim/global outputs, bfloat16 columns,
+multi-host frames) falls back to the in-process jax dispatch with
+identical semantics. The device-resident benchmark loops
 keep using the jax path — data staying in jax Arrays is the point there;
 the native mesh path demonstrates (and tests, cpu:4 parity vs jax) that
 the C ABI can host the sharded programs themselves.

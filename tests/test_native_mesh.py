@@ -201,6 +201,105 @@ class TestNativeDsortDfilter:
             x[x % 3 == 0].tolist(), reverse=True)
 
 
+class TestNativeDaggregate:
+    """daggregate through the C++ core — the last mesh op to gain the
+    route (reference property: every UDAF compaction ran in the C++
+    session, ``DebugRowOps.scala:617-662``)."""
+
+    def test_monoid_parity_with_jax_path(self, mesh4, pjrt_routing):
+        import os
+
+        rng = np.random.default_rng(31)
+        n, g = 200, 17
+        keys = rng.integers(0, g, n).astype(np.int64)
+        vals = rng.normal(size=n)
+        df = tft.frame({"key": keys, "x": vals})
+        dist = par.distribute(df, mesh4)
+        ex = _executor(mesh4)
+        before = ex.dispatch_count
+        out = par.daggregate({"x": "sum"}, dist, "key")
+        assert ex.dispatch_count == before + 1  # native core ran it
+        got = {r["key"]: r["x"] for r in out.collect()}
+
+        os.environ.pop("TFT_EXECUTOR", None)
+        ref_out = par.daggregate({"x": "sum"},
+                                 par.distribute(df, mesh4), "key")
+        ref = {r["key"]: r["x"] for r in ref_out.collect()}
+        assert set(got) == set(ref)
+        for k in ref:  # same XLA, same partitioner -> identical floats
+            np.testing.assert_array_equal(got[k], ref[k])
+
+    def test_monoid_min_vector_column(self, mesh4, pjrt_routing):
+        rng = np.random.default_rng(32)
+        k = rng.integers(0, 5, 30).astype(np.int64)
+        v = rng.normal(size=(30, 2))
+        df = tft.analyze(tft.frame({"k": k, "v": v}))
+        dist = par.distribute(df, mesh4)
+        ex = _executor(mesh4)
+        before = ex.dispatch_count
+        out = par.daggregate({"v": "min"}, dist, "k")
+        assert ex.dispatch_count == before + 1
+        for r in out.collect():
+            np.testing.assert_allclose(
+                r["v"], v[k == r["k"]].min(axis=0), rtol=1e-12)
+
+    def test_device_key_composite_parity(self, mesh4, pjrt_routing):
+        # composite (mixed-radix) device-side keys: the key columns never
+        # visit the host; the aggregation program still runs natively
+        import os
+
+        rng = np.random.default_rng(33)
+        k1 = rng.integers(0, 4, 60).astype(np.int64)
+        k2 = rng.integers(0, 3, 60).astype(np.int64)
+        x = rng.normal(size=60)
+        df = tft.frame({"k1": k1, "k2": k2, "x": x})
+        dist = par.distribute(df, mesh4)
+        ex = _executor(mesh4)
+        before = ex.dispatch_count
+        out = par.daggregate({"x": "sum"}, dist, ["k1", "k2"],
+                             max_groups=16)
+        assert ex.dispatch_count > before
+        got = {(r["k1"], r["k2"]): r["x"] for r in out.collect()}
+
+        os.environ.pop("TFT_EXECUTOR", None)
+        ref_out = par.daggregate({"x": "sum"}, par.distribute(df, mesh4),
+                                 ["k1", "k2"])
+        ref = {(r["k1"], r["k2"]): r["x"] for r in ref_out.collect()}
+        assert set(got) == set(ref)
+        for k in ref:
+            np.testing.assert_allclose(got[k], ref[k], rtol=1e-12)
+
+    def test_generic_fold_runs_natively(self, mesh4, pjrt_routing):
+        # the arbitrary-computation (sorted-scan) path compiles as one
+        # GSPMD executable too
+        import os
+
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(34)
+        n = 120
+        k = rng.integers(0, 7, n).astype(np.int64)
+        v = rng.normal(size=n)
+
+        def fetch(v_input):
+            return {"v": jnp.sqrt((v_input ** 2).sum(0))}
+
+        df = tft.frame({"k": k, "v": v})
+        dist = par.distribute(df, mesh4)
+        ex = _executor(mesh4)
+        before = ex.dispatch_count
+        out = par.daggregate(fetch, dist, "k")
+        assert ex.dispatch_count == before + 1
+        got = {r["k"]: r["v"] for r in out.collect()}
+
+        os.environ.pop("TFT_EXECUTOR", None)
+        ref_out = par.daggregate(fetch, par.distribute(df, mesh4), "k")
+        ref = {r["k"]: r["v"] for r in ref_out.collect()}
+        assert set(got) == set(ref)
+        for key in ref:
+            np.testing.assert_array_equal(got[key], ref[key])
+
+
 class TestRoutingGuards:
     def test_off_without_env(self, mesh4, monkeypatch):
         monkeypatch.delenv("TFT_EXECUTOR", raising=False)

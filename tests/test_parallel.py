@@ -722,6 +722,36 @@ class TestColumnsort:
         want = sorted((v for v in x if v > 0), reverse=True)
         np.testing.assert_allclose([r["x"] for r in rows], want, rtol=0)
 
+    def test_gather_fallback_warns_once(self, mesh8, caplog, monkeypatch):
+        # a multi-shard frame whose rows do NOT tile the data axis takes
+        # the local-argsort program, whose GSPMD lowering gathers the key
+        # column — that silent return must warn (once), VERDICT r4 #4a
+        import logging
+
+        from tensorframes_tpu.parallel import distributed as _dist
+
+        monkeypatch.setattr(_dist, "_dsort_gather_warned", False)
+        x = np.arange(48.0)
+        dist = par.distribute(tft.frame({"x": x}), mesh8)
+        # trim/global map: 6 output rows on an 8-shard mesh
+        summary = par.dmap_blocks(
+            lambda x: {"s": -x[:6]}, dist, trim=True, row_aligned=False)
+        assert summary.padded_rows % mesh8.num_data_shards != 0
+        with caplog.at_level(logging.WARNING,
+                             logger="tensorframes_tpu.dsort"):
+            out = par.dsort("s", summary)
+            rows = out.collect_frame().collect()
+        assert [r["s"] for r in rows] == sorted((-x[:6]).tolist())
+        gather_warnings = [r for r in caplog.records
+                           if "gather" in r.message]
+        assert len(gather_warnings) == 1
+        # second call: warned once per process, no repeat
+        with caplog.at_level(logging.WARNING,
+                             logger="tensorframes_tpu.dsort"):
+            par.dsort("s", summary, descending=True)
+        assert len([r for r in caplog.records
+                    if "gather" in r.message]) == 1
+
     def test_vector_and_string_riders(self, mesh8):
         rng = np.random.default_rng(8)
         n = 300
