@@ -30,25 +30,35 @@ ROOT = os.path.dirname(HERE)
 sys.path.insert(0, ROOT)
 
 if __name__ == "__main__":
-    os.environ.setdefault(
-        "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
-    # a multi-device sweep needs the virtual CPU mesh (the TPU grant is
-    # one chip, and this image exports JAX_PLATFORMS=axon): force cpu
-    # unconditionally; the helper below applies it post-import too
-    os.environ["JAX_PLATFORMS"] = "cpu"
+    _argv_devices = int(sys.argv[2]) if len(sys.argv) > 2 else 8
+    if _argv_devices > 1:
+        # a multi-device sweep needs the virtual CPU mesh (the TPU grant
+        # is one chip, and this image exports JAX_PLATFORMS=axon): force
+        # cpu unconditionally; the helper below applies it post-import
+        # too. A 1-device run keeps the live platform so the chip suite
+        # can time the fused local sort on silicon.
+        os.environ.setdefault(
+            "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+        os.environ["JAX_PLATFORMS"] = "cpu"
 
 import jax  # noqa: E402
 
 from benchmarks._platform import force_cpu_if_requested  # noqa: E402
 
 
+def _block(r):
+    # DistributedFrame is not a pytree: block on its column arrays
+    cols = getattr(r, "columns", None)
+    jax.block_until_ready(list(cols.values())
+                          if isinstance(cols, dict) else r)
+
+
 def bench(fn, iters=20):
-    r = fn()
-    jax.block_until_ready(r)
+    _block(fn())
     t0 = time.perf_counter()
     for _ in range(iters):
         r = fn()
-    jax.block_until_ready(r)
+    _block(r)
     return (time.perf_counter() - t0) / iters
 
 
@@ -129,6 +139,7 @@ def main(n_rows: int = 1_000_000, n_dev: int = 8):
         "devices": S, "model_s": model,
         "model_ratio": full / model if model else None,
         "rows_per_s": n_rows / full,
+        "platform": jax.devices()[0].platform,
     }))
     return out, full, model
 

@@ -63,5 +63,8 @@ run baseline_1_2_3 500 python benchmarks/run_tpu_baselines.py 1 2 3
 run baseline_4     580 python benchmarks/run_tpu_baselines.py 4
 run baseline_5     580 python benchmarks/run_tpu_baselines.py 5
 run daggregate     580 python benchmarks/daggregate_bench.py 1000000 100000
+# 1-device run keeps the live platform: the fused local-sort round's
+# chip-side constant (columnsort's cost model, BASELINE.md)
+run dsort_local    400 python benchmarks/dsort_steps_bench.py 1000000 1
 run headline       580 python bench.py
 echo "chip suite complete; results in $OUT"

@@ -176,11 +176,22 @@ int take_device_ordinal(std::vector<SpecOption>* opts) {
 // Backend interface
 // ---------------------------------------------------------------------------
 
+// A device-resident buffer detached from a results set; passed back as
+// an execution input to keep loop state in device memory across
+// dispatches (no host round-trip per call).
+struct BufIface {
+  virtual ~BufIface() = default;
+  virtual int meta(int* dtype, int* ndim, long long* dims) const = 0;
+};
+
 struct ResultsIface {
   virtual ~ResultsIface() = default;
   virtual int count() const = 0;
   virtual int meta(int i, int* dtype, int* ndim, long long* dims) const = 0;
   virtual int read(int i, void* dst, long long nbytes, std::string* err) = 0;
+  // Detach slot i as a standalone device buffer (slot becomes empty);
+  // nullptr on out-of-range / already-released slots.
+  virtual BufIface* release(int i) = 0;
 };
 
 struct ExeIface {
@@ -219,7 +230,17 @@ struct ClientIface {
   virtual ResultsIface* execute_replicated(
       ExeIface* exe, int n_replicas, int nargs, const int* dtypes,
       const int* ndims, const long long* dims, const void* const* data,
-      std::string* err) = 0;
+      std::string* err) {
+    return execute_replicated_mixed(exe, n_replicas, nargs, dtypes, ndims,
+                                    dims, data, nullptr, err);
+  }
+  // As execute_replicated, but a non-null dev_bufs[r*nargs + a] entry is
+  // used as that slot's input directly (device-resident, not consumed —
+  // the caller still owns it); the matching data entry is ignored.
+  virtual ResultsIface* execute_replicated_mixed(
+      ExeIface* exe, int n_replicas, int nargs, const int* dtypes,
+      const int* ndims, const long long* dims, const void* const* data,
+      BufIface* const* dev_bufs, std::string* err) = 0;
 };
 
 long long dense_elems(int ndim, const long long* dims) {
@@ -324,13 +345,33 @@ struct CppExe : ExeIface {
   std::unique_ptr<xla::PjRtLoadedExecutable> exe;
 };
 
+struct CppBuf : BufIface {
+  std::unique_ptr<xla::PjRtBuffer> buf;
+
+  int meta(int* dtype, int* ndim, long long* dims) const override {
+    *dtype = from_xla_type(buf->element_type());
+    auto d = buf->dimensions();
+    if (d.size() > 8) return 2;
+    *ndim = static_cast<int>(d.size());
+    for (size_t k = 0; k < d.size(); ++k) dims[k] = d[k];
+    return 0;
+  }
+};
+
 struct CppResults : ResultsIface {
   std::vector<std::unique_ptr<xla::PjRtBuffer>> bufs;
 
   int count() const override { return static_cast<int>(bufs.size()); }
 
+  BufIface* release(int i) override {
+    if (i < 0 || i >= count() || !bufs[i]) return nullptr;
+    auto* b = new CppBuf();
+    b->buf = std::move(bufs[i]);  // slot left empty; meta/read now fail
+    return b;
+  }
+
   int meta(int i, int* dtype, int* ndim, long long* dims) const override {
-    if (i < 0 || i >= count()) return 1;
+    if (i < 0 || i >= count() || !bufs[i]) return 1;
     const auto& b = bufs[i];
     *dtype = from_xla_type(b->element_type());
     auto d = b->dimensions();
@@ -341,7 +382,10 @@ struct CppResults : ResultsIface {
   }
 
   int read(int i, void* dst, long long nbytes, std::string* err) override {
-    if (i < 0 || i >= count()) { *err = "result index out of range"; return 1; }
+    if (i < 0 || i >= count() || !bufs[i]) {
+      *err = "result index out of range or buffer released";
+      return 1;
+    }
     auto& b = bufs[i];
     auto sz = b->GetOnDeviceSizeInBytes();
     if (!sz.ok()) { *err = sz.status().ToString(); return 1; }
@@ -431,11 +475,13 @@ struct CppClient : ClientIface {
     return compile_xla(std::move(xc), err, /*n_replicas=*/1, n_partitions);
   }
 
-  ResultsIface* execute_replicated(ExeIface* exe_i, int n_replicas,
-                                   int nargs, const int* dtypes,
-                                   const int* ndims, const long long* dims,
-                                   const void* const* data,
-                                   std::string* err) override {
+  ResultsIface* execute_replicated_mixed(ExeIface* exe_i, int n_replicas,
+                                         int nargs, const int* dtypes,
+                                         const int* ndims,
+                                         const long long* dims,
+                                         const void* const* data,
+                                         BufIface* const* dev_bufs,
+                                         std::string* err) override {
     auto* exe = static_cast<CppExe*>(exe_i);
     // the executable's own devices, in execution order — covers both
     // replicated (n replicas x 1 partition) and GSPMD-partitioned
@@ -460,6 +506,13 @@ struct CppClient : ClientIface {
       for (int a = 0; a < nargs; ++a) {
         std::vector<int64_t> shape(d, d + ndims[a]);
         d += ndims[a];
+        if (dev_bufs && dev_bufs[r * nargs + a]) {
+          // device-resident input: borrowed, not consumed (the caller
+          // keeps ownership; default-compiled programs donate nothing)
+          arg_lists[r].push_back(
+              static_cast<CppBuf*>(dev_bufs[r * nargs + a])->buf.get());
+          continue;
+        }
         auto buf_or = client->BufferFromHostBuffer(
             data[r * nargs + a], to_xla_type(dtypes[a]), shape,
             std::nullopt,
@@ -624,12 +677,52 @@ struct CApiExe : ExeIface {
   }
 };
 
+// Shared meta query for a single PJRT_Buffer (results + detached bufs).
+int capi_buffer_meta(const PJRT_Api* api, PJRT_Buffer* buf, int* dtype,
+                     int* ndim, long long* dims) {
+  PJRT_Buffer_ElementType_Args et;
+  std::memset(&et, 0, sizeof(et));
+  et.struct_size = PJRT_Buffer_ElementType_Args_STRUCT_SIZE;
+  et.buffer = buf;
+  if (api->PJRT_Buffer_ElementType(&et)) return 2;
+  *dtype = from_capi_type(et.type);
+  PJRT_Buffer_Dimensions_Args dm;
+  std::memset(&dm, 0, sizeof(dm));
+  dm.struct_size = PJRT_Buffer_Dimensions_Args_STRUCT_SIZE;
+  dm.buffer = buf;
+  if (api->PJRT_Buffer_Dimensions(&dm)) return 2;
+  if (dm.num_dims > 8) return 2;
+  *ndim = static_cast<int>(dm.num_dims);
+  for (size_t k = 0; k < dm.num_dims; ++k) dims[k] = dm.dims[k];
+  return 0;
+}
+
+struct CApiBuf : BufIface {
+  const PJRT_Api* api = nullptr;
+  PJRT_Buffer* buf = nullptr;
+
+  ~CApiBuf() override {
+    if (buf) {
+      PJRT_Buffer_Destroy_Args dd;
+      std::memset(&dd, 0, sizeof(dd));
+      dd.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
+      dd.buffer = buf;
+      capi_err(api, api->PJRT_Buffer_Destroy(&dd));
+    }
+  }
+
+  int meta(int* dtype, int* ndim, long long* dims) const override {
+    return capi_buffer_meta(api, buf, dtype, ndim, dims);
+  }
+};
+
 struct CApiResults : ResultsIface {
   const PJRT_Api* api = nullptr;
   std::vector<PJRT_Buffer*> bufs;
 
   ~CApiResults() override {
     for (auto* b : bufs) {
+      if (!b) continue;  // released slots
       PJRT_Buffer_Destroy_Args dd;
       std::memset(&dd, 0, sizeof(dd));
       dd.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
@@ -640,27 +733,25 @@ struct CApiResults : ResultsIface {
 
   int count() const override { return static_cast<int>(bufs.size()); }
 
+  BufIface* release(int i) override {
+    if (i < 0 || i >= count() || !bufs[i]) return nullptr;
+    auto* b = new CApiBuf();
+    b->api = api;
+    b->buf = bufs[i];
+    bufs[i] = nullptr;  // slot emptied; meta/read now fail
+    return b;
+  }
+
   int meta(int i, int* dtype, int* ndim, long long* dims) const override {
-    if (i < 0 || i >= count()) return 1;
-    PJRT_Buffer_ElementType_Args et;
-    std::memset(&et, 0, sizeof(et));
-    et.struct_size = PJRT_Buffer_ElementType_Args_STRUCT_SIZE;
-    et.buffer = bufs[i];
-    if (api->PJRT_Buffer_ElementType(&et)) return 2;
-    *dtype = from_capi_type(et.type);
-    PJRT_Buffer_Dimensions_Args dm;
-    std::memset(&dm, 0, sizeof(dm));
-    dm.struct_size = PJRT_Buffer_Dimensions_Args_STRUCT_SIZE;
-    dm.buffer = bufs[i];
-    if (api->PJRT_Buffer_Dimensions(&dm)) return 2;
-    if (dm.num_dims > 8) return 2;
-    *ndim = static_cast<int>(dm.num_dims);
-    for (size_t k = 0; k < dm.num_dims; ++k) dims[k] = dm.dims[k];
-    return 0;
+    if (i < 0 || i >= count() || !bufs[i]) return 1;
+    return capi_buffer_meta(api, bufs[i], dtype, ndim, dims);
   }
 
   int read(int i, void* dst, long long nbytes, std::string* err) override {
-    if (i < 0 || i >= count()) { *err = "result index out of range"; return 1; }
+    if (i < 0 || i >= count() || !bufs[i]) {
+      *err = "result index out of range or buffer released";
+      return 1;
+    }
     PJRT_Buffer_ToHostBuffer_Args th;
     std::memset(&th, 0, sizeof(th));
     th.struct_size = PJRT_Buffer_ToHostBuffer_Args_STRUCT_SIZE;
@@ -830,11 +921,13 @@ struct CApiClient : ClientIface {
     return compile_fmt(module, "mlir", err, /*n_replicas=*/1, n_partitions);
   }
 
-  ResultsIface* execute_replicated(ExeIface* exe_i, int n_replicas,
-                                   int nargs, const int* dtypes,
-                                   const int* ndims, const long long* dims,
-                                   const void* const* data,
-                                   std::string* err) override {
+  ResultsIface* execute_replicated_mixed(ExeIface* exe_i, int n_replicas,
+                                         int nargs, const int* dtypes,
+                                         const int* ndims,
+                                         const long long* dims,
+                                         const void* const* data,
+                                         BufIface* const* dev_bufs,
+                                         std::string* err) override {
     auto* exe = static_cast<CApiExe*>(exe_i);
     // the executable's addressable devices, in replica order
     PJRT_LoadedExecutable_AddressableDevices_Args ad;
@@ -852,7 +945,7 @@ struct CApiClient : ClientIface {
       return nullptr;
     }
 
-    std::vector<PJRT_Buffer*> in_bufs;
+    std::vector<PJRT_Buffer*> in_bufs;  // only buffers we created here
     auto destroy_inputs = [&]() {
       for (auto* b : in_bufs) {
         PJRT_Buffer_Destroy_Args dd;
@@ -869,6 +962,13 @@ struct CApiClient : ClientIface {
       for (int a = 0; a < nargs; ++a) {
         std::vector<int64_t> shape(d, d + ndims[a]);
         d += ndims[a];
+        if (dev_bufs && dev_bufs[r * nargs + a]) {
+          // device-resident input: borrowed (caller keeps ownership;
+          // not added to in_bufs, so never destroyed here)
+          arg_lists[r].push_back(
+              static_cast<CApiBuf*>(dev_bufs[r * nargs + a])->buf);
+          continue;
+        }
         PJRT_Client_BufferFromHostBuffer_Args bh;
         std::memset(&bh, 0, sizeof(bh));
         bh.struct_size = PJRT_Client_BufferFromHostBuffer_Args_STRUCT_SIZE;
@@ -1099,6 +1199,9 @@ struct tfr_pjrt_exe {
 struct tfr_pjrt_results {
   std::unique_ptr<ResultsIface> impl;
 };
+struct tfr_pjrt_buffer {
+  std::unique_ptr<BufIface> impl;
+};
 
 extern "C" {
 
@@ -1320,5 +1423,53 @@ int tfr_pjrt_result_read(tfr_pjrt_results* r, int i, void* dst,
 }
 
 void tfr_pjrt_results_destroy(tfr_pjrt_results* r) { delete r; }
+
+tfr_pjrt_buffer* tfr_pjrt_result_release_buffer(tfr_pjrt_results* r,
+                                                int i) {
+  BufIface* b = r->impl->release(i);
+  if (!b) return nullptr;
+  auto* out = new tfr_pjrt_buffer();
+  out->impl.reset(b);
+  return out;
+}
+
+int tfr_pjrt_buffer_meta(tfr_pjrt_buffer* b, int* dtype, int* ndim,
+                         long long* dims) {
+  return b->impl->meta(dtype, ndim, dims);
+}
+
+void tfr_pjrt_buffer_destroy(tfr_pjrt_buffer* b) { delete b; }
+
+tfr_pjrt_results* tfr_pjrt_execute_replicated_mixed(
+    tfr_pjrt_client* c, tfr_pjrt_exe* e, int n_replicas, int nargs,
+    const int* dtypes, const int* ndims, const long long* dims,
+    const void* const* data, tfr_pjrt_buffer* const* dev_bufs, char* err,
+    int errlen) {
+  for (int a = 0; a < nargs; ++a) {
+    if (dtype_size(dtypes[a]) == 0) {
+      set_err(err, errlen,
+              "unsupported dtype code " + std::to_string(dtypes[a]));
+      return nullptr;
+    }
+  }
+  std::vector<BufIface*> devs;
+  if (dev_bufs) {
+    devs.resize(static_cast<size_t>(n_replicas) * nargs, nullptr);
+    for (size_t i = 0; i < devs.size(); ++i) {
+      if (dev_bufs[i]) devs[i] = dev_bufs[i]->impl.get();
+    }
+  }
+  std::string errmsg;
+  ResultsIface* r = c->impl->execute_replicated_mixed(
+      e->impl.get(), n_replicas, nargs, dtypes, ndims, dims, data,
+      dev_bufs ? devs.data() : nullptr, &errmsg);
+  if (!r) {
+    set_err(err, errlen, errmsg);
+    return nullptr;
+  }
+  auto* out = new tfr_pjrt_results();
+  out->impl.reset(r);
+  return out;
+}
 
 }  // extern "C"

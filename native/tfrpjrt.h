@@ -28,6 +28,10 @@ extern "C" {
 typedef struct tfr_pjrt_client tfr_pjrt_client;
 typedef struct tfr_pjrt_exe tfr_pjrt_exe;
 typedef struct tfr_pjrt_results tfr_pjrt_results;
+/* A device-resident buffer detached from a results set: lets a caller
+ * chain executions without a host round-trip per dispatch (the
+ * device-resident loop the jax path gets for free). */
+typedef struct tfr_pjrt_buffer tfr_pjrt_buffer;
 
 /* dtype codes (stable across backends; mapped internally) */
 enum tfr_dtype {
@@ -132,6 +136,36 @@ int tfr_pjrt_result_meta(tfr_pjrt_results* r, int i, int* dtype, int* ndim,
 int tfr_pjrt_result_read(tfr_pjrt_results* r, int i, void* dst,
                          long long nbytes, char* err, int errlen);
 void tfr_pjrt_results_destroy(tfr_pjrt_results* r);
+
+/* Detach result i as a standalone DEVICE-RESIDENT buffer handle. The
+ * buffer stays in device memory (HBM on TPU); the results slot is
+ * emptied (meta/read on it fail afterwards). The caller owns the handle
+ * and may pass it back as an input to
+ * tfr_pjrt_execute_replicated_mixed — the residency contract that turns
+ * per-call host marshalling into a device loop. Returns NULL on
+ * out-of-range or already-released slots. */
+tfr_pjrt_buffer* tfr_pjrt_result_release_buffer(tfr_pjrt_results* r, int i);
+/* dims must have room for 8 entries; returns 0 on success. */
+int tfr_pjrt_buffer_meta(tfr_pjrt_buffer* b, int* dtype, int* ndim,
+                         long long* dims);
+void tfr_pjrt_buffer_destroy(tfr_pjrt_buffer* b);
+
+/* As tfr_pjrt_execute_replicated, but each (replica, arg) slot may be a
+ * device-resident buffer instead of host memory: dev_bufs holds
+ * n_replicas * nargs entries, replica-major; a non-NULL entry is used
+ * directly (it must live on that replica's device — true for buffers
+ * released from a result slot of the same (replica, executable-family)
+ * position) and the corresponding data entry is ignored. dev_bufs NULL
+ * means all-host (identical to tfr_pjrt_execute_replicated). dtypes/
+ * ndims/dims still describe every argument (device entries included —
+ * they are part of the program signature). Buffers are NOT consumed:
+ * the same handle may be passed to many executions and must still be
+ * destroyed by the caller. */
+tfr_pjrt_results* tfr_pjrt_execute_replicated_mixed(
+    tfr_pjrt_client* c, tfr_pjrt_exe* e, int n_replicas, int nargs,
+    const int* dtypes, const int* ndims, const long long* dims,
+    const void* const* data, tfr_pjrt_buffer* const* dev_bufs, char* err,
+    int errlen);
 
 #ifdef __cplusplus
 }

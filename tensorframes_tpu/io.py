@@ -31,8 +31,48 @@ __all__ = ["read_parquet", "write_parquet", "from_pandas", "to_pandas",
            "read_npz", "write_npz", "read_csv", "write_csv"]
 
 
-def _column_to_numpy(col, name: str) -> np.ndarray:
-    """One pyarrow ChunkedArray/Array -> dense numpy column."""
+class _RaggedParts:
+    """A variable-length list column decoded as its arrow buffers.
+
+    Holds the flattened value buffer plus the per-row offsets — the
+    columnar form the pad path consumes DIRECTLY (one vectorized scatter,
+    no per-cell Python work: the reference's acknowledged per-row boxing
+    weakness, ``DataOps.scala:30-33``, eliminated at the IO boundary).
+    ``cells()`` materializes the engine's in-memory ragged format (one
+    numpy view per row) for frames that stay ragged. Internal to
+    :func:`read_parquet` — never escapes into a TensorFrame.
+    """
+
+    __slots__ = ("flat", "offs")
+
+    def __init__(self, flat: np.ndarray, offs: np.ndarray):
+        self.flat = flat
+        self.offs = offs
+
+    def __len__(self) -> int:
+        return len(self.offs) - 1
+
+    @property
+    def lens(self) -> np.ndarray:
+        return self.offs[1:] - self.offs[:-1]
+
+    def cells(self) -> list:
+        flat, offs = self.flat, self.offs
+        return [flat[offs[i]:offs[i + 1]] for i in range(len(offs) - 1)]
+
+    def pad(self, width: int, dtype) -> tuple:
+        """-> (dense [rows, width], mask int32, lens int64), vectorized."""
+        lens = self.lens
+        r = len(lens)
+        m = np.arange(width) < lens[:, None]
+        dense = np.zeros((r, width), dtype)
+        dense[m] = self.flat  # row-major fill == concatenated cell order
+        return dense, m.astype(np.int32), lens.astype(np.int64)
+
+
+def _column_to_numpy(col, name: str):
+    """One pyarrow ChunkedArray/Array -> dense numpy column (or
+    :class:`_RaggedParts` for variable-length list columns)."""
     import pyarrow as pa
 
     if isinstance(col, pa.ChunkedArray):
@@ -53,11 +93,11 @@ def _column_to_numpy(col, name: str) -> np.ndarray:
             width = lengths[0]
             flat = col.flatten().to_numpy(zero_copy_only=False)
             return np.asarray(flat).reshape(len(col), width)
-        # variable-length lists -> a RAGGED column: one numpy cell per
-        # row, sliced zero-copy-ish out of the arrow value buffer
+        # variable-length lists: keep the (values, offsets) buffer pair —
+        # cells slice out lazily, and the pad path never makes cells
         flat = np.asarray(col.flatten().to_numpy(zero_copy_only=False))
-        offs = np.asarray(col.offsets)
-        return [flat[offs[i]:offs[i + 1]] for i in range(len(col))]
+        offs = np.asarray(col.offsets).astype(np.int64)
+        return _RaggedParts(flat, offs)
     if pa.types.is_string(t) or pa.types.is_large_string(t):
         return np.asarray(col.to_pylist(), dtype=object)
     return col.to_numpy(zero_copy_only=False)
@@ -102,39 +142,83 @@ def read_parquet(path: str, columns: Optional[Sequence[str]] = None,
     if not names:  # explicit empty selection: an empty frame
         return TensorFrame.from_columns({})
     ragged_names = [n for n in names
-                    if any(isinstance(b[n], list) for b in blocks)]
+                    if any(isinstance(b[n], _RaggedParts) for b in blocks)]
+    # which ragged columns pad at load (fused: straight from the arrow
+    # buffers); non-ragged pad requests fall through to pad_column below
+    if pad_ragged:
+        to_pad = list(ragged_names) if pad_ragged is True else [
+            n for n in pad_ragged]
+    else:
+        to_pad = []
+    fused_pad = [n for n in to_pad if n in ragged_names]
     if not ragged_names:
         first = TensorFrame.from_columns(blocks[0])
         schema = first.schema
     else:
         # a row group whose lists HAPPEN to share one length decodes
-        # dense; normalize those columns back to ragged cells so every
-        # block agrees with the schema
+        # dense; rebuild its (values, offsets) form so every block agrees
         for b in blocks:
             for n in ragged_names:
-                if not isinstance(b[n], list):
-                    b[n] = list(b[n])
+                c = b[n]
+                if isinstance(c, np.ndarray):
+                    w = c.shape[1] if c.ndim > 1 else 0
+                    b[n] = _RaggedParts(
+                        np.ascontiguousarray(c).reshape(-1),
+                        np.arange(len(c) + 1, dtype=np.int64) * w)
         from . import dtypes as _dt
         from .schema import Field, Schema
+        from .shape import Shape, Unknown
 
+        # global pad width per fused column (what pad_column's length
+        # scan computes, here from the offsets alone)
+        widths = {n: max((int(b[n].lens.max()) if len(b[n]) else 0)
+                         for b in blocks) for n in fused_pad}
         fields = []
         for n in names:
             if n in ragged_names:
                 # dtype probe over ALL blocks: the first one may hold
                 # only empty cells
                 probe = next(
-                    (c for b in blocks for c in b[n] if len(c)),
+                    (b[n].flat for b in blocks if b[n].flat.size),
                     np.empty(0))
-                fields.append(Field(n, _dt.from_numpy(probe.dtype),
-                                    sql_rank=1))
+                dt = _dt.from_numpy(probe.dtype)
+                if n in fused_pad:
+                    fields.append(Field(
+                        n, dt, block_shape=Shape(Unknown, widths[n]),
+                        sql_rank=1))
+                else:
+                    fields.append(Field(n, dt, sql_rank=1))
             else:
                 fields.append(
                     Schema.from_numpy_columns(
                         {n: blocks[0][n]}).fields[0])
+        for n in fused_pad:  # mask/len fields append in pad order
+            for extra in (f"{n}_mask", f"{n}_len"):
+                if extra in names:
+                    raise ValueError(f"Column {extra!r} already exists")
+            fields.append(Field(f"{n}_mask", _dt.int32,
+                                block_shape=Shape(Unknown, widths[n]),
+                                sql_rank=1))
+            fields.append(Field(f"{n}_len", _dt.int64,
+                                block_shape=Shape(Unknown), sql_rank=0))
         schema = Schema(fields)
+        for b in blocks:
+            for n in names:
+                c = b[n]
+                if not isinstance(c, _RaggedParts):
+                    continue
+                if n in fused_pad:
+                    dense, mask, lens = c.pad(widths[n],
+                                              schema[n].dtype.np_storage)
+                    b[n] = dense
+                    b[f"{n}_mask"] = mask
+                    b[f"{n}_len"] = lens
+                else:
+                    b[n] = c.cells()
     from .frame import Block
 
-    fblocks = [Block({n: b[n] for n in names},
+    out_names = schema.names
+    fblocks = [Block({n: b[n] for n in out_names},
                      len(b[names[0]])) for b in blocks]
     first = TensorFrame.from_blocks(fblocks, schema)
     if num_partitions is not None:
@@ -142,13 +226,11 @@ def read_parquet(path: str, columns: Optional[Sequence[str]] = None,
         from .frame import _split_even
 
         spans = _split_even(merged.num_rows, num_partitions)
-        fblocks = [Block({n: merged.columns[n][a:b] for n in names},
+        fblocks = [Block({n: merged.columns[n][a:b] for n in out_names},
                          b - a) for a, b in spans]
         first = TensorFrame.from_blocks(fblocks, schema)
-    if pad_ragged:
-        to_pad = ragged_names if pad_ragged is True else [
-            n for n in pad_ragged]
-        for n in to_pad:
+    for n in to_pad:
+        if n not in fused_pad:  # non-ragged pad request: pad_column path
             first = first.pad_column(n)
     return first
 

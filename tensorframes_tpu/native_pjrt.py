@@ -29,7 +29,8 @@ from . import dtypes as _dt
 from .computation import Computation
 from .utils.logging import get_logger
 
-__all__ = ["available", "PjrtCoreClient", "PjrtBlockExecutor"]
+__all__ = ["available", "PjrtCoreClient", "PjrtBlockExecutor",
+           "PjrtDeviceBuffer"]
 
 _log = get_logger("native_pjrt")
 
@@ -130,6 +131,24 @@ def _load() -> Optional[ctypes.CDLL]:
                                          ci]
     lib.tfr_pjrt_result_read.restype = ci
     lib.tfr_pjrt_results_destroy.argtypes = [vp]
+    try:
+        lib.tfr_pjrt_result_release_buffer.argtypes = [vp, ci]
+        lib.tfr_pjrt_result_release_buffer.restype = vp
+        lib.tfr_pjrt_buffer_meta.argtypes = [vp, ctypes.POINTER(ci),
+                                             ctypes.POINTER(ci),
+                                             ctypes.POINTER(cll)]
+        lib.tfr_pjrt_buffer_meta.restype = ci
+        lib.tfr_pjrt_buffer_destroy.argtypes = [vp]
+        lib.tfr_pjrt_execute_replicated_mixed.argtypes = [
+            vp, vp, ci, ci, ctypes.POINTER(ci), ctypes.POINTER(ci),
+            ctypes.POINTER(cll), ctypes.POINTER(vp), ctypes.POINTER(vp),
+            ctypes.c_char_p, ci]
+        lib.tfr_pjrt_execute_replicated_mixed.restype = vp
+        lib._tfr_has_resident = True
+    except AttributeError:
+        # an older libtfrpjrt.so without the device-resident surface;
+        # execute(keep_outputs=...) / device-buffer args will raise
+        lib._tfr_has_resident = False
     _lib = lib
     return _lib
 
@@ -411,6 +430,35 @@ class PjrtExecutable:
             pass
 
 
+class PjrtDeviceBuffer:
+    """A DEVICE-RESIDENT buffer detached from a replicated result set.
+
+    Holds device (HBM) memory owned by the native core; pass it back as
+    an input slot of :meth:`PjrtReplicatedExecutable.execute` to chain
+    dispatches without the per-call host round-trip (the residency the
+    jax path gets from ``jax.Array``). The buffer lives on the replica
+    device that produced it — reuse it only in the same replica slot.
+    """
+
+    def __init__(self, client: PjrtCoreClient, handle, dtype: np.dtype,
+                 shape: Tuple[int, ...]):
+        self._client = client
+        self._h = handle
+        self.dtype = np.dtype(dtype)
+        self.shape = tuple(shape)
+
+    def close(self):
+        if self._h:
+            self._client._lib.tfr_pjrt_buffer_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
 class PjrtReplicatedExecutable:
     """A program compiled for N devices; one ``execute`` call runs every
     replica in parallel inside the native core — the in-process analogue
@@ -422,25 +470,36 @@ class PjrtReplicatedExecutable:
         self._h = handle
         self.n_replicas = n_replicas
 
-    def execute(self, per_replica_args) -> list:
+    def execute(self, per_replica_args, keep_outputs: bool = False) -> list:
         """``per_replica_args``: list of ``n_replicas`` argument lists
-        (equal shapes/dtypes across replicas — XLA's static world).
-        Returns one output list per replica."""
+        (equal shapes/dtypes across replicas — XLA's static world). An
+        argument may be a :class:`PjrtDeviceBuffer` (device-resident, no
+        host upload for that slot). Returns one output list per replica —
+        numpy arrays, or :class:`PjrtDeviceBuffer` handles when
+        ``keep_outputs`` (no host download; feed them back in)."""
         lib = self._client._lib
         if len(per_replica_args) != self.n_replicas:
             raise PjrtCoreError(
                 f"expected {self.n_replicas} replica argument lists, got "
                 f"{len(per_replica_args)}")
         nargs = len(per_replica_args[0])
-        views = [[np.ascontiguousarray(a) for a in rep]
+        views = [[a if isinstance(a, PjrtDeviceBuffer)
+                  else np.ascontiguousarray(a) for a in rep]
                  for rep in per_replica_args]
         first = views[0]
+        has_dev = any(isinstance(a, PjrtDeviceBuffer)
+                      for rep in views for a in rep)
+        if (has_dev or keep_outputs) and \
+                not getattr(lib, "_tfr_has_resident", False):
+            raise PjrtCoreError(
+                "this libtfrpjrt.so predates device-resident buffers; "
+                "rebuild with make -C native pjrt")
         dtypes = (ctypes.c_int * nargs)()
         ndims = (ctypes.c_int * nargs)()
         flat_dims = []
         for i, a in enumerate(first):
             dtypes[i] = _dtype_code(a.dtype)
-            ndims[i] = a.ndim
+            ndims[i] = len(a.shape)
             flat_dims.extend(a.shape)
         for rep in views[1:]:
             if len(rep) != nargs or any(
@@ -451,24 +510,67 @@ class PjrtReplicatedExecutable:
         dims = (ctypes.c_longlong * max(1, len(flat_dims)))(*flat_dims)
         n_total = self.n_replicas * nargs
         datas = (ctypes.c_void_p * n_total)()
-        for r, rep in enumerate(views):
-            for i, a in enumerate(rep):
-                datas[r * nargs + i] = a.ctypes.data_as(ctypes.c_void_p)
         err = ctypes.create_string_buffer(_ERRLEN)
-        res = lib.tfr_pjrt_execute_replicated(
-            self._client._client, self._h, self.n_replicas, nargs, dtypes,
-            ndims, dims, datas, err, _ERRLEN)
+        if has_dev or keep_outputs:
+            devs = (ctypes.c_void_p * n_total)()
+            for r, rep in enumerate(views):
+                for i, a in enumerate(rep):
+                    if isinstance(a, PjrtDeviceBuffer):
+                        if not a._h:
+                            raise PjrtCoreError(
+                                f"replica {r} arg {i}: device buffer "
+                                f"already closed")
+                        devs[r * nargs + i] = a._h
+                    else:
+                        datas[r * nargs + i] = a.ctypes.data_as(
+                            ctypes.c_void_p)
+            res = lib.tfr_pjrt_execute_replicated_mixed(
+                self._client._client, self._h, self.n_replicas, nargs,
+                dtypes, ndims, dims, datas, devs, err, _ERRLEN)
+        else:
+            for r, rep in enumerate(views):
+                for i, a in enumerate(rep):
+                    datas[r * nargs + i] = a.ctypes.data_as(ctypes.c_void_p)
+            res = lib.tfr_pjrt_execute_replicated(
+                self._client._client, self._h, self.n_replicas, nargs,
+                dtypes, ndims, dims, datas, err, _ERRLEN)
         if not res:
             raise PjrtCoreError(
                 f"replicated execute failed: "
                 f"{err.value.decode(errors='replace')}")
         try:
-            outs = _read_results(lib, res)
+            if keep_outputs:
+                outs = self._release_all(lib, res)
+            else:
+                outs = _read_results(lib, res)
         finally:
             lib.tfr_pjrt_results_destroy(res)
         per_rep = len(outs) // self.n_replicas
         return [outs[r * per_rep:(r + 1) * per_rep]
                 for r in range(self.n_replicas)]
+
+    def _release_all(self, lib, res) -> list:
+        """Detach every result as a device-resident buffer handle."""
+        outs = []
+        for i in range(lib.tfr_pjrt_results_count(res)):
+            dt = ctypes.c_int()
+            nd = ctypes.c_int()
+            odims = (ctypes.c_longlong * 8)()
+            if lib.tfr_pjrt_result_meta(res, i, ctypes.byref(dt),
+                                        ctypes.byref(nd), odims):
+                raise PjrtCoreError(f"result {i}: meta query failed")
+            np_dt = (_dt.bfloat16.np_storage if dt.value == _BF16_CODE
+                     else _NP_FROM_CODE.get(dt.value))
+            if np_dt is None:
+                raise PjrtCoreError(
+                    f"result {i}: unsupported dtype code {dt.value}")
+            h = lib.tfr_pjrt_result_release_buffer(res, i)
+            if not h:
+                raise PjrtCoreError(f"result {i}: buffer release failed")
+            outs.append(PjrtDeviceBuffer(
+                self._client, h, np_dt,
+                tuple(odims[k] for k in range(nd.value))))
+        return outs
 
     def close(self):
         if self._h:

@@ -257,22 +257,10 @@ class NativeMeshExecutor:
         return result
 
     # -- generic sharded program -------------------------------------------
-    def run_sharded(self, cache_key, build_fn, host_args, in_shardings,
-                    out_shardings, mesh, owner=None, out_check=None):
-        """Compile-or-reuse ONE GSPMD program and execute it natively.
-
-        ``build_fn() -> traceable fn`` over positional args matching
-        ``host_args``/``in_shardings``; ``out_shardings`` is a list (or a
-        callable of the out avals returning one). ``out_check(out_avals)
-        -> bool`` vetoes routing from the abstract output shapes (e.g.
-        dmap's row-alignment requirement). Results come back as GLOBAL
-        numpy arrays assembled from the per-device shards. Returns
-        ``None`` when not routable — the verdict (including a FAILED
-        compile: a backend without a lowering for some collective must
-        not pay a full re-trace per call before the jax fallback) is
-        cached. ``owner`` (e.g. a live Computation) keys the cache on the
-        owning object instead of the executor-wide LRU, dying with it.
-        """
+    def _entry_for(self, cache_key, build_fn, host_args, in_shardings,
+                   out_shardings, mesh, owner=None, out_check=None):
+        """Compile-or-reuse the GSPMD program (shared by the one-shot and
+        resident-loop dispatch paths); ``None`` when not routable."""
         import jax
 
         n_total = mesh.num_devices
@@ -290,7 +278,6 @@ class NativeMeshExecutor:
                 cache.move_to_end(cache_key)
         if entry is _NOT_ROUTABLE:
             return None
-        host_args = [np.asarray(a) for a in host_args]
         if entry is None:
             fn = build_fn()
             avals = [jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s)
@@ -337,6 +324,31 @@ class NativeMeshExecutor:
                     entry = (exe, out_avals, out_sh)
                     self._cache_put(cache, cache_key, entry, cap)
                     self.compile_count += 1
+        return entry
+
+    def run_sharded(self, cache_key, build_fn, host_args, in_shardings,
+                    out_shardings, mesh, owner=None, out_check=None):
+        """Compile-or-reuse ONE GSPMD program and execute it natively.
+
+        ``build_fn() -> traceable fn`` over positional args matching
+        ``host_args``/``in_shardings``; ``out_shardings`` is a list (or a
+        callable of the out avals returning one). ``out_check(out_avals)
+        -> bool`` vetoes routing from the abstract output shapes (e.g.
+        dmap's row-alignment requirement). Results come back as GLOBAL
+        numpy arrays assembled from the per-device shards. Returns
+        ``None`` when not routable — the verdict (including a FAILED
+        compile: a backend without a lowering for some collective must
+        not pay a full re-trace per call before the jax fallback) is
+        cached. ``owner`` (e.g. a live Computation) keys the cache on the
+        owning object instead of the executor-wide LRU, dying with it.
+        """
+        n_total = mesh.num_devices
+        host_args = [np.asarray(a) for a in host_args]
+        entry = self._entry_for(cache_key, build_fn, host_args,
+                                in_shardings, out_shardings, mesh,
+                                owner=owner, out_check=out_check)
+        if entry is None:
+            return None
         exe, out_avals, out_sh = entry
         dev_order = list(mesh.mesh.devices.flat)
         per_arg = [self._split(a, s, dev_order)
@@ -350,6 +362,56 @@ class NativeMeshExecutor:
                   for i, (oav, sh) in enumerate(zip(out_avals, out_sh))]
         self.dispatch_count += 1  # after assembly: failures don't count
         return result
+
+    def run_sharded_loop(self, cache_key, build_fn, host_args,
+                         in_shardings, out_shardings, mesh, iters: int,
+                         owner=None):
+        """Iterate ONE GSPMD program with DEVICE-RESIDENT loop state.
+
+        The shards upload once, each dispatch's output buffers feed the
+        next dispatch directly (``PjrtDeviceBuffer`` handles — HBM on a
+        TPU host, no per-call host marshalling), and only the final
+        iteration's results come back as global numpy arrays. Requires
+        the program's outputs to match its inputs positionally
+        (shape + dtype) — the fixed-point/loop-state shape every
+        iterative workload (k-means, logreg) has. Returns ``None`` when
+        the program is not natively routable.
+        """
+        if iters < 1:
+            raise ValueError(f"iters must be >= 1, got {iters}")
+        n_total = mesh.num_devices
+        host_args = [np.asarray(a) for a in host_args]
+        entry = self._entry_for(cache_key, build_fn, host_args,
+                                in_shardings, out_shardings, mesh,
+                                owner=owner)
+        if entry is None:
+            return None
+        exe, out_avals, out_sh = entry
+        # shardings must match too: each replica's output buffer feeds
+        # the same input slot, so a rows-sharded input produced as a
+        # columns-sharded output would silently permute the loop state
+        mismatch = [
+            i for i, (a, o, ish, osh)
+            in enumerate(zip(host_args, out_avals, in_shardings, out_sh))
+            if a.shape != o.shape or a.dtype != o.dtype or ish != osh]
+        if len(host_args) != len(out_avals) or mismatch:
+            raise ValueError(
+                "run_sharded_loop needs outputs matching inputs "
+                f"positionally (shape, dtype AND sharding); mismatched "
+                f"positions: {mismatch}")
+        dev_order = list(mesh.mesh.devices.flat)
+        per_arg = [self._split(a, s, dev_order)
+                   for a, s in zip(host_args, in_shardings)]
+        args = [[shards[p] for shards in per_arg] for p in range(n_total)]
+        with span("native_mesh.resident_loop"):
+            for _ in range(iters - 1):
+                args = exe.execute(args, keep_outputs=True)
+                self.dispatch_count += 1
+            outs = exe.execute(args, keep_outputs=False)
+            self.dispatch_count += 1
+        return [self._assemble([outs[p][i] for p in range(n_total)],
+                               sh, oav.shape, oav.dtype, dev_order)
+                for i, (oav, sh) in enumerate(zip(out_avals, out_sh))]
 
     # -- collective reduce -------------------------------------------------
     def dreduce_collective(self, shard_fn, in_specs, names, dist,

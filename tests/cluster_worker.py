@@ -31,6 +31,18 @@ import numpy as np  # noqa: E402
 
 
 def main() -> int:
+    """Run the step matrix; each step reports its own pass/fail marker.
+
+    ``[worker N] STEP <name> OK`` / ``... STEP <name> FAIL`` + traceback —
+    the driver-side test file turns each marker into its own pytest test,
+    so a failure names the op instead of dumping one 3000-char tail. A
+    failed step does not stop the rest (the steps only share the
+    read-only distributed frames); the process exit code is the OR of
+    all steps. Collectives stay in lockstep across processes because
+    every step runs unconditionally on every process, in order.
+    """
+    import traceback
+
     pid, nproc, port = (int(a) for a in sys.argv[1:4])
     ckpt_dir = sys.argv[4] if len(sys.argv) > 4 else None
     from tensorframes_tpu import parallel as par
@@ -58,86 +70,97 @@ def main() -> int:
     x_g = np.concatenate([np.arange(23.0), np.arange(17.0) + 1000])
     v_g = np.stack([x_g, -x_g], 1)
 
-    # 1. dmap_blocks (row-local) + collect round trip
-    out = par.dmap_blocks(lambda x: {"z": x * 2.0 + 1.0}, dist)
-    frame = out.collect_frame()
-    rows = frame.collect()
-    got_z = np.sort(np.array([r["z"] for r in rows]))
-    np.testing.assert_allclose(got_z, np.sort(x_g * 2 + 1), rtol=1e-12)
+    def step_dmap():
+        # dmap_blocks (row-local) + collect round trip
+        out = par.dmap_blocks(lambda x: {"z": x * 2.0 + 1.0}, dist)
+        rows = out.collect_frame().collect()
+        got_z = np.sort(np.array([r["z"] for r in rows]))
+        np.testing.assert_allclose(got_z, np.sort(x_g * 2 + 1), rtol=1e-12)
 
-    # 2. monoid dreduce (collective path with per-shard validity masks)
-    red = par.dreduce_blocks({"x": "sum", "v": "min"}, dist)
-    np.testing.assert_allclose(red["x"], x_g.sum(), rtol=1e-12)
-    np.testing.assert_allclose(red["v"], v_g.min(0), rtol=1e-12)
+    def step_dreduce_monoid():
+        # collective path with per-shard validity masks
+        red = par.dreduce_blocks({"x": "sum", "v": "min"}, dist)
+        np.testing.assert_allclose(red["x"], x_g.sum(), rtol=1e-12)
+        np.testing.assert_allclose(red["v"], v_g.min(0), rtol=1e-12)
 
-    # 3. generic dreduce (arbitrary computation over ragged validity;
-    # reduce consumes every column, so select the value column first)
-    red2 = par.dreduce_blocks(
-        lambda x_input: {"x": jnp.sqrt((x_input ** 2).sum(0))},
-        dist.select("x"))
-    np.testing.assert_allclose(red2["x"], np.sqrt((x_g ** 2).sum()),
-                               rtol=1e-9)
-
-    # 4. monoid daggregate
-    agg = par.daggregate({"x": "sum", "v": "max"},
-                         dist, "k").collect()
-    for r in agg:
-        sel = k_g == r["k"]
-        np.testing.assert_allclose(r["x"], x_g[sel].sum(), rtol=1e-12)
-        np.testing.assert_allclose(r["v"], v_g[sel].max(0), rtol=1e-12)
-
-    # 5. generic daggregate (UDAF-analogue inside the "shuffle"; every
-    # value column must back a fetch, so select key + value only)
-    agg2 = par.daggregate(
-        lambda x_input: {"x": jnp.sqrt((x_input ** 2).sum(0))},
-        dist.select(["k", "x"]), "k").collect()
-    assert len(agg2) == 5
-    for r in agg2:
-        sel = k_g == r["k"]
-        np.testing.assert_allclose(r["x"], np.sqrt((x_g[sel] ** 2).sum()),
+    def step_dreduce_generic():
+        # arbitrary computation over ragged validity; reduce consumes
+        # every column, so select the value column first
+        red2 = par.dreduce_blocks(
+            lambda x_input: {"x": jnp.sqrt((x_input ** 2).sum(0))},
+            dist.select("x"))
+        np.testing.assert_allclose(red2["x"], np.sqrt((x_g ** 2).sum()),
                                    rtol=1e-9)
 
-    # 6. daggregate with DEVICE-side keys across processes (the ids are
-    # built by one jitted sort-unique over the global sharded key column)
-    agg3 = par.daggregate({"x": "sum"}, dist.select(["k", "x"]), "k",
-                          max_groups=8).collect()
-    assert len(agg3) == 5
-    for r in agg3:
-        sel = k_g == r["k"]
-        np.testing.assert_allclose(r["x"], x_g[sel].sum(), rtol=1e-12)
+    def step_daggregate_monoid():
+        agg = par.daggregate({"x": "sum", "v": "max"}, dist, "k").collect()
+        for r in agg:
+            sel = k_g == r["k"]
+            np.testing.assert_allclose(r["x"], x_g[sel].sum(), rtol=1e-12)
+            np.testing.assert_allclose(r["v"], v_g[sel].max(0), rtol=1e-12)
 
-    # 7. dfilter across processes (per-shard compaction under the
-    # per-process pad layout) chained into a collective reduce
-    flt = par.dfilter(lambda x: x < 500.0, dist)   # keeps only p0's rows
-    assert flt.count() == 23, flt.count()
-    fred = par.dreduce_blocks({"x": "sum"}, flt.select("x"))
-    np.testing.assert_allclose(fred["x"], x_g[x_g < 500].sum(), rtol=1e-12)
+    def step_daggregate_generic():
+        # UDAF-analogue inside the "shuffle"; every value column must
+        # back a fetch, so select key + value only
+        agg2 = par.daggregate(
+            lambda x_input: {"x": jnp.sqrt((x_input ** 2).sum(0))},
+            dist.select(["k", "x"]), "k").collect()
+        assert len(agg2) == 5
+        for r in agg2:
+            sel = k_g == r["k"]
+            np.testing.assert_allclose(
+                r["x"], np.sqrt((x_g[sel] ** 2).sum()), rtol=1e-9)
 
-    # 8. dsort across processes: global order out of process-local shards,
-    # result normalized to prefix validity
-    srt = par.dsort("x", flt.select("x"), descending=True)
-    assert srt.shard_valid is None
-    top = srt.collect_frame().collect()
-    np.testing.assert_allclose([r["x"] for r in top],
-                               np.sort(x_g[x_g < 500])[::-1], rtol=1e-12)
+    def step_daggregate_device_keys():
+        # DEVICE-side keys across processes (ids built by one jitted
+        # sort-unique over the global sharded key column)
+        agg3 = par.daggregate({"x": "sum"}, dist.select(["k", "x"]), "k",
+                              max_groups=8).collect()
+        assert len(agg3) == 5
+        for r in agg3:
+            sel = k_g == r["k"]
+            np.testing.assert_allclose(r["x"], x_g[sel].sum(), rtol=1e-12)
 
-    # 9. COMPOSITE device-side keys across processes (mixed-radix int32
-    # combination inside one jitted program over the sharded key columns)
-    k2_local = (np.arange(n_local) % 3).astype(np.int64)
-    dist2 = par.distribute_local(
-        {"k": k_local, "k2": k2_local, "x": x_local}, mesh)
-    k2_g = np.concatenate([(np.arange(23) % 3), (np.arange(17) % 3)])
-    agg4 = par.daggregate({"x": "sum"}, dist2, ["k", "k2"],
-                          max_groups=16).collect()
-    assert len(agg4) == len({(a, b) for a, b in zip(k_g, k2_g)})
-    for r in agg4:
-        sel = (k_g == r["k"]) & (k2_g == r["k2"])
-        np.testing.assert_allclose(r["x"], x_g[sel].sum(), rtol=1e-12)
+    def step_dfilter():
+        # per-shard compaction under the per-process pad layout, chained
+        # into a collective reduce
+        flt = par.dfilter(lambda x: x < 500.0, dist)  # only p0's rows
+        assert flt.count() == 23, flt.count()
+        fred = par.dreduce_blocks({"x": "sum"}, flt.select("x"))
+        np.testing.assert_allclose(fred["x"], x_g[x_g < 500].sum(),
+                                   rtol=1e-12)
 
-    # 10. checkpoint save + resume-on-mesh with BOTH processes
-    # participating: each host writes/reads only its shards (orbax), and
-    # the restored arrays carry the original shardings
-    if ckpt_dir:
+    def step_dsort():
+        # global order out of process-local shards, result normalized to
+        # prefix validity (runs its own dfilter so steps stay independent)
+        flt = par.dfilter(lambda x: x < 500.0, dist)
+        srt = par.dsort("x", flt.select("x"), descending=True)
+        assert srt.shard_valid is None
+        top = srt.collect_frame().collect()
+        np.testing.assert_allclose([r["x"] for r in top],
+                                   np.sort(x_g[x_g < 500])[::-1],
+                                   rtol=1e-12)
+
+    def step_daggregate_composite_keys():
+        # COMPOSITE device-side keys (mixed-radix int32 combination
+        # inside one jitted program over the sharded key columns)
+        k2_local = (np.arange(n_local) % 3).astype(np.int64)
+        dist2 = par.distribute_local(
+            {"k": k_local, "k2": k2_local, "x": x_local}, mesh)
+        k2_g = np.concatenate([(np.arange(23) % 3), (np.arange(17) % 3)])
+        agg4 = par.daggregate({"x": "sum"}, dist2, ["k", "k2"],
+                              max_groups=16).collect()
+        assert len(agg4) == len({(a, b) for a, b in zip(k_g, k2_g)})
+        for r in agg4:
+            sel = (k_g == r["k"]) & (k2_g == r["k2"])
+            np.testing.assert_allclose(r["x"], x_g[sel].sum(), rtol=1e-12)
+
+    def step_checkpoint_resume():
+        # save + resume-on-mesh with BOTH processes participating: each
+        # host writes/reads only its shards (orbax), restored arrays
+        # carry the original shardings
+        if not ckpt_dir:
+            return
         from tensorframes_tpu.utils import checkpoint as ckpt
 
         state = {"x": dist.columns["x"], "v": dist.columns["v"]}
@@ -154,8 +177,32 @@ def main() -> int:
                 np.testing.assert_array_equal(np.asarray(so.data),
                                               np.asarray(sn.data))
 
-    print(f"[worker {pid}] OK", flush=True)
-    return 0
+    steps = [
+        ("dmap", step_dmap),
+        ("dreduce_monoid", step_dreduce_monoid),
+        ("dreduce_generic", step_dreduce_generic),
+        ("daggregate_monoid", step_daggregate_monoid),
+        ("daggregate_generic", step_daggregate_generic),
+        ("daggregate_device_keys", step_daggregate_device_keys),
+        ("dfilter", step_dfilter),
+        ("dsort", step_dsort),
+        ("daggregate_composite_keys", step_daggregate_composite_keys),
+        ("checkpoint_resume", step_checkpoint_resume),
+    ]
+    failed = False
+    for name, fn in steps:
+        try:
+            fn()
+        except Exception:
+            failed = True
+            print(f"[worker {pid}] STEP {name} FAIL", flush=True)
+            traceback.print_exc(file=sys.stdout)
+            sys.stdout.flush()
+        else:
+            print(f"[worker {pid}] STEP {name} OK", flush=True)
+    if not failed:
+        print(f"[worker {pid}] OK", flush=True)
+    return 1 if failed else 0
 
 
 if __name__ == "__main__":

@@ -155,3 +155,56 @@ class TestRaggedParquet:
         assert df.num_partitions == 3
         rows = df.collect()
         np.testing.assert_array_equal(rows[4]["v"], np.arange(5))
+
+    def test_fused_pad_matches_pad_column(self, tmp_path):
+        # read_parquet(pad_ragged=True) pads straight from the arrow
+        # offsets+values buffers (no per-cell work); it must be
+        # indistinguishable from loading ragged cells then pad_column
+        p = self._write_ragged(tmp_path)
+        fused = tio.read_parquet(p, pad_ragged=True)
+        stepwise = tio.read_parquet(p).pad_column("v")
+        assert fused.schema.names == stepwise.schema.names
+        for f_f, f_s in zip(fused.schema, stepwise.schema):
+            assert (f_f.name, f_f.dtype, f_f.sql_rank) == \
+                (f_s.name, f_s.dtype, f_s.sql_rank)
+            assert (f_f.block_shape is None) == (f_s.block_shape is None)
+            if f_f.block_shape is not None:
+                assert f_f.block_shape.dims == f_s.block_shape.dims
+        fr, sr = fused.collect(), stepwise.collect()
+        assert len(fr) == len(sr)
+        for a, b in zip(fr, sr):
+            for c in fused.schema.names:
+                np.testing.assert_array_equal(a[c], b[c])
+
+    def test_fused_pad_empty_and_uniform_cells(self, tmp_path):
+        # empty cells pad to all-mask-zero rows; a row GROUP whose cells
+        # happen to share one length decodes dense and must still fold
+        # into the global pad width
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        p = str(tmp_path / "mixed.parquet")
+        writer = None
+        try:
+            # row group 1: ragged incl. an empty cell
+            t1 = pa.table({"v": pa.array(
+                [[1.0, 2.0, 3.0], [], [4.0]])})
+            # row group 2: uniform length 2 (decodes dense)
+            t2 = pa.table({"v": pa.array([[5.0, 6.0], [7.0, 8.0]])})
+            writer = pq.ParquetWriter(p, t1.schema)
+            writer.write_table(t1)
+            writer.write_table(t2)
+        finally:
+            if writer is not None:
+                writer.close()
+        df = tio.read_parquet(p, pad_ragged=True)
+        rows = df.collect()
+        assert [r["v_len"] for r in rows] == [3, 0, 1, 2, 2]
+        np.testing.assert_array_equal(rows[0]["v"], [1.0, 2.0, 3.0])
+        np.testing.assert_array_equal(rows[1]["v_mask"], [0, 0, 0])
+        np.testing.assert_array_equal(rows[3]["v"], [5.0, 6.0, 0.0])
+        # parity with the stepwise path on the same file
+        stepwise = tio.read_parquet(p).pad_column("v")
+        for a, b in zip(rows, stepwise.collect()):
+            for c in df.schema.names:
+                np.testing.assert_array_equal(a[c], b[c])

@@ -265,6 +265,42 @@ def test_replicated_execution_across_devices(core):
         client.close()
 
 
+def test_device_resident_buffers_loop(core):
+    # keep_outputs=True detaches results as device-resident handles that
+    # feed straight back as inputs: one upload, N device-side dispatches,
+    # one download (the residency contract of tfr_pjrt_buffer)
+    client = core.PjrtCoreClient("cpu:4")
+    try:
+        hlo = (
+            b"module @f {\n"
+            b"  func.func public @main(%a: tensor<4xf32>)"
+            b" -> tensor<4xf32> {\n"
+            b"    %c = stablehlo.constant dense<1.0> : tensor<4xf32>\n"
+            b"    %0 = stablehlo.add %a, %c : tensor<4xf32>\n"
+            b"    func.return %0 : tensor<4xf32>\n  }\n}\n")
+        exe = client.compile_replicated(hlo, 4)
+        reps = [np.arange(4, dtype=np.float32) + 10 * r for r in range(4)]
+        bufs = exe.execute([[a] for a in reps], keep_outputs=True)
+        for rep in bufs:
+            b = rep[0]
+            assert isinstance(b, core.PjrtDeviceBuffer)
+            assert b.shape == (4,) and b.dtype == np.float32
+        for _ in range(4):
+            bufs = exe.execute(bufs, keep_outputs=True)
+        outs = exe.execute(bufs, keep_outputs=False)
+        for r, out in enumerate(outs):
+            np.testing.assert_array_equal(out[0], reps[r] + 6.0)
+        # handles are reusable (not consumed): run one of them again
+        outs2 = exe.execute(bufs, keep_outputs=False)
+        for r, out in enumerate(outs2):
+            np.testing.assert_array_equal(out[0], reps[r] + 6.0)
+        for rep in bufs:
+            rep[0].close()
+        exe.close()
+    finally:
+        client.close()
+
+
 def test_run_blocks_parallel_matches_sequential(core):
     import jax.numpy as jnp
 
