@@ -1,0 +1,148 @@
+// JVM host demo: a Java program as the EXECUTOR HOST.
+//
+// The reference's first-class host WAS a JVM — Scala code driving the
+// libtensorflow C++ runtime through javacpp JNI bindings
+// (PythonInterface.scala:23-81 -> TensorFlowOps.scala:46-64). This
+// program replays native/host_demo.cpp from Java: no Python, no jax —
+// it parses a TFTPU1 blob serialized by the Python driver
+// (tensorframes_tpu/computation.py:246-341), compiles the raw
+// dynamic-shape StableHLO module at a concrete row count through the
+// C ABI (tfrpjrt.h, reached via the thin JNI glue in tfr_jni.cpp), and
+// executes it on rows it fabricates.
+//
+// Usage:  java -Dtfr.jni=<path/libtfrjni.so> TfrHostDemo <blob> <rows>
+// Exit 0 and a final "JVM_HOST_OK" line on success.
+//
+// Build:  make -C native jni   (needs a JDK; links libtfrpjrt.so)
+
+import java.nio.charset.StandardCharsets;
+import java.nio.file.Files;
+import java.nio.file.Paths;
+
+public final class TfrHostDemo {
+    static {
+        System.load(System.getProperty("tfr.jni"));
+    }
+
+    // thin JNI surface over tfrpjrt.h (handles are opaque longs);
+    // specialized to the demo's one-rank-1-f64-argument shape — the
+    // general host surface is the C ABI itself
+    private static native long clientCreate(String spec);
+    private static native void clientDestroy(long client);
+    private static native String clientPlatform(long client);
+    private static native int deviceCount(long client);
+    private static native long compileDynamicF64(
+        long client, byte[] module, int ccVersion, String platformsCsv,
+        String selectPlatform, long rows);
+    private static native void exeDestroy(long exe);
+    private static native double[] executeF64(long client, long exe,
+                                              double[] x);
+
+    // -- TFTPU1 header scanning (the fixed format of computation.py) ----
+
+    private static long scanLong(String header, String key, long fallback) {
+        int pos = header.indexOf("\"" + key + "\":");
+        if (pos < 0) return fallback;
+        int start = header.indexOf(':', pos) + 1;
+        while (start < header.length()
+               && header.charAt(start) == ' ') start++;
+        int end = start;
+        while (end < header.length()
+               && (Character.isDigit(header.charAt(end))
+                   || header.charAt(end) == '-')) end++;
+        return Long.parseLong(header.substring(start, end));
+    }
+
+    // ["cpu", "tpu"] -> "cpu,tpu"
+    private static String scanStringListCsv(String header, String key) {
+        int pos = header.indexOf("\"" + key + "\":");
+        if (pos < 0) return "";
+        int open = header.indexOf('[', pos);
+        int close = header.indexOf(']', open);
+        if (open < 0 || close < 0) return "";
+        StringBuilder out = new StringBuilder();
+        int i = open;
+        while (i < close) {
+            int q1 = header.indexOf('"', i);
+            if (q1 < 0 || q1 > close) break;
+            int q2 = header.indexOf('"', q1 + 1);
+            if (q2 < 0 || q2 > close) break;
+            if (out.length() > 0) out.append(',');
+            out.append(header, q1 + 1, q2);
+            i = q2 + 1;
+        }
+        return out.toString();
+    }
+
+    public static void main(String[] args) throws Exception {
+        if (args.length < 2) {
+            System.err.println("usage: TfrHostDemo <tftpu1-blob> <rows>");
+            System.exit(2);
+        }
+        byte[] blob = Files.readAllBytes(Paths.get(args[0]));
+        long rows = Long.parseLong(args[1]);
+
+        byte[] magic = "TFTPU1\0".getBytes(StandardCharsets.US_ASCII);
+        for (int i = 0; i < magic.length; i++) {
+            if (blob.length <= i || blob[i] != magic[i]) {
+                System.err.println("not a TFTPU1 blob");
+                System.exit(2);
+            }
+        }
+        // header length: little-endian uint32 after the magic
+        int hlen = (blob[7] & 0xFF) | ((blob[8] & 0xFF) << 8)
+                 | ((blob[9] & 0xFF) << 16) | ((blob[10] & 0xFF) << 24);
+        String header = new String(blob, 11, hlen,
+                                   StandardCharsets.UTF_8);
+        int payloadOff = 11 + hlen;
+        long moduleLen = scanLong(header, "module_len", -1);
+        long ccVersion = scanLong(header, "cc_version", -1);
+        String platforms = scanStringListCsv(header, "platforms");
+        String argDtype = scanStringListCsv(header, "arg_dtypes");
+        int comma = argDtype.indexOf(',');
+        if (comma >= 0) argDtype = argDtype.substring(0, comma);
+        if (moduleLen < 0 || ccVersion < 0) {
+            System.err.println(
+                "blob has no native section (pre-native format?)");
+            System.exit(2);
+        }
+        if (!argDtype.equals("float64")) {
+            System.err.println("demo supports float64 args, got "
+                               + argDtype);
+            System.exit(2);
+        }
+        byte[] module = new byte[(int) moduleLen];
+        System.arraycopy(blob, payloadOff, module, 0, (int) moduleLen);
+        System.err.println("[jvm_host] header: module_len=" + moduleLen
+                           + " cc_version=" + ccVersion
+                           + " platforms=" + platforms);
+
+        long client = clientCreate("cpu");
+        if (client == 0) System.exit(1);
+        String plat = clientPlatform(client);
+        System.err.println("[jvm_host] platform=" + plat
+                           + " devices=" + deviceCount(client));
+
+        long exe = compileDynamicF64(client, module, (int) ccVersion,
+                                     platforms, plat, rows);
+        if (exe == 0) {
+            clientDestroy(client);
+            System.exit(1);
+        }
+        double[] x = new double[(int) rows];
+        for (int i = 0; i < rows; i++) x[i] = i;
+        double[] out = executeF64(client, exe, x);
+        if (out == null) {
+            exeDestroy(exe);
+            clientDestroy(client);
+            System.exit(1);
+        }
+        System.out.printf("out[0] dtype=f64 elems=%d first=%.6f "
+                          + "last=%.6f%n", out.length,
+                          out.length > 0 ? out[0] : 0.0,
+                          out.length > 0 ? out[out.length - 1] : 0.0);
+        exeDestroy(exe);
+        clientDestroy(client);
+        System.out.println("JVM_HOST_OK");
+    }
+}

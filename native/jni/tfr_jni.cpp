@@ -1,0 +1,129 @@
+// JNI glue: the thin shim between TfrHostDemo.java and libtfrpjrt.so.
+//
+// The reference's equivalent was javacpp's generated JNI bindings around
+// libtensorflow (project/Dependencies.scala:36-43); this is the same
+// boundary hand-written for the demo's surface — opaque handles travel
+// as jlong, errors print to stderr and return 0/null (the Java side
+// exits non-zero). Specialized to one rank-1 float64 argument; the
+// general host surface is the C ABI itself (tfrpjrt.h).
+//
+// Build: make -C native jni   (needs JAVA_HOME with include/jni.h)
+
+#include <jni.h>
+
+#include <cstdio>
+#include <vector>
+
+#include "../tfrpjrt.h"
+
+namespace {
+constexpr int kErrLen = 4096;
+}
+
+extern "C" {
+
+JNIEXPORT jlong JNICALL Java_TfrHostDemo_clientCreate(
+    JNIEnv* env, jclass, jstring spec) {
+  const char* s = env->GetStringUTFChars(spec, nullptr);
+  char err[kErrLen] = {0};
+  tfr_pjrt_client* c = tfr_pjrt_client_create(s, err, kErrLen);
+  env->ReleaseStringUTFChars(spec, s);
+  if (!c) std::fprintf(stderr, "client create failed: %s\n", err);
+  return reinterpret_cast<jlong>(c);
+}
+
+JNIEXPORT void JNICALL Java_TfrHostDemo_clientDestroy(
+    JNIEnv*, jclass, jlong client) {
+  tfr_pjrt_client_destroy(reinterpret_cast<tfr_pjrt_client*>(client));
+}
+
+JNIEXPORT jstring JNICALL Java_TfrHostDemo_clientPlatform(
+    JNIEnv* env, jclass, jlong client) {
+  char plat[64] = {0};
+  tfr_pjrt_client_platform(reinterpret_cast<tfr_pjrt_client*>(client),
+                           plat, sizeof(plat));
+  return env->NewStringUTF(plat);
+}
+
+JNIEXPORT jint JNICALL Java_TfrHostDemo_deviceCount(
+    JNIEnv*, jclass, jlong client) {
+  return tfr_pjrt_client_device_count(
+      reinterpret_cast<tfr_pjrt_client*>(client));
+}
+
+JNIEXPORT jlong JNICALL Java_TfrHostDemo_compileDynamicF64(
+    JNIEnv* env, jclass, jlong client, jbyteArray module, jint cc_version,
+    jstring platforms_csv, jstring select_platform, jlong rows) {
+  jsize mlen = env->GetArrayLength(module);
+  jbyte* mbytes = env->GetByteArrayElements(module, nullptr);
+  const char* csv = env->GetStringUTFChars(platforms_csv, nullptr);
+  const char* sel = env->GetStringUTFChars(select_platform, nullptr);
+  int dtypes[1] = {TFR_F64};
+  int ndims[1] = {1};
+  long long dims[1] = {static_cast<long long>(rows)};
+  char err[kErrLen] = {0};
+  tfr_pjrt_exe* exe = tfr_pjrt_compile_dynamic(
+      reinterpret_cast<tfr_pjrt_client*>(client),
+      reinterpret_cast<const char*>(mbytes), static_cast<long>(mlen),
+      static_cast<int>(cc_version), csv, sel, 1, dtypes, ndims, dims,
+      err, kErrLen);
+  env->ReleaseStringUTFChars(select_platform, sel);
+  env->ReleaseStringUTFChars(platforms_csv, csv);
+  env->ReleaseByteArrayElements(module, mbytes, JNI_ABORT);
+  if (!exe) std::fprintf(stderr, "compile failed: %s\n", err);
+  return reinterpret_cast<jlong>(exe);
+}
+
+JNIEXPORT void JNICALL Java_TfrHostDemo_exeDestroy(
+    JNIEnv*, jclass, jlong exe) {
+  tfr_pjrt_exe_destroy(reinterpret_cast<tfr_pjrt_exe*>(exe));
+}
+
+JNIEXPORT jdoubleArray JNICALL Java_TfrHostDemo_executeF64(
+    JNIEnv* env, jclass, jlong client, jlong exe, jdoubleArray x) {
+  jsize rows = env->GetArrayLength(x);
+  jdouble* xv = env->GetDoubleArrayElements(x, nullptr);
+  int dtypes[1] = {TFR_F64};
+  int ndims[1] = {1};
+  long long dims[1] = {static_cast<long long>(rows)};
+  const void* data[1] = {xv};
+  char err[kErrLen] = {0};
+  tfr_pjrt_results* res = tfr_pjrt_execute(
+      reinterpret_cast<tfr_pjrt_client*>(client),
+      reinterpret_cast<tfr_pjrt_exe*>(exe), 1, dtypes, ndims, dims, data,
+      err, kErrLen);
+  env->ReleaseDoubleArrayElements(x, xv, JNI_ABORT);
+  if (!res) {
+    std::fprintf(stderr, "execute failed: %s\n", err);
+    return nullptr;
+  }
+  if (tfr_pjrt_results_count(res) < 1) {
+    std::fprintf(stderr, "no results\n");
+    tfr_pjrt_results_destroy(res);
+    return nullptr;
+  }
+  int odt = 0, ondim = 0;
+  long long odims[8] = {0};
+  if (tfr_pjrt_result_meta(res, 0, &odt, &ondim, odims) ||
+      odt != TFR_F64) {
+    std::fprintf(stderr, "result 0: meta failed or not f64 (%d)\n", odt);
+    tfr_pjrt_results_destroy(res);
+    return nullptr;
+  }
+  long long elems = 1;
+  for (int d = 0; d < ondim; ++d) elems *= odims[d];
+  std::vector<double> out(static_cast<size_t>(elems));
+  if (tfr_pjrt_result_read(res, 0, out.data(), elems * 8, err, kErrLen)) {
+    std::fprintf(stderr, "result read failed: %s\n", err);
+    tfr_pjrt_results_destroy(res);
+    return nullptr;
+  }
+  tfr_pjrt_results_destroy(res);
+  jdoubleArray jout = env->NewDoubleArray(static_cast<jsize>(elems));
+  if (!jout) return nullptr;
+  env->SetDoubleArrayRegion(jout, 0, static_cast<jsize>(elems),
+                            out.data());
+  return jout;
+}
+
+}  // extern "C"

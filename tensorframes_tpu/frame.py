@@ -111,6 +111,13 @@ class Block:
         # 0-row columns carry no shape evidence (their zero-filled cell dims
         # need not match the real blocks'); they are ignored when unifying.
         nonempty = [b for b in blocks if b.num_rows > 0]
+        if len(nonempty) == 1:
+            # single-partition frames concat for free: callers treat
+            # blocks as immutable, so the columns can be shared, not
+            # copied (np.concatenate of one array still copies)
+            b = nonempty[0]
+            return Block({f.name: b.columns[f.name] for f in schema},
+                         b.num_rows)
         if not nonempty:
             if blocks:
                 return Block({f.name: blocks[0].columns[f.name]

@@ -264,7 +264,8 @@ def distribute(df: TensorFrame, mesh: DeviceMesh) -> DistributedFrame:
     the "partitions" are equal shards of one global array (pad rows, zero
     filled, make up the remainder; ``num_rows`` remembers the truth).
     """
-    merged = Block.concat(df.blocks(), df.schema)
+    with span("distribute.concat"):
+        merged = Block.concat(df.blocks(), df.schema)
     n = merged.num_rows
     shards = mesh.num_data_shards
     padded = ((n + shards - 1) // shards) * shards if n else shards
@@ -275,13 +276,22 @@ def distribute(df: TensorFrame, mesh: DeviceMesh) -> DistributedFrame:
             cols[f.name] = _host_side_column(a, f, padded)
             continue
         dd = _dt.device_dtype(f.dtype)
-        if a.dtype != dd:
-            from .. import native as _native
-            a = _native.convert(a, dd)
         if padded != n:
-            pad = [(0, padded - n)] + [(0, 0)] * (a.ndim - 1)
-            a = np.pad(a, pad)
-        cols[f.name] = jax.device_put(a, mesh.row_sharding(a.ndim))
+            # one allocation pads AND casts (assignment casting); empty +
+            # explicit tail zero writes each byte once, where zeros-then-
+            # assign wrote the data region twice
+            with span("distribute.convert_pad"):
+                out = np.empty((padded,) + a.shape[1:], dd)
+                out[:n] = a
+                out[n:] = 0
+            a = out
+        elif a.dtype != dd:
+            # cast-only: the native kernel threads large buffers
+            with span("distribute.convert_pad"):
+                from .. import native as _native
+                a = _native.convert(a, dd)
+        with span("distribute.device_put"):
+            cols[f.name] = jax.device_put(a, mesh.row_sharding(a.ndim))
     return DistributedFrame(mesh, df.schema, cols, n)
 
 

@@ -14,6 +14,21 @@ Usage: python tests/cluster_worker.py <process_id> <num_processes> <port>
 import os
 import sys
 
+# the step matrix, importable by test_cluster.py WITHOUT duplicating it
+# (one source of truth; main() asserts its table matches)
+STEP_NAMES = [
+    "dmap",
+    "dreduce_monoid",
+    "dreduce_generic",
+    "daggregate_monoid",
+    "daggregate_generic",
+    "daggregate_device_keys",
+    "dfilter",
+    "dsort",
+    "daggregate_composite_keys",
+    "checkpoint_resume",
+]
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 os.environ.setdefault(
@@ -35,11 +50,14 @@ def main() -> int:
 
     ``[worker N] STEP <name> OK`` / ``... STEP <name> FAIL`` + traceback —
     the driver-side test file turns each marker into its own pytest test,
-    so a failure names the op instead of dumping one 3000-char tail. A
-    failed step does not stop the rest (the steps only share the
-    read-only distributed frames); the process exit code is the OR of
-    all steps. Collectives stay in lockstep across processes because
-    every step runs unconditionally on every process, in order.
+    so a failure names the op instead of dumping one 3000-char tail.
+
+    The FIRST failure aborts the remaining steps (printed as ``STEP
+    <name> SKIP``): a failure inside a distributed op may leave this
+    process's collective sequence out of lockstep with its peers, and
+    running further collective steps against a desynced peer would hang
+    or corrupt their verdicts. The test file reports skipped steps as
+    inconclusive, naming the step that actually failed.
     """
     import traceback
 
@@ -189,8 +207,9 @@ def main() -> int:
         ("daggregate_composite_keys", step_daggregate_composite_keys),
         ("checkpoint_resume", step_checkpoint_resume),
     ]
+    assert [n for n, _ in steps] == STEP_NAMES  # one source of truth
     failed = False
-    for name, fn in steps:
+    for i, (name, fn) in enumerate(steps):
         try:
             fn()
         except Exception:
@@ -198,6 +217,12 @@ def main() -> int:
             print(f"[worker {pid}] STEP {name} FAIL", flush=True)
             traceback.print_exc(file=sys.stdout)
             sys.stdout.flush()
+            # a failure mid-collective leaves this process out of
+            # lockstep; running more collective steps against a desynced
+            # peer would hang — mark the rest skipped and stop
+            for later, _ in steps[i + 1:]:
+                print(f"[worker {pid}] STEP {later} SKIP", flush=True)
+            break
         else:
             print(f"[worker {pid}] STEP {name} OK", flush=True)
     if not failed:
