@@ -86,12 +86,17 @@ def _child(platform: str) -> None:
     # end-to-end including host<->device marshalling each iteration (the
     # reference's acknowledged weak spot, DataOps.scala:30-33): columnar
     # host frame -> device -> compute -> back to host
-    t0 = time.perf_counter()
-    for _ in range(3):
+
+    def e2e_iter():
         d2 = distribute(df, mesh)
         o2 = dmap_blocks(comp, d2, trim=True)
         np.asarray(o2.columns["z"])
-    e2e = N_ROWS / ((time.perf_counter() - t0) / 3)
+
+    e2e_iter()  # warm: allocator + any per-shape retrace out of the loop
+    t0 = time.perf_counter()
+    for _ in range(5):
+        e2e_iter()
+    e2e = N_ROWS / ((time.perf_counter() - t0) / 5)
 
     # which executor backs the engine path (native C++ core vs in-process
     # jax) — evidence for BASELINE.md, not part of the measured loop above
