@@ -17,6 +17,10 @@ the gap between ``native_resident`` and ``jax`` is the remaining C-ABI
 dispatch overhead. Emits one JSON line per (devices, path).
 
 Run:  python benchmarks/native_mesh_bench.py [rows] [iters]
+      python benchmarks/native_mesh_bench.py [rows] [iters] --chip
+        (chip mode: 1-device mesh on the LIVE platform, native executor
+        against the axon PJRT plugin — the HBM-resident native loop on
+        silicon; wired into run_chip_suite.sh)
 """
 
 from __future__ import annotations
@@ -30,10 +34,19 @@ HERE = os.path.dirname(os.path.abspath(__file__))
 ROOT = os.path.dirname(HERE)
 sys.path.insert(0, ROOT)
 
+CHIP = "--chip" in sys.argv
+# a user-supplied mesh backend is the explicit stand-in escape hatch for
+# testing chip mode off-silicon (e.g. TFT_PJRT_MESH_BACKEND=cpu:1)
+CHIP_BACKEND_OVERRIDDEN = "TFT_PJRT_MESH_BACKEND" in os.environ
+
 if __name__ == "__main__":
-    os.environ.setdefault(
-        "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
-    os.environ["JAX_PLATFORMS"] = "cpu"  # image exports JAX_PLATFORMS=axon
+    if not CHIP:
+        os.environ.setdefault(
+            "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+        # image exports JAX_PLATFORMS=axon
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    else:
+        os.environ.setdefault("TFT_PJRT_MESH_BACKEND", "axon")
     os.environ["TFT_EXECUTOR"] = "pjrt"
 
 import jax  # noqa: E402
@@ -41,7 +54,7 @@ import jax  # noqa: E402
 from benchmarks._platform import force_cpu_if_requested  # noqa: E402
 
 
-def main(n_rows: int = 1_000_000, iters: int = 20):
+def main(n_rows: int = 1_000_000, iters: int = 20, dev_counts=(1, 2, 4, 8)):
     import jax.numpy as jnp
     import numpy as np
     from jax import shard_map
@@ -51,8 +64,10 @@ def main(n_rows: int = 1_000_000, iters: int = 20):
     from tensorframes_tpu.parallel import native_mesh
 
     x_host = np.arange(n_rows, dtype=np.float32) / n_rows
+    plat = jax.devices()[0].platform  # stamped on every line: chip-mode
+    # output must be distinguishable from a 1-device CPU run
 
-    for n_dev in (1, 2, 4, 8):
+    for n_dev in dev_counts:
         mesh = par.local_mesh(n_dev)
         axis = mesh.data_axis
 
@@ -78,12 +93,14 @@ def main(n_rows: int = 1_000_000, iters: int = 20):
         jax.block_until_ready(r)
         jax_s = (time.perf_counter() - t0) / iters
         print(json.dumps({"devices": n_dev, "path": "jax",
-                          "s_per_dispatch": jax_s, "rows": n_rows}))
+                          "s_per_dispatch": jax_s, "rows": n_rows,
+                          "platform": plat}))
 
         ex = native_mesh.executor_for(mesh)
         if ex is None:
             print(json.dumps({"devices": n_dev, "path": "native",
-                              "error": "executor unavailable"}))
+                              "error": "executor unavailable",
+                              "platform": plat}))
             continue
 
         # -- native, host-marshalled per call -----------------------------
@@ -95,7 +112,8 @@ def main(n_rows: int = 1_000_000, iters: int = 20):
             (cur,) = ex.run_sharded(key, build, [cur], in_sh, out_sh, mesh)
         marsh_s = (time.perf_counter() - t0) / iters
         print(json.dumps({"devices": n_dev, "path": "native_marshalled",
-                          "s_per_dispatch": marsh_s, "rows": n_rows}))
+                          "s_per_dispatch": marsh_s, "rows": n_rows,
+                          "platform": plat}))
 
         # -- native, device-resident loop ---------------------------------
         ex.run_sharded_loop(key, build, [x_host], in_sh, out_sh, mesh,
@@ -107,13 +125,24 @@ def main(n_rows: int = 1_000_000, iters: int = 20):
         print(json.dumps({
             "devices": n_dev, "path": "native_resident",
             "s_per_dispatch": res_s, "rows": n_rows,
+            "platform": plat,
             "marshalling_overhead_x": marsh_s / res_s if res_s else None,
             "vs_jax_x": res_s / jax_s if jax_s else None,
         }))
 
 
 if __name__ == "__main__":
-    force_cpu_if_requested()
-    n = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
-    it = int(sys.argv[2]) if len(sys.argv) > 2 else 20
-    main(n, it)
+    if not CHIP or CHIP_BACKEND_OVERRIDDEN:
+        # stand-in chip testing honors JAX_PLATFORMS=cpu too (sitecustomize
+        # would otherwise re-point jax at the tunnelled TPU)
+        force_cpu_if_requested()
+    elif jax.devices()[0].platform not in ("tpu", "axon"):
+        # chip mode on a CPU backend would tee CPU timings into
+        # chip_results.jsonl as silicon evidence
+        print(json.dumps({"error": "chip mode but live platform is "
+                          + jax.devices()[0].platform}))
+        sys.exit(2)
+    pos = [a for a in sys.argv[1:] if not a.startswith("-")]
+    n = int(pos[0]) if len(pos) > 0 else 1_000_000
+    it = int(pos[1]) if len(pos) > 1 else 20
+    main(n, it, dev_counts=(1,) if CHIP else (1, 2, 4, 8))

@@ -66,5 +66,8 @@ run daggregate     580 python benchmarks/daggregate_bench.py 1000000 100000
 # 1-device run keeps the live platform: the fused local-sort round's
 # chip-side constant (columnsort's cost model, BASELINE.md)
 run dsort_local    400 python benchmarks/dsort_steps_bench.py 1000000 1
+# HBM-resident native loop vs jax on the chip (device buffers held by
+# the C++ core across dispatches; BASELINE.md native-dispatch table)
+run native_mesh    400 python benchmarks/native_mesh_bench.py 1000000 20 --chip
 run headline       580 python bench.py
 echo "chip suite complete; results in $OUT"
