@@ -132,10 +132,15 @@ def main(n_rows: int = 1_000_000, iters: int = 20, dev_counts=(1, 2, 4, 8)):
 
 
 if __name__ == "__main__":
-    if not CHIP or CHIP_BACKEND_OVERRIDDEN:
-        # stand-in chip testing honors JAX_PLATFORMS=cpu too (sitecustomize
-        # would otherwise re-point jax at the tunnelled TPU)
+    if not CHIP:
         force_cpu_if_requested()
+    elif CHIP_BACKEND_OVERRIDDEN and \
+            os.environ["TFT_PJRT_MESH_BACKEND"].startswith("cpu"):
+        # stand-in testing with a cpu native backend: pin the jax leg to
+        # cpu too, unconditionally — otherwise sitecustomize points jax
+        # at the tunnelled TPU and the two legs time different platforms
+        # under one stamp
+        jax.config.update("jax_platforms", "cpu")
     elif jax.devices()[0].platform not in ("tpu", "axon"):
         # chip mode on a CPU backend would tee CPU timings into
         # chip_results.jsonl as silicon evidence

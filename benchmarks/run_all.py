@@ -25,11 +25,13 @@ def main(argv=None) -> int:
 
     records = []
     if light:
+        records += marshal_bench.run_ragged(n_rows=10_000, iters=2)
         records += marshal_bench.run(n_scalar=100_000, n_vector=100_000,
                                      iters=2)
         records += e2e_bench.run(n_rows=200_000, iters=2)
         records += baseline_configs.run(heavy=False)
     else:
+        records += marshal_bench.run_ragged()
         records += marshal_bench.run()
         records += e2e_bench.run()
         records += baseline_configs.run()
