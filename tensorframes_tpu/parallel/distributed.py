@@ -1681,13 +1681,12 @@ def _generic_reduce(fetches, dist: DistributedFrame) -> Dict[str, np.ndarray]:
         cache = comp._tft_dreduce_cache = {}
     key = (mesh.mesh, axis, n,
            tuple((f, a.shape, str(a.dtype)) for f, a in zip(names, arrays)))
-    fn = cache.get(key)
-    if fn is None:
-        in_specs = tuple(P(axis, *([None] * (a.ndim - 1))) for a in arrays)
-        # each shard emits its partial with a unit lead axis; stacking over
-        # the data axis yields a (shards, *cell) global array
-        out_specs = tuple(P(axis) for _ in names)
+    in_specs = tuple(P(axis, *([None] * (a.ndim - 1))) for a in arrays)
+    # each shard emits its partial with a unit lead axis; stacking over
+    # the data axis yields a (shards, *cell) global array
+    out_specs = tuple(P(axis) for _ in names)
 
+    def make_program():
         def shard_fn(*local):
             out = comp.fn(
                 {f + "_input": s for f, s in zip(names, local)})
@@ -1709,10 +1708,39 @@ def _generic_reduce(fetches, dist: DistributedFrame) -> Dict[str, np.ndarray]:
                           for f in names})
             return comp.fn({f + "_input": parts[f] for f in names})
 
-        fn = jax.jit(program)
-        cache[key] = fn
-    with span("dreduce_blocks.generic_dispatch"):
-        final = fn(*arrays)
+        return program
+
+    # TFT_EXECUTOR=pjrt: the whole generic reduce — per-shard partials,
+    # the ragged-tail re-reduce, and the final stacked combine — compiles
+    # as one GSPMD executable in the native C++ core
+    final = None
+    nm = _native_mesh(mesh)
+    if nm is not None:
+        def build_prog():
+            program = make_program()
+
+            def prog(*cols):
+                out = program(*cols)
+                return tuple(out[f] for f in names)
+            return prog
+
+        in_shardings = [mesh.row_sharding(a.ndim) for a in arrays]
+        out_shardings = [mesh.replicated() for _ in names]
+        try:
+            outs = nm.run_sharded(("dreduce_generic",) + key, build_prog,
+                                  arrays, in_shardings, out_shardings,
+                                  mesh, owner=comp)
+        except Exception as e:
+            _native_mesh_fallback(e)
+            outs = None
+        if outs is not None:
+            final = dict(zip(names, outs))
+    if final is None:
+        fn = cache.get(key)
+        if fn is None:
+            fn = cache[key] = jax.jit(make_program())
+        with span("dreduce_blocks.generic_dispatch"):
+            final = fn(*arrays)
     out = {}
     for f in fetch_names:
         v = np.asarray(final[f])

@@ -145,6 +145,27 @@ class TestNativeDreduce:
         out = par.dreduce_blocks({"v": "sum"}, dist)
         np.testing.assert_allclose(out["v"], v.sum(axis=0))
 
+    def test_generic_computation_runs_natively(self, mesh4, pjrt_routing):
+        # the arbitrary-computation reduce (per-shard partials + ragged
+        # tail + final stacked combine) compiles as one GSPMD executable
+        import os
+
+        rng = np.random.default_rng(13)
+        x = rng.normal(size=42)  # 42 over 4 shards: tail shard exercised
+        df = tft.frame({"x": x})
+        dist = par.distribute(df, mesh4)
+        ex = _executor(mesh4)
+        before = ex.dispatch_count
+
+        def fetch(x_input):
+            return {"x": jnp.sqrt((x_input ** 2).sum(0))}
+
+        out = par.dreduce_blocks(fetch, dist)
+        assert ex.dispatch_count == before + 1
+        os.environ.pop("TFT_EXECUTOR", None)
+        ref = par.dreduce_blocks(fetch, par.distribute(df, mesh4))
+        np.testing.assert_array_equal(out["x"], ref["x"])
+
     def test_matches_jax_path_exactly(self, mesh4, pjrt_routing):
         # same XLA, same partitioner, same program -> identical floats
         import os
