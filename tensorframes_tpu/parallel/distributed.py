@@ -1635,10 +1635,13 @@ def _generic_reduce(fetches, dist: DistributedFrame) -> Dict[str, np.ndarray]:
     on every shard's local rows in parallel (SPMD — pad-only shards compute
     a garbage partial that is statically sliced away), the ragged tail
     shard's valid prefix is re-reduced on its own, and the partials are
-    combined with one final stacked block-reduce. The only host transfer is
-    the final one-cell result — the reference's driver-collect analogue
-    (``DebugRowOps.scala:511-512``), with the per-shard data never leaving
-    its device.
+    combined with one final stacked block-reduce. On the default jax
+    dispatch the only host transfer is the final one-cell result — the
+    reference's driver-collect analogue (``DebugRowOps.scala:511-512``),
+    with the per-shard data never leaving its device. (Under
+    ``TFT_EXECUTOR=pjrt`` the native route marshals the columns through
+    host numpy per call — the documented correctness-proof trade,
+    ``native_mesh`` module docstring.)
     """
     schema = dist.schema
     comp = _cached_reduce_computation(fetches, schema, ("_input",),
