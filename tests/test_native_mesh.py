@@ -290,6 +290,25 @@ class TestNativeDaggregate:
         for k in ref:
             np.testing.assert_allclose(got[k], ref[k], rtol=1e-12)
 
+    def test_integer_sum_exact(self, mesh4, pjrt_routing):
+        # int64 sums must stay exact through the native route (the XLA
+        # scatter-add flavor is forced exactly because the Pallas one-hot
+        # matmul accumulates in f32)
+        rng = np.random.default_rng(35)
+        k = rng.integers(0, 6, 64).astype(np.int64)
+        # values near 2^53: per-key sums leave f64's exact-integer range,
+        # so a silent float detour (f32 OR f64 accumulation) fails loudly
+        x = rng.integers(2**53 - 2**20, 2**53, 64).astype(np.int64)
+        df = tft.frame({"k": k, "x": x})
+        dist = par.distribute(df, mesh4)
+        ex = _executor(mesh4)
+        before = ex.dispatch_count
+        out = par.daggregate({"x": "sum"}, dist, "k")
+        assert ex.dispatch_count == before + 1
+        got = {r["k"]: r["x"] for r in out.collect()}
+        for kk in np.unique(k):
+            assert got[kk] == x[k == kk].sum(), kk  # exact, not approx
+
     def test_generic_fold_runs_natively(self, mesh4, pjrt_routing):
         # the arbitrary-computation (sorted-scan) path compiles as one
         # GSPMD executable too
@@ -360,6 +379,30 @@ class TestResidentLoop:
         for _ in range(iters):
             (ref,) = fn(ref)
         np.testing.assert_allclose(looped[0], np.asarray(ref), rtol=1e-12)
+
+    def test_loop_multi_arg_mixed_dtypes(self, mesh4, pjrt_routing):
+        # two-state loop (f64 vector + i32 counter), both resident
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        ex = _executor(mesh4)
+        axis = mesh4.data_axis
+        x = np.arange(8, dtype=np.float64)
+        c = np.zeros(8, dtype=np.int32)
+
+        def build():
+            def step(x, c):
+                return (x * 2.0, c + 1)
+            return shard_map(step, mesh=mesh4.mesh,
+                             in_specs=(P(axis), P(axis)),
+                             out_specs=(P(axis), P(axis)))
+
+        sh = [mesh4.row_sharding(1), mesh4.row_sharding(1)]
+        outs = ex.run_sharded_loop(("loop-multi", 8), build, [x, c],
+                                   sh, sh, mesh4, iters=3)
+        assert outs is not None, "two-state program should be routable"
+        np.testing.assert_array_equal(outs[0], x * 8.0)
+        np.testing.assert_array_equal(outs[1], np.full(8, 3, np.int32))
 
     def test_loop_rejects_signature_mismatch(self, mesh4, pjrt_routing):
         from jax import shard_map
