@@ -59,7 +59,11 @@ def executor_for(mesh) -> Optional["NativeMeshExecutor"]:
 
     Enabled by ``TFT_EXECUTOR=pjrt`` (single-process only: a multi-host
     mesh's shards live in other processes, which the in-process native
-    client cannot address). The native client needs at least as many
+    client cannot address — and cross-process native CPU collectives are
+    not buildable from this environment's libtensorflow wheel, whose
+    headers ship only ``in_process_collectives``; no Gloo/MPI backend.
+    Multi-process meshes therefore execute via jax's distributed
+    runtime, by construction, not omission). The native client needs at least as many
     devices as the mesh: ``TFT_PJRT_MESH_BACKEND`` overrides the spec;
     by default a ``cpu`` backend is widened to ``cpu:<n_devices>`` and a
     plugin backend is used as-is (its device count is the grant's).
