@@ -324,6 +324,35 @@ def main():
               f"final distance {history[-1]:.3f}")
     print("centers:\n", centers)
 
+    # mesh variants (C: device-resident frame, D: mesh keyed shuffle)
+    from tensorframes_tpu.parallel.distributed import distribute
+    from tensorframes_tpu.parallel.mesh import local_mesh
+
+    dist = distribute(df, local_mesh())
+    for name, step in [("device_resident", step_device_resident),
+                       ("daggregate", step_daggregate)]:
+        centers, history = kmeans(dist, init, step=step)
+        print(f"[{name}] converged after {len(history)} steps; "
+              f"final distance {history[-1]:.3f}")
+
+    # variant E: the whole loop in the native C++ core, when available
+    import os
+
+    from tensorframes_tpu import native_pjrt
+
+    if native_pjrt.available() and os.environ.get("TFT_EXECUTOR") == "pjrt":
+        try:
+            centers = kmeans_native_resident(dist, init, num_iters=20)
+        except RuntimeError as e:
+            # executor_for can still decline (multi-process, client
+            # failure, too few native devices) after the cheap checks
+            print(f"[native_resident] skipped ({e})")
+        else:
+            print("[native_resident] centers:\n", np.asarray(centers))
+    else:
+        print("[native_resident] skipped (needs TFT_EXECUTOR=pjrt and "
+              "a built native/libtfrpjrt.so)")
+
 
 if __name__ == "__main__":
     from tensorframes_tpu.utils.platform import force_cpu_if_requested
