@@ -65,7 +65,7 @@ def bench(fn, iters=20):
 def main(n_rows: int = 1_000_000, n_dev: int = 8):
     import jax.numpy as jnp
     import numpy as np
-    from jax import shard_map
+    from tensorframes_tpu.utils.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     import tensorframes_tpu as tft

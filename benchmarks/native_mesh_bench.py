@@ -57,7 +57,7 @@ from benchmarks._platform import force_cpu_if_requested  # noqa: E402
 def main(n_rows: int = 1_000_000, iters: int = 20, dev_counts=(1, 2, 4, 8)):
     import jax.numpy as jnp
     import numpy as np
-    from jax import shard_map
+    from tensorframes_tpu.utils.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     from tensorframes_tpu import parallel as par

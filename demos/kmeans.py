@@ -231,7 +231,7 @@ def kmeans_native_resident(dist, init_centers: np.ndarray,
     """
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
+    from tensorframes_tpu.utils.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     from tensorframes_tpu.parallel import native_mesh

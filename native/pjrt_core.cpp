@@ -526,8 +526,11 @@ struct CppClient : ClientIface {
         arg_lists[r].push_back(in_bufs[r].back().get());
       }
     }
-    auto out_or = exe->exe->Execute(absl::MakeSpan(arg_lists),
-                                    xla::ExecuteOptions());
+    // multi-output programs come back as one tuple buffer unless asked
+    // to untuple; CppResults expects one buffer per output
+    xla::ExecuteOptions exec_opts;
+    exec_opts.untuple_result = true;
+    auto out_or = exe->exe->Execute(absl::MakeSpan(arg_lists), exec_opts);
     if (!out_or.ok()) { *err = out_or.status().ToString(); return nullptr; }
     auto* res = new CppResults();
     for (auto& per_replica : out_or.value()) {
@@ -567,8 +570,9 @@ struct CppClient : ClientIface {
       in_ptrs.push_back(in_bufs.back().get());
     }
     std::vector<std::vector<xla::PjRtBuffer*>> arg_lists = {in_ptrs};
-    auto out_or = exe->exe->Execute(absl::MakeSpan(arg_lists),
-                                    xla::ExecuteOptions());
+    xla::ExecuteOptions exec_opts;
+    exec_opts.untuple_result = true;
+    auto out_or = exe->exe->Execute(absl::MakeSpan(arg_lists), exec_opts);
     if (!out_or.ok()) { *err = out_or.status().ToString(); return nullptr; }
     auto* r = new CppResults();
     r->bufs = std::move(out_or.value()[0]);

@@ -6,6 +6,17 @@
 set -euo pipefail
 cd "$(dirname "$0")"
 
+echo "== static check: no bare 'except:' under tensorframes_tpu/ =="
+python tools/check_no_bare_except.py
+
+# --resilience: run only the retry/fallback/fault-injection lane
+# (tests/test_resilience.py) — fast, CPU-only, no native build needed
+if [ "${1:-}" = "--resilience" ]; then
+  shift
+  echo "== resilience lane (pytest -m resilience, CPU) =="
+  exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m resilience "$@"
+fi
+
 echo "== building native runtime (libtfruntime.so) =="
 make -C native
 

@@ -32,23 +32,26 @@ __all__ = [
 ]
 
 
-def map_blocks(fetches, dframe: TensorFrame, trim: bool = False) -> TensorFrame:
+def map_blocks(fetches, dframe: TensorFrame, trim: bool = False,
+               executor=None) -> TensorFrame:
     """Transforms a DataFrame into another DataFrame block by block.
 
     Appends new columns (trim=False) or discards the inputs and returns only
     the computation's outputs (trim=True), in which case the number of rows
     may differ from the input block's. Lazy. Reference: ``core.py:172-218``.
+    ``executor`` overrides the process-default :class:`BlockExecutor`.
     """
-    return _ops.map_blocks(fetches, dframe, trim=trim)
+    return _ops.map_blocks(fetches, dframe, trim=trim, executor=executor)
 
 
-def map_rows(fetches, dframe: TensorFrame) -> TensorFrame:
+def map_rows(fetches, dframe: TensorFrame, executor=None) -> TensorFrame:
     """Transforms a DataFrame row by row, adding one column per fetch.
 
     Works on cells (no leading block dimension); the only op that accepts
     rows whose vector cells vary in size. Lazy. Reference: ``core.py:132-170``.
+    ``executor`` overrides the process-default padding executor.
     """
-    return _ops.map_rows(fetches, dframe)
+    return _ops.map_rows(fetches, dframe, executor=executor)
 
 
 def _unpack(result: Dict[str, np.ndarray], names: Sequence[str]):

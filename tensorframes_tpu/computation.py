@@ -337,9 +337,8 @@ class Computation:
                 version = _sh.get_minimum_version()
             except Exception:
                 version = "0.9.0"
-            from jax._src.lib import _jax as _jaxlib
-            module = _jaxlib.mlir.serialize_portable_artifact(
-                module, version)
+            from .utils.compat import serialize_stablehlo_artifact
+            module = serialize_stablehlo_artifact(module, version)
         if platforms is None:
             platforms = (jax.default_backend(),)
         platforms = tuple("tpu" if p == "axon" else p for p in platforms)
@@ -353,7 +352,9 @@ class Computation:
         n = len(inputs)
 
         def build_exported(out_avals):
-            return jax_export.Exported(
+            import dataclasses as _dc
+
+            kwargs = dict(
                 fun_name="foreign_stablehlo",
                 in_tree=jtu.tree_structure((tuple(in_avals), {})),
                 in_avals=in_avals,
@@ -376,6 +377,11 @@ class Computation:
                 uses_global_constants=False,
                 _get_vjp=None,
             )
+            # the named-shardings triple is newer than some supported jax
+            # builds; construct with whatever fields this Exported declares
+            fields = {f.name for f in _dc.fields(jax_export.Exported)}
+            return jax_export.Exported(
+                **{k: v for k, v in kwargs.items() if k in fields})
 
         if outputs is None:
             # the module knows its results; discover them abstractly by
@@ -463,9 +469,9 @@ def _module_result_avals(bytecode: bytes):
     :meth:`Computation.from_stablehlo` is given no output specs."""
     import re
 
-    from jax._src.lib import _jax as _jaxlib
+    from .utils.compat import deserialize_stablehlo_artifact
 
-    text = _jaxlib.mlir.deserialize_portable_artifact(bytecode)
+    text = deserialize_stablehlo_artifact(bytecode)
     if isinstance(text, bytes):
         text = text.decode("utf-8", errors="replace")
     m = re.search(

@@ -61,7 +61,11 @@ def lower_nodes(fetches: Sequence[Node]):
             if n.op == "Placeholder":
                 vals[id(n)] = jnp.asarray(inputs[n.name])
             elif n.op == "Const":
-                vals[id(n)] = jnp.asarray(n.value)
+                # the numpy value stays raw: jnp ops lift it to a jaxpr
+                # literal, whereas jnp.asarray here stamps a device_put
+                # into the trace and breaks the prim-for-prim parity with
+                # handwritten JAX that the golden DSL tests assert
+                vals[id(n)] = n.value
             else:
                 vals[id(n)] = n.impl(*[vals[id(p)] for p in n.parents])
         return {f.name: vals[id(f)] for f in fetches}

@@ -25,13 +25,87 @@ import numpy as np
 from .. import dtypes as _dt
 from .. import native as _native
 from ..computation import Computation
+from ..resilience import default_policy, env_bool, faults, is_oom
 from ..utils.logging import get_logger
-from ..utils.tracing import enabled as _tracing_enabled, span
+from ..utils.tracing import counters, span
 
 __all__ = ["BlockExecutor", "PaddingExecutor", "default_executor",
            "default_padding_executor"]
 
 _log = get_logger("engine.executor")
+
+
+def _oom_split_enabled() -> bool:
+    return env_bool("TFT_OOM_SPLIT", True)
+
+
+def _split_rows(comp: Computation, arrays: Mapping, n_rows: int):
+    """Halve the row dimension: two input mappings whose row-dimensioned
+    inputs are the top / bottom halves (non-row inputs ride whole)."""
+    half = n_rows // 2
+    first, second = {}, {}
+    for spec in comp.inputs:
+        a = arrays[spec.name]
+        if spec.shape.ndim > 0 and spec.shape.head == -1:
+            first[spec.name] = a[:half]
+            second[spec.name] = a[half:]
+        else:
+            first[spec.name] = a
+            second[spec.name] = a
+    return first, second
+
+
+def _concat_outputs(comp: Computation, a: Mapping, b: Mapping):
+    """Stitch two half-block results back together; every output must be
+    row-dimensioned (the row-local contract the split path requires)."""
+    out = {}
+    for spec in comp.outputs:
+        if not (spec.shape.ndim > 0 and spec.shape.head == -1):
+            raise ValueError(
+                f"output {spec.name!r} has no row dimension; the OOM "
+                f"split path only serves row-local computations")
+        out[spec.name] = np.concatenate([a[spec.name], b[spec.name]])
+    return out
+
+
+def _oom_split_run(executor, comp: Computation, arrays: Mapping,
+                   n_rows: Optional[int], cause: BaseException):
+    """Re-dispatch an OOM'd row-local block as two halves (recursively:
+    a half that still OOMs halves again through the same path).
+
+    The caller established row-locality before calling; each half runs
+    at its EXACT shape (``pad_ok=False``) — re-padding a half back up to
+    the minimum bucket would dispatch the identical program and OOM
+    identically, making the recovery futile for small blocks.
+
+    Returns the stitched outputs, or re-raises ``cause`` when splitting
+    is impossible (no rows / single row / non-row outputs / disabled).
+    """
+    if (not _oom_split_enabled() or not n_rows or n_rows < 2
+            or any(not (s.shape.ndim > 0 and s.shape.head == -1)
+                   for s in comp.outputs)):
+        raise cause
+    counters.inc("oom_split.dispatches")
+    _log.warning(
+        "block dispatch hit an OOM-shaped failure (%s); re-dispatching "
+        "as two %d/%d-row halves", cause, n_rows // 2,
+        n_rows - n_rows // 2)
+    first, second = _split_rows(comp, arrays, n_rows)
+    with span("executor.oom_split"):
+        out_a = _run_half(executor, comp, first, n_rows // 2)
+        out_b = _run_half(executor, comp, second, n_rows - n_rows // 2)
+    return _concat_outputs(comp, out_a, out_b)
+
+
+def _run_half(executor, comp: Computation, arrays: Mapping, n_rows: int):
+    """One half of a split: exact-shape dispatch, recursing into a
+    further split when the half itself still OOMs."""
+    try:
+        return executor.run(comp, arrays, pad_ok=False)
+    except Exception as e:
+        if is_oom(e):
+            return _oom_split_run(executor, comp, arrays, n_rows, e)
+        raise
 
 
 def _next_bucket(n: int, minimum: int = 8) -> int:
@@ -100,6 +174,13 @@ class BlockExecutor:
 
     # -- compile cache -----------------------------------------------------
     def _compiled(self, comp: Computation, sig: Tuple):
+        # Double-checked locking: the lock-free fast path is safe under
+        # the GIL (a dict read racing a dict write sees either the old or
+        # the new table, never a torn one); EVERY mutation of the
+        # weak-keyed outer map and the per-computation signature dicts
+        # happens under self._lock, so two threads racing the same new
+        # signature compile once and both get that executable
+        # (tests/test_resilience.py::TestConcurrentDispatch).
         per_comp = self._cache.get(comp)
         fn = None if per_comp is None else per_comp.get(sig)
         if fn is None:
@@ -115,6 +196,29 @@ class BlockExecutor:
         return fn
 
     # -- execution ---------------------------------------------------------
+    def _dispatch(self, comp: Computation, dev_arrays: Mapping):
+        """Compile (cached) + dispatch one signature, with transient
+        failures retried under the process policy. Fault sites:
+        ``compile``, ``dispatch``, ``oom``."""
+        sig = tuple(sorted(
+            (n, a.shape, str(a.dtype)) for n, a in dev_arrays.items()))
+
+        def attempt():
+            faults.check("compile")
+            fn = self._compiled(comp, sig)
+            faults.check("dispatch")
+            faults.check("oom")
+            with span("executor.dispatch"):
+                out = fn(dev_arrays)
+                # JAX dispatch is async: an execution failure would
+                # otherwise surface at convert_back's np.asarray, OUTSIDE
+                # this retry and the OOM-split handlers (it also keeps
+                # device time attributed to this span)
+                jax.block_until_ready(out)
+            return out
+
+        return default_policy().call(attempt, op="executor.dispatch")
+
     def run(self, comp: Computation,
             arrays: Mapping[str, np.ndarray],
             pad_ok: bool = True) -> Dict[str, np.ndarray]:
@@ -122,6 +226,11 @@ class BlockExecutor:
 
         Inputs are cast to their device dtypes (double -> f32 on TPU) and
         outputs cast back to the computation's declared storage dtypes.
+
+        Failure handling (``docs/resilience.md``): transient dispatch
+        errors retry with backoff; a failing bucketed (padded) compile
+        falls back to the exact shape; an OOM-shaped error on a row-local
+        dispatch re-runs the block as two halves.
         """
         dev_arrays = {}
         n_rows = None
@@ -135,21 +244,38 @@ class BlockExecutor:
                 if spec.shape.ndim > 0 and spec.shape.head == -1:
                     n_rows = a.shape[0] if n_rows is None else n_rows
 
+        # pad_rows+pad_ok is the executor's row-locality contract — the
+        # same property that makes padding safe makes halving safe
+        row_local = bool(self.pad_rows and pad_ok and n_rows)
         pad_to = None
-        if self.pad_rows and pad_ok and n_rows:  # 0-row blocks never pad
+        if row_local:  # 0-row blocks never pad
             pad_to = _next_bucket(n_rows)
-            if pad_to != n_rows:
-                dev_arrays = _pad_inputs(comp, dev_arrays, pad_to, n_rows)
+            if pad_to == n_rows:
+                pad_to = None
 
-        sig = tuple(sorted(
-            (n, a.shape, str(a.dtype)) for n, a in dev_arrays.items()))
-        fn = self._compiled(comp, sig)
-        with span("executor.dispatch"):
-            out = fn(dev_arrays)
-            if _tracing_enabled():
-                # JAX dispatch is async; without this the device time would
-                # be misattributed to convert_back's np.asarray
-                jax.block_until_ready(out)
+        out = None
+        if pad_to is not None:
+            try:
+                faults.check("pad_compile")
+                padded = _pad_inputs(comp, dev_arrays, pad_to, n_rows)
+                out = self._dispatch(comp, padded)
+            except Exception as e:
+                if is_oom(e):
+                    return _oom_split_run(self, comp, arrays, n_rows, e)
+                counters.inc("pad_fallback.compiles")
+                _log.warning(
+                    "bucketed %d-row compile/dispatch failed (%s); "
+                    "falling back to the exact %d-row shape",
+                    pad_to, e, n_rows)
+                pad_to = None
+        if out is None:
+            try:
+                out = self._dispatch(comp, dev_arrays)
+            except Exception as e:
+                if is_oom(e) and row_local:
+                    return _oom_split_run(self, comp, arrays, n_rows, e)
+                raise
+
         result: Dict[str, np.ndarray] = {}
         with span("executor.convert_back"):
             host_out = {s.name: np.asarray(out[s.name])
@@ -193,9 +319,26 @@ class PaddingExecutor:
         n_rows = _row_count(comp, arrays)
         pad_to = _next_bucket(n_rows) if (pad_ok and n_rows) else None
         if pad_to is None or pad_to == n_rows:  # incl. 0-row blocks
+            try:
+                return self.inner.run(comp, arrays, pad_ok=False)
+            except Exception as e:
+                if is_oom(e) and pad_ok:  # pad_ok == row-local here
+                    return _oom_split_run(self, comp, arrays, n_rows, e)
+                raise
+        try:
+            faults.check("pad_compile")
+            padded = _pad_inputs(comp, arrays, pad_to, n_rows)
+            out = self.inner.run(comp, padded, pad_ok=False)
+        except Exception as e:
+            if is_oom(e):
+                return _oom_split_run(self, comp, arrays, n_rows, e)
+            # a failing bucketed compile must not take the job down when
+            # the exact shape (the no-padding semantics) can still run
+            counters.inc("pad_fallback.compiles")
+            _log.warning(
+                "bucketed %d-row compile failed (%s); falling back to "
+                "the exact %d-row shape", pad_to, e, n_rows)
             return self.inner.run(comp, arrays, pad_ok=False)
-        padded = _pad_inputs(comp, arrays, pad_to, n_rows)
-        out = self.inner.run(comp, padded, pad_ok=False)
         return _slice_outputs(comp, out, pad_to, n_rows)
 
     def clear(self):

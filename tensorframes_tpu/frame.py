@@ -391,13 +391,14 @@ class TensorFrame:
         return GroupedFrame(self, list(cols))
 
     # -- fluent op sugar (reference dsl/Implicits.scala:12-123) ------------
-    def map_blocks(self, fetches, trim: bool = False) -> "TensorFrame":
+    def map_blocks(self, fetches, trim: bool = False,
+                   executor=None) -> "TensorFrame":
         from . import api
-        return api.map_blocks(fetches, self, trim=trim)
+        return api.map_blocks(fetches, self, trim=trim, executor=executor)
 
-    def map_rows(self, fetches) -> "TensorFrame":
+    def map_rows(self, fetches, executor=None) -> "TensorFrame":
         from . import api
-        return api.map_rows(fetches, self)
+        return api.map_rows(fetches, self, executor=executor)
 
     def reduce_blocks(self, fetches):
         from . import api

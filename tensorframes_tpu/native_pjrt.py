@@ -692,11 +692,21 @@ class PjrtBlockExecutor:
     def run(self, comp: Computation, arrays: Mapping[str, np.ndarray],
             pad_ok: bool = True) -> Dict[str, np.ndarray]:
         del pad_ok  # exact-shape compiles; padding never applies
+        from .resilience import default_policy, faults
+
         in_names = [s.name for s in comp.inputs]
         dev_arrays = _device_views(comp, arrays)
-        exe = self._compiled(comp, dev_arrays)
-        outs = exe.execute([dev_arrays[n] for n in in_names])
-        return _to_storage(comp, outs)
+
+        def attempt():
+            faults.check("pjrt_execute")
+            exe = self._compiled(comp, dev_arrays)
+            outs = exe.execute([dev_arrays[n] for n in in_names])
+            return _to_storage(comp, outs)
+
+        # PjrtCoreError carries the PJRT status word (UNAVAILABLE /
+        # ABORTED / ...) in its message, which is exactly what the
+        # transient classifier keys on
+        return default_policy().call(attempt, op="pjrt.execute")
 
     def run_blocks_parallel(self, comp: Computation, blocks,
                             ) -> "list[Dict[str, np.ndarray]]":

@@ -22,17 +22,18 @@ from __future__ import annotations
 
 from typing import FrozenSet
 
-import jax
+from ..utils.compat import vma_of
 
 __all__ = ["vma_union", "interpret_blocked_by_vma"]
 
 
 def vma_union(*arrays) -> FrozenSet[str]:
     """Union of the varying-mesh-axes of every input — the ``vma`` a
-    per-shard kernel's ``out_shape`` must declare."""
+    per-shard kernel's ``out_shape`` must declare. Empty on jax builds
+    without vma tracking (nothing to declare there)."""
     out: FrozenSet[str] = frozenset()
     for a in arrays:
-        out = out | frozenset(jax.typeof(a).vma)
+        out = out | vma_of(a)
     return out
 
 

@@ -26,6 +26,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..utils.compat import shape_dtype_struct, tpu_compiler_params
 from ._pallas_mesh import interpret_blocked_by_vma, vma_union
 
 __all__ = ["segment_sum"]
@@ -79,9 +80,9 @@ def _pallas_segment_sum(values, segment_ids, num_segments: int,
             pl.BlockSpec((block_rows, d), lambda i: (i, 0)),
         ],
         out_specs=pl.BlockSpec((num_segments, d), lambda i: (0, 0)),
-        out_shape=jax.ShapeDtypeStruct((num_segments, d), acc_dtype,
-                                       vma=vma),
-        compiler_params=pltpu.CompilerParams(
+        out_shape=shape_dtype_struct((num_segments, d), acc_dtype,
+                                     vma=vma),
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("arbitrary",)),
         interpret=interpret,
     )(segment_ids.astype(jnp.int32).reshape(-1, 1), values)

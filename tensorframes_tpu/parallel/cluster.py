@@ -31,38 +31,192 @@ import numpy as np
 
 from .. import dtypes as _dt
 from ..frame import TensorFrame
+from ..resilience import (ClusterInitError, DeadlineExceeded, deadline,
+                          default_policy, env_bool, env_float, faults,
+                          is_transient, remaining_time)
 from ..schema import Schema
+from ..utils.compat import distributed_is_initialized
+from ..utils.logging import get_logger
+from ..utils.tracing import counters
 from .distributed import DistributedFrame
 from .mesh import DeviceMesh
 
 __all__ = ["initialize", "cluster_mesh", "distribute_local",
            "process_index", "process_count"]
 
+_log = get_logger("parallel.cluster")
+
+# default bound on the whole bootstrap (connect + retries); jax's own
+# default (300s) is tuned for pod schedulers, far too patient for the
+# "coordinator address is simply wrong" failure mode at the heart of
+# multi-process bring-up problems (TF-HPC, arXiv:1903.04364 §5)
+_DEFAULT_BOOTSTRAP_TIMEOUT = 60.0
+
 
 def initialize(coordinator_address: Optional[str] = None,
                num_processes: Optional[int] = None,
                process_id: Optional[int] = None,
-               **kwargs) -> None:
-    """Join this process to the cluster (idempotent).
+               timeout: Optional[float] = None,
+               **kwargs) -> bool:
+    """Join this process to the cluster (idempotent). Returns True when
+    the process is part of a multi-process cluster afterwards, False when
+    it degraded to (or already was) single-process.
 
-    Thin policy wrapper over ``jax.distributed.initialize``: explicit
+    Policy wrapper over ``jax.distributed.initialize``: explicit
     arguments win, otherwise ``TFT_COORDINATOR`` / ``TFT_NUM_PROCESSES`` /
     ``TFT_PROCESS_ID`` are read, otherwise jax's own autodetection (TPU
     pod metadata, SLURM, ...) runs. Call before the first jax operation.
+
+    Robustness semantics (see ``docs/resilience.md``):
+
+    - a partially-specified cluster env (e.g. a coordinator address with
+      no process count) raises ``ValueError`` immediately instead of
+      handing jax a spec that hangs;
+    - the whole bootstrap is bounded by ``timeout`` (or
+      ``TFT_BOOTSTRAP_TIMEOUT``, default 60s) and retried with backoff:
+      an explicitly-configured cluster keeps retrying until that deadline
+      (the coordinator may simply not be up yet), autodetection retries
+      under the attempt-counted process policy (``TFT_RETRY_*`` knobs);
+    - when the bootstrap still fails, the process degrades to a
+      single-process mesh with a LOUD warning — unless
+      ``TFT_REQUIRE_CLUSTER=1``, which turns degradation into a
+      :class:`~..resilience.ClusterInitError` raised within the deadline.
     """
     import os
 
-    if jax.distributed.is_initialized():  # already up
-        return
+    if distributed_is_initialized():  # already up
+        return jax.process_count() > 1
+
     coordinator_address = coordinator_address or os.environ.get(
         "TFT_COORDINATOR")
     if num_processes is None and os.environ.get("TFT_NUM_PROCESSES"):
         num_processes = int(os.environ["TFT_NUM_PROCESSES"])
     if process_id is None and os.environ.get("TFT_PROCESS_ID"):
         process_id = int(os.environ["TFT_PROCESS_ID"])
-    jax.distributed.initialize(coordinator_address=coordinator_address,
-                               num_processes=num_processes,
-                               process_id=process_id, **kwargs)
+
+    spec = {"TFT_COORDINATOR / coordinator_address": coordinator_address,
+            "TFT_NUM_PROCESSES / num_processes": num_processes,
+            "TFT_PROCESS_ID / process_id": process_id}
+    given = [k for k, v in spec.items() if v is not None]
+    missing = [k for k, v in spec.items() if v is None]
+    if given and missing:
+        # a partial spec reaches jax.distributed as a malformed cluster
+        # and surfaces as an opaque hang/grpc error; fail fast instead
+        raise ValueError(
+            f"partially-specified cluster environment: {given} set but "
+            f"{missing} missing — set all three (or none, for "
+            f"single-process / autodetection)")
+    if coordinator_address is not None:
+        # malformed addresses fail fast like the partial spec above —
+        # retrying (or degrading on) a typo helps nobody
+        _parse_hostport(coordinator_address)
+
+    if timeout is None:
+        timeout = env_float("TFT_BOOTSTRAP_TIMEOUT",
+                            _DEFAULT_BOOTSTRAP_TIMEOUT)
+    require_cluster = env_bool("TFT_REQUIRE_CLUSTER", False)
+    if given:
+        # an explicitly-configured cluster is retried until the bootstrap
+        # deadline, not for an attempt count: connection-refused is
+        # near-instant while the coordinator has not bound its port yet
+        # (the normal worker-before-coordinator launch race), so a
+        # 3-attempt budget would give up in milliseconds and split-brain
+        # the job. The retry loop's deadline accounting ends the loop.
+        policy = default_policy(max_attempts=1_000_000)
+    else:
+        # autodetection: a handful of tries is plenty — "no cluster
+        # detected" answers quickly and is usually the final answer
+        policy = default_policy()
+
+    def attempt() -> None:
+        faults.check("cluster_init")
+        if distributed_is_initialized():
+            return  # a slow earlier attempt won the race after all
+        left = remaining_time()
+        if coordinator_address is not None and process_id not in (None, 0):
+            # probe the coordinator over plain TCP FIRST: on several
+            # jaxlib versions a failed in-process connect ends in
+            # LOG(FATAL) (the distributed client terminates the whole
+            # process), which no Python-level retry could survive. A
+            # refused/timed-out socket here raises ConnectionError /
+            # TimeoutError — both transient, both retried.
+            _probe_coordinator(coordinator_address,
+                               min(left, 10.0) if left else 10.0)
+        kw = dict(kwargs)
+        if left is not None and "initialization_timeout" not in kw:
+            # per-attempt bound: jax's own default (300s) would swallow
+            # the whole budget in one try
+            kw["initialization_timeout"] = max(1, int(left))
+        jax.distributed.initialize(coordinator_address=coordinator_address,
+                                   num_processes=num_processes,
+                                   process_id=process_id, **kw)
+
+    try:
+        with deadline(timeout):
+            policy.call(attempt, op="cluster_init")
+    except Exception as e:
+        if require_cluster:
+            counters.inc("cluster_init.failures")
+            raise ClusterInitError(
+                f"cluster bootstrap failed within {timeout}s and "
+                f"TFT_REQUIRE_CLUSTER is set: {e}") from e
+        if (not given and not isinstance(e, DeadlineExceeded)
+                and not is_transient(e)):
+            # nothing was configured and autodetection said "no cluster
+            # here" — the normal single-process case, not a failure (no
+            # counter). A TRANSIENT error that survived the retry budget
+            # is different: a cluster was within reach and bootstrap
+            # genuinely failed, which must be a loud degradation.
+            _log.debug("no cluster detected (%s); running single-process",
+                       e)
+            return False
+        counters.inc("cluster_init.failures")
+        counters.inc("cluster_init.degraded")
+        _log.warning(
+            "DEGRADED TO SINGLE-PROCESS: cluster bootstrap failed (%s). "
+            "Collectives will only span this process's devices; set "
+            "TFT_REQUIRE_CLUSTER=1 to make this fatal instead.", e)
+        return False
+    return jax.process_count() > 1
+
+
+def _parse_hostport(address: str):
+    """``host:port`` / ``[v6]:port`` → ``(host, port)``; ``ValueError``
+    on anything a socket connect could not use."""
+    host, sep, port_s = address.rpartition(":")
+    if host.startswith("[") and host.endswith("]"):
+        host = host[1:-1]  # bracketed IPv6 literal
+    try:
+        port = int(port_s)
+    except ValueError:
+        port = -1
+    if not sep or not 0 < port < 65536:
+        raise ValueError(
+            f"coordinator address {address!r} is not host:port")
+    return host or "127.0.0.1", port
+
+
+def _probe_coordinator(address: str, timeout: float) -> None:
+    """One TCP connect to the coordinator, bounded by ``timeout``.
+
+    Raises ``ConnectionError`` (refused/reset) or ``TimeoutError``
+    (unroutable) — the transient classifications the retry loop expects.
+    """
+    import socket
+
+    host, port = _parse_hostport(address)
+    try:
+        sock = socket.create_connection((host, port),
+                                        timeout=max(timeout, 0.001))
+    except socket.timeout as e:  # pre-3.10 spelling of TimeoutError
+        raise TimeoutError(
+            f"coordinator {address} unreachable within {timeout:.1f}s"
+        ) from e
+    except OSError as e:
+        raise ConnectionError(
+            f"coordinator {address} not accepting connections: {e}"
+        ) from e
+    sock.close()
 
 
 def process_index() -> int:

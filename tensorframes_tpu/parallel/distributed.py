@@ -32,11 +32,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
+from ..utils.compat import shard_map
 
 from .. import dtypes as _dt
 from ..engine import ops as _ops
 from ..frame import Block, TensorFrame
+from ..resilience import default_policy as _default_policy, faults as _faults
 from ..schema import Schema
 from .collectives import COMBINERS
 from .mesh import DeviceMesh
@@ -347,8 +348,23 @@ def dmap_blocks(fetches, dist: DistributedFrame, trim: bool = False,
                                     shard_valid=dist.shard_valid)
 
     jitted = _jitted(comp)
-    with span("dmap_blocks.dispatch"):
-        out = jitted({n: dist.columns[n] for n in comp.input_names})
+    policy = _default_policy()
+
+    def _dispatch():
+        _faults.check("dmap")
+        with span("dmap_blocks.dispatch"):
+            out = jitted({n: dist.columns[n] for n in comp.input_names})
+            if policy.max_attempts > 1:
+                # jax dispatch is async: without this barrier an
+                # execution failure would surface at a later consumption
+                # of `out`, outside the retry. TFT_RETRY_MAX_ATTEMPTS=1
+                # restores fire-and-forget pipelining for hot loops.
+                jax.block_until_ready(out)
+            return out
+
+    # one jit dispatch covers every shard: a transient PJRT failure here
+    # would otherwise kill the whole mesh map
+    out = policy.call(_dispatch, op="dmap_blocks.dispatch")
     leads = {out[s.name].shape[0] for s in comp.outputs}
     if len(leads) > 1:
         raise ValueError(

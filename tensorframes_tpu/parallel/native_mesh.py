@@ -430,7 +430,7 @@ class NativeMeshExecutor:
         + combiners + shapes). Outputs are replicated (one numpy array
         per reduced column).
         """
-        from jax import shard_map
+        from ..utils.compat import shard_map
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         mesh = dist.mesh

@@ -21,7 +21,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+from ..utils import compat as _compat
+from ..utils.compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from .mesh import DeviceMesh
@@ -39,7 +40,7 @@ def _varying(a, *axes: Optional[str]):
     as they combine with the sharded q block."""
     if not hasattr(jax.lax, "pcast"):
         return a
-    have = getattr(jax.typeof(a), "vma", ())
+    have = _compat.vma_of(a)
     need = tuple(ax for ax in axes if ax is not None and ax not in have)
     if not need:
         return a

@@ -1,0 +1,85 @@
+"""Exception classification for the resilience layer.
+
+Three buckets, three responses:
+
+- **transient** — worth retrying: coordinator/connection hiccups, PJRT
+  ``UNAVAILABLE``/``ABORTED``/``DEADLINE_EXCEEDED`` statuses, injected
+  faults. Retried under a :class:`~.policy.RetryPolicy`.
+- **oom** — ``RESOURCE_EXHAUSTED`` / out-of-memory shapes: retrying the
+  same program would fail identically, but HALVING the rows and running
+  the two halves usually succeeds for row-local computations
+  (``engine/executor.py``'s split-block re-dispatch).
+- **permanent** — everything else (shape errors, type errors, compile
+  diagnostics): fail fast, loudly, once.
+
+Classification is string-based on purpose: the error types that matter
+(``XlaRuntimeError``, ``PjrtCoreError``, grpc errors) cross a C++/Python
+boundary where the *status word* in the message is the stable contract,
+not the Python class.
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["is_transient", "is_oom", "is_permanent",
+           "TRANSIENT_MARKERS", "OOM_MARKERS"]
+
+# XLA/PJRT status words + socket-layer phrases that indicate the failure
+# was environmental, not the program's fault.
+TRANSIENT_MARKERS = (
+    "UNAVAILABLE",
+    "ABORTED",
+    "DEADLINE_EXCEEDED",
+    "CANCELLED",
+    "connection refused",
+    "connection reset",
+    "socket closed",
+    "temporarily unavailable",
+    "injected transient fault",  # resilience.faults
+)
+
+OOM_MARKERS = (
+    "RESOURCE_EXHAUSTED",
+    "out of memory",
+    "Out of memory",
+    "OOM",
+)
+
+
+def _extra_transient_markers() -> tuple:
+    """Operator-extensible marker list: ``TFT_TRANSIENT_ERRORS`` is a
+    comma-separated set of additional substrings to treat as transient
+    (an escape hatch for backend-specific status texts)."""
+    raw = os.environ.get("TFT_TRANSIENT_ERRORS", "")
+    return tuple(m.strip() for m in raw.split(",") if m.strip())
+
+
+def is_oom(exc: BaseException) -> bool:
+    """True when the failure is an out-of-memory shape — NOT retried
+    as-is; the executor's split-block path handles it."""
+    if isinstance(exc, MemoryError):
+        return True
+    msg = str(exc)
+    return any(m in msg for m in OOM_MARKERS)
+
+
+def is_transient(exc: BaseException) -> bool:
+    """True when retrying the same operation may legitimately succeed."""
+    from .faults import InjectedFault
+
+    if isinstance(exc, InjectedFault):
+        return exc.transient
+    if is_oom(exc):
+        return False  # same program, same memory: split, don't retry
+    if isinstance(exc, (ConnectionError, TimeoutError)):
+        return True
+    msg = str(exc)
+    if any(m in msg for m in TRANSIENT_MARKERS):
+        return True
+    extra = _extra_transient_markers()
+    return bool(extra) and any(m in msg for m in extra)
+
+
+def is_permanent(exc: BaseException) -> bool:
+    return not is_transient(exc) and not is_oom(exc)

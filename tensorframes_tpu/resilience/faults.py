@@ -1,0 +1,177 @@
+"""Deterministic fault injection for the resilience layer.
+
+The retry/fallback paths in this package exist for failures that cannot
+be produced on demand — a TPU coordinator timing out, a bucketed compile
+dying inside PJRT, an HBM OOM. This harness makes them reproducible:
+instrumented sites in the execution layers call :func:`check(site)
+<check>`, which raises a scripted :class:`InjectedFault` while that
+site's budget lasts, then goes quiet. The tier-1 resilience suite drives
+every recovery path end-to-end on CPU this way.
+
+Two drivers:
+
+- context manager (tests): ``with inject("compile", fail_n=2): ...`` —
+  the first two ``check("compile")`` calls raise, the third passes.
+- environment (whole-process experiments): ``TFT_FAULTS="compile:2,
+  dispatch:1"`` arms the same budgets at import time — useful for
+  chaos-testing a real run without editing code.
+
+Instrumented sites (see ``docs/resilience.md``):
+
+========== ===========================================================
+site        raised from
+========== ===========================================================
+cluster_init ``parallel.cluster.initialize`` bootstrap attempt
+compile      ``engine.executor.BlockExecutor`` signature compile
+dispatch     ``engine.executor.BlockExecutor`` block dispatch
+pad_compile  ``engine.executor.PaddingExecutor`` bucketed-compile path
+oom          ``engine.executor.BlockExecutor`` dispatch, OOM-shaped
+pjrt_execute ``native_pjrt.PjrtBlockExecutor`` native-core dispatch
+dmap         ``parallel.distributed.dmap_blocks`` mesh dispatch
+========== ===========================================================
+
+Counting is deterministic (a lock-guarded integer per site, decremented
+per check), so a test asserting "succeeds on the 3rd attempt" is exact,
+never flaky.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+from typing import Dict, Iterator, Optional
+
+from ..utils.logging import get_logger
+from ..utils.tracing import counters
+
+__all__ = ["InjectedFault", "inject", "check", "arm", "reset", "active"]
+
+_log = get_logger("resilience.faults")
+
+
+class InjectedFault(RuntimeError):
+    """A scripted failure from :func:`check`.
+
+    ``transient=True`` (default) makes it retryable under
+    :func:`~.classify.is_transient`; ``message`` can be shaped to hit
+    other classifiers (e.g. ``RESOURCE_EXHAUSTED`` for the OOM split
+    path — :func:`inject` does this automatically for the ``oom`` site).
+    """
+
+    def __init__(self, site: str, message: Optional[str] = None,
+                 transient: bool = True):
+        self.site = site
+        self.transient = transient
+        super().__init__(
+            message or f"injected transient fault at site {site!r}")
+
+
+class _State:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.budgets: Dict[str, int] = {}
+        self.messages: Dict[str, Optional[str]] = {}
+        self.transient: Dict[str, bool] = {}
+        self._armed_env = False
+
+
+_state = _State()
+
+# the "oom" site must be caught by classify.is_oom, not retried
+_OOM_MESSAGE = ("RESOURCE_EXHAUSTED: injected fault: out of memory "
+                "allocating scratch for block")
+
+
+def _arm_from_env() -> None:
+    """Parse ``TFT_FAULTS="site:count,site:count"`` once per process."""
+    with _state.lock:
+        if _state._armed_env:
+            return
+        _state._armed_env = True
+        raw = os.environ.get("TFT_FAULTS", "")
+    for part in raw.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        site, _, count = part.partition(":")
+        try:
+            arm(site.strip(), int(count) if count else 1)
+        except ValueError:
+            _log.warning("ignoring malformed TFT_FAULTS entry %r", part)
+
+
+def arm(site: str, fail_n: int = 1, message: Optional[str] = None,
+        transient: Optional[bool] = None) -> None:
+    """Arm ``site`` to fail its next ``fail_n`` checks.
+
+    ``transient`` defaults to True except for the ``oom`` site, whose
+    faults must reach the OOM classifier (split-block re-dispatch), not
+    the retry loop.
+    """
+    if fail_n < 0:
+        raise ValueError(f"fail_n must be >= 0, got {fail_n}")
+    if site == "oom":
+        if message is None:
+            message = _OOM_MESSAGE
+        if transient is None:
+            transient = False
+    elif transient is None:
+        transient = True
+    with _state.lock:
+        _state.budgets[site] = fail_n
+        _state.messages[site] = message
+        _state.transient[site] = transient
+    _log.debug("fault site %r armed for %d failure(s)", site, fail_n)
+
+
+def reset(site: Optional[str] = None) -> None:
+    """Disarm one site, or every site when ``site`` is None."""
+    with _state.lock:
+        if site is None:
+            _state.budgets.clear()
+            _state.messages.clear()
+            _state.transient.clear()
+        else:
+            _state.budgets.pop(site, None)
+            _state.messages.pop(site, None)
+            _state.transient.pop(site, None)
+
+
+def active(site: str) -> int:
+    """Remaining scripted failures for ``site`` (0 when disarmed)."""
+    _arm_from_env()
+    with _state.lock:
+        return _state.budgets.get(site, 0)
+
+
+def check(site: str) -> None:
+    """Raise the site's scripted fault while its budget lasts.
+
+    Instrumentation points call this unconditionally: the disarmed path
+    is one env read (memoized) plus a dict lookup under a lock.
+    """
+    _arm_from_env()
+    with _state.lock:
+        left = _state.budgets.get(site, 0)
+        if left <= 0:
+            return
+        _state.budgets[site] = left - 1
+        message = _state.messages.get(site)
+        transient = _state.transient.get(site, True)
+    counters.inc(f"faults.{site}.injected")
+    _log.info("injecting fault at site %r (%d more scripted)",
+              site, left - 1)
+    raise InjectedFault(site, message, transient=transient)
+
+
+@contextlib.contextmanager
+def inject(site: str, fail_n: int = 1, message: Optional[str] = None,
+           transient: Optional[bool] = None) -> Iterator[None]:
+    """Scoped fault injection: the next ``fail_n`` ``check(site)`` calls
+    inside the block raise; the site is disarmed on exit either way."""
+    arm(site, fail_n, message=message, transient=transient)
+    try:
+        yield
+    finally:
+        reset(site)

@@ -17,6 +17,8 @@ from __future__ import annotations
 import os
 from typing import Any, Optional
 
+import numpy as np
+
 from .logging import get_logger
 
 __all__ = ["save", "restore", "latest_step", "save_step", "restore_step"]
@@ -36,7 +38,11 @@ def save(path: str, state: Any) -> None:
 
     path = os.path.abspath(path)
     ckpt = _checkpointer()
-    ckpt.save(path, jax.tree_util.tree_map(lambda x: x, state), force=True)
+    # numpy scalar leaves (np.float32(x)) are not in Orbax's supported
+    # leaf set; store them as 0-d arrays, which round-trip losslessly
+    state = jax.tree_util.tree_map(
+        lambda x: np.asarray(x) if isinstance(x, np.generic) else x, state)
+    ckpt.save(path, state, force=True)
     ckpt.wait_until_finished()
     _log.debug("checkpoint saved to %s", path)
 

@@ -31,8 +31,8 @@ from typing import Dict, Iterator, Optional
 
 from .logging import get_logger
 
-__all__ = ["Timings", "timings", "span", "enable", "disable", "enabled",
-           "profile"]
+__all__ = ["Timings", "timings", "Counters", "counters", "span", "enable",
+           "disable", "enabled", "profile"]
 
 _log = get_logger("utils.tracing")
 
@@ -97,6 +97,39 @@ class Timings:
 
 
 timings = Timings()
+
+
+class Counters:
+    """Thread-safe named event counters (retries, giveups, fallbacks).
+
+    Unlike :class:`Timings` spans these are ALWAYS on: the resilience
+    layer's retry/giveup counts must be observable after the fact even
+    when span timing was disabled during the failure (the moment you most
+    want them). Incrementing an int under a lock is cheap enough.
+    """
+
+    def __init__(self):
+        self._counts: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def inc(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._counts[name] = self._counts.get(name, 0) + n
+
+    def get(self, name: str) -> int:
+        with self._lock:
+            return self._counts.get(name, 0)
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._counts)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counts.clear()
+
+
+counters = Counters()
 
 _enabled = os.environ.get("TFT_TRACE", "") not in ("", "0", "false")
 

@@ -38,3 +38,6 @@ def rng():
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: heavier smoke tests (model-sized benchmarks)")
+    config.addinivalue_line(
+        "markers", "resilience: retry/fallback/fault-injection suite "
+                   "(run-tests.sh runs this lane standalone too)")

@@ -167,7 +167,10 @@ class TestNativeDreduce:
         np.testing.assert_array_equal(out["x"], ref["x"])
 
     def test_matches_jax_path_exactly(self, mesh4, pjrt_routing):
-        # same XLA, same partitioner, same program -> identical floats
+        # same partitioner, same program -> same floats up to reduction
+        # order. The native core may be built against a different XLA
+        # (tensorflow's) than jaxlib's, so bit-exactness across the two
+        # builds is not guaranteed — hold them to ~1 ULP instead.
         import os
 
         rng = np.random.default_rng(11)
@@ -177,7 +180,8 @@ class TestNativeDreduce:
         os.environ.pop("TFT_EXECUTOR", None)
         ref = par.dreduce_blocks({"x": "sum"},
                                  par.distribute(tft.frame({"x": x}), mesh4))
-        np.testing.assert_array_equal(native["x"], ref["x"])
+        np.testing.assert_allclose(native["x"], ref["x"],
+                                   rtol=1e-15, atol=0)
 
 
 class TestNativeDsortDfilter:
@@ -347,7 +351,7 @@ class TestResidentLoop:
 
     def test_loop_matches_per_call_dispatch(self, mesh4, pjrt_routing):
         import jax.numpy as jnp
-        from jax import shard_map
+        from tensorframes_tpu.utils.compat import shard_map
         from jax.sharding import PartitionSpec as P
 
         ex = _executor(mesh4)
@@ -382,7 +386,7 @@ class TestResidentLoop:
 
     def test_loop_multi_arg_mixed_dtypes(self, mesh4, pjrt_routing):
         # two-state loop (f64 vector + i32 counter), both resident
-        from jax import shard_map
+        from tensorframes_tpu.utils.compat import shard_map
         from jax.sharding import PartitionSpec as P
 
         ex = _executor(mesh4)
@@ -405,7 +409,7 @@ class TestResidentLoop:
         np.testing.assert_array_equal(outs[1], np.full(8, 3, np.int32))
 
     def test_loop_rejects_signature_mismatch(self, mesh4, pjrt_routing):
-        from jax import shard_map
+        from tensorframes_tpu.utils.compat import shard_map
         from jax.sharding import PartitionSpec as P
 
         ex = _executor(mesh4)

@@ -1,0 +1,42 @@
+#!/usr/bin/env python
+"""Static check: no bare ``except:`` clauses under tensorframes_tpu/.
+
+A bare except swallows ``BaseException`` — including KeyboardInterrupt,
+DeadlineExceeded, and injected faults — which blinds the resilience
+layer's transient/oom/permanent classifier. ``except Exception`` (or a
+narrower type) is always available instead. AST-based, so strings and
+comments never false-positive.
+"""
+
+import ast
+import pathlib
+import sys
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent / "tensorframes_tpu"
+
+
+def main() -> int:
+    bad = []
+    for path in sorted(ROOT.rglob("*.py")):
+        try:
+            tree = ast.parse(path.read_text(), filename=str(path))
+        except SyntaxError as e:
+            bad.append(f"{path}:{e.lineno}: syntax error: {e.msg}")
+            continue
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ExceptHandler) and node.type is None:
+                bad.append(
+                    f"{path}:{node.lineno}: bare 'except:' — catch "
+                    f"'Exception' (or narrower) so the resilience "
+                    f"classifier can see what failed")
+    for line in bad:
+        print(line, file=sys.stderr)
+    if bad:
+        print(f"check_no_bare_except: {len(bad)} violation(s)",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
