@@ -17,6 +17,14 @@ if [ "${1:-}" = "--resilience" ]; then
   exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m resilience "$@"
 fi
 
+# --pipeline: run only the pipelined block-execution lane
+# (tests/test_pipeline.py) — fast, CPU-only, no native build needed
+if [ "${1:-}" = "--pipeline" ]; then
+  shift
+  echo "== pipeline lane (pytest -m pipeline, CPU) =="
+  exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m pipeline "$@"
+fi
+
 echo "== building native runtime (libtfruntime.so) =="
 make -C native
 

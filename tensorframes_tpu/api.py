@@ -62,20 +62,21 @@ def _unpack(result: Dict[str, np.ndarray], names: Sequence[str]):
     return vals[0] if len(vals) == 1 else vals
 
 
-def reduce_blocks(fetches, dframe: TensorFrame):
+def reduce_blocks(fetches, dframe: TensorFrame, executor=None):
     """Reduces the frame to one row, block-at-a-time then across partials.
 
     Naming contract: each fetch ``z`` requires an input ``z_input`` of one
     rank higher. Eager; combine order unspecified. Returns a numpy value per
     fetch (a list if several). Reference: ``core.py:220-256``.
+    ``executor`` overrides the process-default :class:`BlockExecutor`.
     """
     comp = _ops._reduce_computation(fetches, dframe.schema, ("_input",),
                                     block_level=True)
-    out = _ops.reduce_blocks(comp, dframe)
+    out = _ops.reduce_blocks(comp, dframe, executor=executor)
     return _unpack(out, comp.output_names)
 
 
-def reduce_rows(fetches, dframe: TensorFrame):
+def reduce_rows(fetches, dframe: TensorFrame, executor=None):
     """Reduces the frame to one row, pairwise.
 
     Naming contract: each fetch ``z`` requires inputs ``z_1`` and ``z_2`` of
@@ -84,11 +85,12 @@ def reduce_rows(fetches, dframe: TensorFrame):
     """
     comp = _ops._reduce_computation(fetches, dframe.schema, ("_1", "_2"),
                                     block_level=False)
-    out = _ops.reduce_rows(comp, dframe)
+    out = _ops.reduce_rows(comp, dframe, executor=executor)
     return _unpack(out, comp.output_names)
 
 
-def filter_rows(predicate, dframe: TensorFrame) -> TensorFrame:
+def filter_rows(predicate, dframe: TensorFrame,
+                executor=None) -> TensorFrame:
     """Keeps the rows where ``predicate`` is true (nonzero). Lazy.
 
     ``predicate`` follows the map conventions (named args select columns)
@@ -96,16 +98,18 @@ def filter_rows(predicate, dframe: TensorFrame) -> TensorFrame:
     the reference's own surface — its users filtered through Spark's
     relational API, which a standalone frame library must supply itself.
     """
-    return _ops.filter_rows(predicate, dframe)
+    return _ops.filter_rows(predicate, dframe, executor=executor)
 
 
 def aggregate(fetches, grouped_data: GroupedFrame,
-              buffer_size: int = DEFAULT_BUFFER_SIZE) -> TensorFrame:
+              buffer_size: int = DEFAULT_BUFFER_SIZE,
+              executor=None) -> TensorFrame:
     """Algebraic aggregation of the grouped data: one output row per key,
     fetch columns appended to the key columns.
     Reference: ``core.py:284-300``.
     """
-    return _ops.aggregate(fetches, grouped_data, buffer_size=buffer_size)
+    return _ops.aggregate(fetches, grouped_data, buffer_size=buffer_size,
+                          executor=executor)
 
 
 def block(df: TensorFrame, col_name: str, tf_name: Optional[str] = None):

@@ -8,15 +8,17 @@ once and cached; partitions then execute as data-parallel XLA launches with
 no interpreter in the loop.
 """
 
-from .executor import BlockExecutor, default_executor
+from .executor import BlockExecutor, PendingBlock, default_executor
 from .ops import (
     map_blocks, map_rows, reduce_blocks, reduce_rows, aggregate,
     InputNotFoundError, InvalidTypeError, InvalidShapeError,
 )
 from .compaction import CompactionBuffer
+from .pipeline import PipelinedExecutor, pipeline_depth, run_pipelined
 
 __all__ = [
-    "BlockExecutor", "default_executor",
+    "BlockExecutor", "PendingBlock", "default_executor",
+    "PipelinedExecutor", "pipeline_depth", "run_pipelined",
     "map_blocks", "map_rows", "reduce_blocks", "reduce_rows", "aggregate",
     "CompactionBuffer",
     "InputNotFoundError", "InvalidTypeError", "InvalidShapeError",

@@ -25,18 +25,32 @@ import numpy as np
 from .. import dtypes as _dt
 from .. import native as _native
 from ..computation import Computation
-from ..resilience import default_policy, env_bool, faults, is_oom
+from ..resilience import (default_policy, env_bool, faults, is_oom,
+                          is_permanent)
 from ..utils.logging import get_logger
 from ..utils.tracing import counters, span
 
-__all__ = ["BlockExecutor", "PaddingExecutor", "default_executor",
-           "default_padding_executor"]
+__all__ = ["BlockExecutor", "PaddingExecutor", "PendingBlock",
+           "default_executor", "default_padding_executor"]
 
 _log = get_logger("engine.executor")
 
 
 def _oom_split_enabled() -> bool:
     return env_bool("TFT_OOM_SPLIT", True)
+
+
+_backend_cpu: Optional[bool] = None
+
+
+def _backend_is_cpu() -> bool:
+    global _backend_cpu
+    if _backend_cpu is None:
+        try:
+            _backend_cpu = jax.default_backend() == "cpu"
+        except Exception:  # backend probe failed; assume host-only
+            _backend_cpu = True
+    return _backend_cpu
 
 
 def _split_rows(comp: Computation, arrays: Mapping, n_rows: int):
@@ -152,6 +166,59 @@ def _slice_outputs(comp: Computation, out: Mapping, pad_to: int,
     return result
 
 
+class PendingBlock:
+    """One in-flight block: dispatched asynchronously, barrier deferred.
+
+    The drain half of the :meth:`BlockExecutor.submit` /
+    :meth:`drain` split. ``drain()`` waits for readiness and converts
+    outputs back to host storage dtypes. Resilience composition: the
+    async fast path carries NO retry loop — any failure (recorded at
+    submit, or surfacing here at the output barrier, where JAX's async
+    dispatch materializes execution errors) re-runs the originating
+    block **synchronously** through :meth:`BlockExecutor.run`, i.e.
+    through the existing retry / OOM-split / pad-fallback machinery.
+    Each such recovery increments ``pipeline.sync_fallbacks``.
+    """
+
+    __slots__ = ("_executor", "_comp", "_arrays", "_pad_ok", "_out",
+                 "_pad_to", "_n_rows", "_error")
+
+    def __init__(self, executor, comp, arrays, pad_ok, out=None,
+                 pad_to=None, n_rows=None, error=None):
+        self._executor = executor
+        self._comp = comp
+        self._arrays = arrays
+        self._pad_ok = pad_ok
+        self._out = out
+        self._pad_to = pad_to
+        self._n_rows = n_rows
+        self._error = error
+
+    def drain(self) -> Dict[str, np.ndarray]:
+        if self._error is None:
+            try:
+                faults.check("drain")
+                return self._executor._convert_back(
+                    self._comp, self._out, self._pad_to, self._n_rows)
+            except Exception as e:
+                self._error = e
+        if self._pad_to is None and is_permanent(self._error):
+            # a deterministic failure with no padded attempt to fall back
+            # from re-runs identically: raise it here (serial semantics,
+            # attributed to this block by the FIFO drain) instead of
+            # paying a duplicate execution and a bogus "recovery" count.
+            # Padded-path errors always re-run: the sync path's
+            # exact-shape fallback can still recover them.
+            raise self._error
+        counters.inc("pipeline.sync_fallbacks")
+        _log.warning(
+            "async fast path failed for a block (%s); re-running it "
+            "synchronously through the resilient path", self._error)
+        self._out = None  # drop the failed device outputs before re-running
+        return self._executor.run(self._comp, self._arrays,
+                                  pad_ok=self._pad_ok)
+
+
 class BlockExecutor:
     """Executes :class:`Computation`s on columnar blocks with a compile cache.
 
@@ -159,6 +226,13 @@ class BlockExecutor:
     dimension to power-of-two buckets before execution and outputs sliced
     back — one compile serves many block sizes. Only valid for computations
     whose per-row outputs do not depend on other rows.
+
+    ``donate``: padded dispatches donate their input buffers to XLA
+    (``jax.jit(..., donate_argnums=0)``) so the staging buckets'
+    device allocations are reused for outputs instead of doubling HBM
+    peak. Safe because every row-dimensioned input on that path is a
+    freshly-built staging buffer the engine owns (``_pad_inputs``), never
+    a caller array. ``TFT_DONATE=0`` disables.
     """
 
     def __init__(self, pad_rows: bool = False, donate: bool = True):
@@ -173,7 +247,22 @@ class BlockExecutor:
         self.compile_count = 0  # observability: distinct signatures compiled
 
     # -- compile cache -----------------------------------------------------
-    def _compiled(self, comp: Computation, sig: Tuple):
+    @staticmethod
+    def _sig(comp: Computation, dev_arrays: Mapping) -> Tuple:
+        """Compile-cache signature of one input mapping.
+
+        The sorted input-name order is computed once per Computation and
+        cached on it — the per-block ``sorted()`` over every (name, shape,
+        dtype) tuple was measurable on streams of small blocks."""
+        names = getattr(comp, "_tft_sig_names", None)
+        if names is None:
+            names = comp._tft_sig_names = tuple(
+                sorted(s.name for s in comp.inputs))
+        return tuple((n, dev_arrays[n].shape, str(dev_arrays[n].dtype))
+                     for n in names)
+
+    def _compiled(self, comp: Computation, sig: Tuple,
+                  donate: bool = False):
         # Double-checked locking: the lock-free fast path is safe under
         # the GIL (a dict read racing a dict write sees either the old or
         # the new table, never a torn one); EVERY mutation of the
@@ -181,6 +270,8 @@ class BlockExecutor:
         # happens under self._lock, so two threads racing the same new
         # signature compile once and both get that executable
         # (tests/test_resilience.py::TestConcurrentDispatch).
+        if donate:
+            sig = ("donate",) + sig
         per_comp = self._cache.get(comp)
         fn = None if per_comp is None else per_comp.get(sig)
         if fn is None:
@@ -188,24 +279,37 @@ class BlockExecutor:
                 per_comp = self._cache.setdefault(comp, {})
                 fn = per_comp.get(sig)
                 if fn is None:
-                    fn = jax.jit(comp.fn)
+                    fn = jax.jit(comp.fn, donate_argnums=0) if donate \
+                        else jax.jit(comp.fn)
                     per_comp[sig] = fn
                     self.compile_count += 1
                     _log.debug("compile #%d for signature %s",
                                self.compile_count, sig)
         return fn
 
+    def _donate_padded(self) -> bool:
+        # donation only ever applies to the padded staging path, whose
+        # row-dimensioned inputs the engine freshly allocates per dispatch
+        # (and whose non-row inputs are host numpy, copied at device_put —
+        # a donated copy, never the caller's buffer). Default: on where
+        # device memory is the scarce resource (TPU/GPU), off on CPU —
+        # there it buys nothing and a donating executable is an extra
+        # compile-cache entry next to the plain one. TFT_DONATE overrides
+        # either way.
+        return self._donate and env_bool("TFT_DONATE",
+                                         not _backend_is_cpu())
+
     # -- execution ---------------------------------------------------------
-    def _dispatch(self, comp: Computation, dev_arrays: Mapping):
+    def _dispatch(self, comp: Computation, dev_arrays: Mapping,
+                  donate: bool = False):
         """Compile (cached) + dispatch one signature, with transient
         failures retried under the process policy. Fault sites:
         ``compile``, ``dispatch``, ``oom``."""
-        sig = tuple(sorted(
-            (n, a.shape, str(a.dtype)) for n, a in dev_arrays.items()))
+        sig = self._sig(comp, dev_arrays)
 
         def attempt():
             faults.check("compile")
-            fn = self._compiled(comp, sig)
+            fn = self._compiled(comp, sig, donate=donate)
             faults.check("dispatch")
             faults.check("oom")
             with span("executor.dispatch"):
@@ -218,6 +322,54 @@ class BlockExecutor:
             return out
 
         return default_policy().call(attempt, op="executor.dispatch")
+
+    def _convert_inputs(self, comp: Computation, arrays: Mapping):
+        """Host marshalling half: inputs cast to device dtypes; returns
+        ``(dev_arrays, n_rows)`` with ``n_rows`` the leading row count of
+        the first row-dimensioned input (None when there is none)."""
+        dev_arrays = {}
+        n_rows = None
+        with span("executor.convert"):
+            for spec in comp.inputs:
+                a = np.asarray(arrays[spec.name])
+                dd = _dt.device_dtype(spec.dtype)
+                if a.dtype != dd:
+                    a = _native.convert(a, dd)  # threaded kernel when built
+                dev_arrays[spec.name] = a
+                if spec.shape.ndim > 0 and spec.shape.head == -1:
+                    n_rows = a.shape[0] if n_rows is None else n_rows
+        return dev_arrays, n_rows
+
+    def _plan_pad(self, n_rows, pad_ok: bool):
+        """Bucketed-padding plan: ``(row_local, pad_to)``.
+
+        pad_rows+pad_ok is the executor's row-locality contract — the
+        same property that makes padding safe makes halving safe."""
+        row_local = bool(self.pad_rows and pad_ok and n_rows)
+        pad_to = None
+        if row_local:  # 0-row blocks never pad
+            pad_to = _next_bucket(n_rows)
+            if pad_to == n_rows:
+                pad_to = None
+        return row_local, pad_to
+
+    def _convert_back(self, comp: Computation, out, pad_to,
+                      n_rows) -> Dict[str, np.ndarray]:
+        """D2H half: readiness wait (``np.asarray`` blocks on the async
+        dispatch), pad-row slicing, storage-dtype casts."""
+        result: Dict[str, np.ndarray] = {}
+        with span("executor.convert_back"):
+            host_out = {s.name: np.asarray(out[s.name])
+                        for s in comp.outputs}
+            if pad_to is not None:
+                host_out = _slice_outputs(comp, host_out, pad_to, n_rows)
+            for spec in comp.outputs:
+                a = host_out[spec.name]
+                storage = spec.dtype.np_storage
+                if a.dtype != storage and spec.dtype is not _dt.bfloat16:
+                    a = _native.convert(a, storage)
+                result[spec.name] = a
+        return result
 
     def run(self, comp: Computation,
             arrays: Mapping[str, np.ndarray],
@@ -232,33 +384,16 @@ class BlockExecutor:
         falls back to the exact shape; an OOM-shaped error on a row-local
         dispatch re-runs the block as two halves.
         """
-        dev_arrays = {}
-        n_rows = None
-        with span("executor.convert"):
-            for spec in comp.inputs:
-                a = np.asarray(arrays[spec.name])
-                dd = _dt.device_dtype(spec.dtype)
-                if a.dtype != dd:
-                    a = _native.convert(a, dd)  # threaded kernel when built
-                dev_arrays[spec.name] = a
-                if spec.shape.ndim > 0 and spec.shape.head == -1:
-                    n_rows = a.shape[0] if n_rows is None else n_rows
-
-        # pad_rows+pad_ok is the executor's row-locality contract — the
-        # same property that makes padding safe makes halving safe
-        row_local = bool(self.pad_rows and pad_ok and n_rows)
-        pad_to = None
-        if row_local:  # 0-row blocks never pad
-            pad_to = _next_bucket(n_rows)
-            if pad_to == n_rows:
-                pad_to = None
+        dev_arrays, n_rows = self._convert_inputs(comp, arrays)
+        row_local, pad_to = self._plan_pad(n_rows, pad_ok)
 
         out = None
         if pad_to is not None:
             try:
                 faults.check("pad_compile")
                 padded = _pad_inputs(comp, dev_arrays, pad_to, n_rows)
-                out = self._dispatch(comp, padded)
+                out = self._dispatch(comp, padded,
+                                     donate=self._donate_padded())
             except Exception as e:
                 if is_oom(e):
                     return _oom_split_run(self, comp, arrays, n_rows, e)
@@ -276,19 +411,41 @@ class BlockExecutor:
                     return _oom_split_run(self, comp, arrays, n_rows, e)
                 raise
 
-        result: Dict[str, np.ndarray] = {}
-        with span("executor.convert_back"):
-            host_out = {s.name: np.asarray(out[s.name])
-                        for s in comp.outputs}
+        return self._convert_back(comp, out, pad_to, n_rows)
+
+    def submit(self, comp: Computation,
+               arrays: Mapping[str, np.ndarray],
+               pad_ok: bool = True) -> PendingBlock:
+        """Async fast-path half of :meth:`run`: convert + pad + dispatch
+        with NO readiness barrier and NO retry loop. Never raises — any
+        failure (including injected compile/dispatch/oom/pad_compile
+        faults) is recorded on the returned :class:`PendingBlock`, whose
+        ``drain()`` re-runs the block synchronously through :meth:`run`
+        and therefore through the full resilience machinery.
+        """
+        pad_to = None
+        try:
+            dev_arrays, n_rows = self._convert_inputs(comp, arrays)
+            _, pad_to = self._plan_pad(n_rows, pad_ok)
+            donate = False
             if pad_to is not None:
-                host_out = _slice_outputs(comp, host_out, pad_to, n_rows)
-            for spec in comp.outputs:
-                a = host_out[spec.name]
-                storage = spec.dtype.np_storage
-                if a.dtype != storage and spec.dtype is not _dt.bfloat16:
-                    a = _native.convert(a, storage)
-                result[spec.name] = a
-        return result
+                faults.check("pad_compile")
+                dev_arrays = _pad_inputs(comp, dev_arrays, pad_to, n_rows)
+                donate = self._donate_padded()
+            faults.check("compile")
+            fn = self._compiled(comp, self._sig(comp, dev_arrays),
+                                donate=donate)
+            faults.check("dispatch")
+            faults.check("oom")
+            with span("executor.dispatch_async"):
+                out = fn(dev_arrays)
+            return PendingBlock(self, comp, arrays, pad_ok, out=out,
+                                pad_to=pad_to, n_rows=n_rows)
+        except Exception as e:
+            # pad_to rides along so drain() knows whether the sync
+            # re-run's exact-shape fallback could still recover this
+            return PendingBlock(self, comp, arrays, pad_ok, error=e,
+                                pad_to=pad_to)
 
     def clear(self):
         with self._lock:
@@ -338,7 +495,14 @@ class PaddingExecutor:
             _log.warning(
                 "bucketed %d-row compile failed (%s); falling back to "
                 "the exact %d-row shape", pad_to, e, n_rows)
-            return self.inner.run(comp, arrays, pad_ok=False)
+            try:
+                return self.inner.run(comp, arrays, pad_ok=False)
+            except Exception as e2:
+                # the exact-shape fallback can OOM too; this path is as
+                # row-local as the one above, so the split still applies
+                if is_oom(e2):
+                    return _oom_split_run(self, comp, arrays, n_rows, e2)
+                raise
         return _slice_outputs(comp, out, pad_to, n_rows)
 
     def clear(self):

@@ -44,6 +44,7 @@ from ..utils.tracing import span
 from .compaction import CompactionBuffer, DEFAULT_BUFFER_SIZE
 from .executor import (BlockExecutor, default_executor,
                        default_padding_executor)
+from . import pipeline as _pipeline
 
 _log = get_logger("engine.ops")
 
@@ -275,6 +276,30 @@ def _validate_reduce(comp: Computation, schema: Schema,
 
 
 # ---------------------------------------------------------------------------
+# pipelined streaming shared by the lazy block ops
+# ---------------------------------------------------------------------------
+
+def _stream_thunk(df: TensorFrame, ex, run_block, submit_block,
+                  drain_block):
+    """The lazy forcing every streaming op shares: blocks through the
+    bounded in-flight window, drained FIFO (``docs/pipeline.md``)."""
+    return lambda: _pipeline.run_pipelined(
+        df.blocks(), run_block, submit_block, drain_block,
+        depth=_pipeline.stream_depth(ex))
+
+
+def _drain_with(finish):
+    """A drain half that passes finished Blocks through (empty/ragged
+    blocks complete at submit) and finishes pendings with ``finish(b,
+    host_out)``."""
+    def drain_block(pending, b: Block) -> Block:
+        if isinstance(pending, Block):
+            return pending
+        return finish(b, pending.drain())
+    return drain_block
+
+
+# ---------------------------------------------------------------------------
 # map_blocks
 # ---------------------------------------------------------------------------
 
@@ -290,22 +315,18 @@ def map_blocks(fetches: Fetches, df: TensorFrame, trim: bool = False,
     _log.debug("map_blocks: inputs=%s fetches=%s trim=%s",
                in_names, fetch_names, trim)
 
-    def run_block(b: Block) -> Block:
-        if b.num_rows == 0:
-            # Empty-partition guard (reference DebugRowOps.scala:374-385):
-            # emit an empty block of the right schema without executing.
-            cols: Dict[str, Column] = {}
-            for f in out_schema:
-                cell = f.cell_shape
-                dims = tuple(0 if d == Unknown else d
-                             for d in (cell.dims if cell else ()))
-                cols[f.name] = np.empty((0,) + dims, f.dtype.np_storage)
-            return Block(cols, 0)
-        with span("map_blocks.block"):
-            arrays = {n: b.dense(n) for n in in_names}
-            # trim may legally change the row count; padding would corrupt
-            # it, and non-row-local computations must see the true block.
-            out = ex.run(comp, arrays, pad_ok=not trim)
+    def empty_block() -> Block:
+        # Empty-partition guard (reference DebugRowOps.scala:374-385):
+        # emit an empty block of the right schema without executing.
+        cols: Dict[str, Column] = {}
+        for f in out_schema:
+            cell = f.cell_shape
+            dims = tuple(0 if d == Unknown else d
+                         for d in (cell.dims if cell else ()))
+            cols[f.name] = np.empty((0,) + dims, f.dtype.np_storage)
+        return Block(cols, 0)
+
+    def finish_block(b: Block, out: Dict[str, np.ndarray]) -> Block:
         lead = {out[f].shape[0] for f in fetch_names}
         if len(lead) > 1:
             raise InvalidShapeError(
@@ -322,8 +343,25 @@ def map_blocks(fetches: Fetches, df: TensorFrame, trim: bool = False,
         cols.update({f: out[f] for f in fetch_names})
         return Block(cols, b.num_rows)
 
+    def run_block(b: Block) -> Block:
+        if b.num_rows == 0:
+            return empty_block()
+        with span("map_blocks.block"):
+            arrays = {n: b.dense(n) for n in in_names}
+            # trim may legally change the row count; padding would corrupt
+            # it, and non-row-local computations must see the true block.
+            out = ex.run(comp, arrays, pad_ok=not trim)
+        return finish_block(b, out)
+
+    def submit_block(b: Block):
+        if b.num_rows == 0:
+            return empty_block()  # finished: flows through the window
+        arrays = {n: b.dense(n) for n in in_names}
+        return _pipeline.submit(ex, comp, arrays, pad_ok=not trim)
+
     return TensorFrame(out_schema,
-                       lambda: [run_block(b) for b in df.blocks()],
+                       _stream_thunk(df, ex, run_block, submit_block,
+                                     _drain_with(finish_block)),
                        df.num_partitions,
                        plan=f"map_blocks({df._plan})")
 
@@ -358,6 +396,11 @@ def map_rows(fetches: Fetches, df: TensorFrame,
         [TensorSpec(s.name, s.dtype, s.shape.prepend(Unknown))
          for s in comp.outputs])
 
+    def attach_outputs(b: Block, out: Dict[str, np.ndarray]) -> Block:
+        cols = dict(b.columns)
+        cols.update({f: out[f] for f in fetch_names})
+        return Block(cols, b.num_rows)
+
     def run_block(b: Block) -> Block:
         if b.num_rows == 0:
             cols = dict(b.columns)
@@ -370,9 +413,7 @@ def map_rows(fetches: Fetches, df: TensorFrame,
             with span("map_rows.block_dense"):
                 arrays = {n: b.dense(n) for n in in_names}
                 out = ex.run(vcomp, arrays)
-            cols = dict(b.columns)
-            cols.update({f: out[f] for f in fetch_names})
-            return Block(cols, b.num_rows)
+            return attach_outputs(b, out)
         # ragged: group rows by cell-shape signature and run ONE vmapped
         # dispatch per distinct signature (instead of the reference's one
         # Session.Run per row, DebugRowOps.scala:810-841). Each group's
@@ -407,8 +448,18 @@ def map_rows(fetches: Fetches, df: TensorFrame,
                        else arrays)
         return Block(cols, b.num_rows)
 
+    def submit_block(b: Block):
+        # empty and ragged blocks run serially at submit (ragged blocks
+        # are many grouped dispatches, not one async unit) and flow
+        # through the window as finished Blocks; dense blocks pipeline.
+        if b.num_rows == 0 or any(b.is_ragged(n) for n in in_names):
+            return run_block(b)
+        arrays = {n: b.dense(n) for n in in_names}
+        return _pipeline.submit(ex, vcomp, arrays)
+
     return TensorFrame(out_schema,
-                       lambda: [run_block(b) for b in df.blocks()],
+                       _stream_thunk(df, ex, run_block, submit_block,
+                                     _drain_with(attach_outputs)),
                        df.num_partitions,
                        plan=f"map_rows({df._plan})")
 
@@ -480,13 +531,7 @@ def filter_rows(predicate: Fetches, df: TensorFrame,
     in_names = comp.input_names
     pname = comp.output_names[0]
 
-    def run_block(b: Block) -> Block:
-        if b.num_rows == 0:
-            return b
-        with span("filter_rows.block"):
-            arrays = {n: b.dense(n) for n in in_names}
-            # masks are row-aligned, so bucketed padding stays legal
-            out = ex.run(comp, arrays, pad_ok=True)
+    def apply_mask(b: Block, out: Dict[str, np.ndarray]) -> Block:
         mask = np.asarray(out[pname]).astype(bool)
         if mask.shape != (b.num_rows,):
             raise InvalidShapeError(
@@ -503,8 +548,24 @@ def filter_rows(predicate: Fetches, df: TensorFrame,
                 cols[n] = [c[i] for i in np.flatnonzero(mask)]
         return Block(cols, keep)
 
+    def run_block(b: Block) -> Block:
+        if b.num_rows == 0:
+            return b
+        with span("filter_rows.block"):
+            arrays = {n: b.dense(n) for n in in_names}
+            # masks are row-aligned, so bucketed padding stays legal
+            out = ex.run(comp, arrays, pad_ok=True)
+        return apply_mask(b, out)
+
+    def submit_block(b: Block):
+        if b.num_rows == 0:
+            return b
+        arrays = {n: b.dense(n) for n in in_names}
+        return _pipeline.submit(ex, comp, arrays, pad_ok=True)
+
     return TensorFrame(df.schema,
-                       lambda: [run_block(b) for b in df.blocks()],
+                       _stream_thunk(df, ex, run_block, submit_block,
+                                     _drain_with(apply_mask)),
                        df.num_partitions,
                        plan=f"filter_rows({df._plan})")
 
@@ -528,13 +589,20 @@ def reduce_blocks(fetches: Fetches, df: TensorFrame,
     _validate_reduce(comp, df.schema, ("_input",), rank_delta=1)
     fetch_names = comp.output_names
 
-    partials: List[Dict[str, np.ndarray]] = []
+    def block_arrays(b: Block) -> Dict[str, np.ndarray]:
+        return {f + "_input": b.dense(f) for f in fetch_names}
+
+    # empty-partition guard (reference :477-479); per-partition partials
+    # stream through the pipelined window like the map ops
+    nonempty = [b for b in df.blocks() if b.num_rows > 0]
     with span("reduce_blocks.partials"):
-        for b in df.blocks():
-            if b.num_rows == 0:
-                continue  # empty-partition guard (reference :477-479)
-            arrays = {f + "_input": b.dense(f) for f in fetch_names}
-            partials.append(ex.run(comp, arrays, pad_ok=False))
+        partials: List[Dict[str, np.ndarray]] = _pipeline.run_pipelined(
+            nonempty,
+            lambda b: ex.run(comp, block_arrays(b), pad_ok=False),
+            lambda b: _pipeline.submit(ex, comp, block_arrays(b),
+                                       pad_ok=False),
+            lambda p, b: p.drain(),
+            depth=_pipeline.stream_depth(ex))
     if not partials:
         raise ValueError("reduce_blocks on an empty frame")
     if len(partials) == 1:

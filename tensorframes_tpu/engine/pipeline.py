@@ -1,0 +1,202 @@
+"""Pipelined block execution: overlap marshalling, H2D, compute, and D2H.
+
+The engine's hot path used to be fully serial: every map/filter/reduce
+materialized its result with ``[run_block(b) for b in df.blocks()]``, and
+each dispatch hard-barriered (``jax.block_until_ready``) before converting
+outputs back to host — so host marshalling, H2D transfer, device compute,
+and D2H readback never overlapped across blocks. This module is the
+streaming replacement: a bounded window of **in-flight blocks** where block
+*k+1*'s convert/pad/device_put runs while block *k* computes on device and
+block *k−1* drains back to host (the inter-step overlap of "Extending
+TensorFlow's Semantics with Pipelined Execution", PAPERS.md).
+
+The executor side is split in two halves (``BlockExecutor.submit`` /
+``PendingBlock.drain``): *submit* converts inputs, plans padding, and
+dispatches asynchronously — no barrier; *drain* waits for readiness and
+converts outputs back. :func:`run_pipelined` keeps at most
+``TFT_PIPELINE_DEPTH`` (default 3) blocks in flight and drains strictly
+FIFO, so **output ordering is preserved** and the lazy-thunk contract of
+the ops is unchanged.
+
+Resilience composition (the load-bearing part): the async fast path has no
+retry loop of its own. Any error — at submit (compile/dispatch) or
+surfacing at drain (async execution failures materialize at the output
+barrier) — is attributed to its originating block, and that block is
+re-run **synchronously** through ``executor.run``, i.e. through the
+existing retry / OOM-split / pad-fallback machinery
+(``docs/resilience.md``). Counted in ``pipeline.sync_fallbacks``.
+
+``TFT_PIPELINE_DEPTH=1`` (or a single-block frame) restores the serial
+path exactly: the ops' unchanged per-block function runs in a plain loop,
+bit-identical to the pre-pipeline engine.
+
+Observability: ``pipeline.submitted`` / ``pipeline.drained`` /
+``pipeline.sync_fallbacks`` are always-on counters
+(``utils.tracing.counters``); window occupancy is sampled into the
+``pipeline.occupancy`` gauge and submit/drain run inside
+``pipeline.submit`` / ``pipeline.drain`` spans when tracing is enabled.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Callable, List, Optional, Sequence, TypeVar
+
+from ..resilience import env_int
+from ..utils.logging import get_logger
+from ..utils.tracing import counters, gauge, span
+
+__all__ = ["DEFAULT_DEPTH", "pipeline_depth", "stream_depth", "submit",
+           "run_pipelined", "ReadyResult", "PipelinedExecutor"]
+
+_log = get_logger("engine.pipeline")
+
+DEFAULT_DEPTH = 3
+
+B = TypeVar("B")
+R = TypeVar("R")
+
+
+def pipeline_depth(explicit: Optional[int] = None) -> int:
+    """The in-flight block window: ``explicit`` if given, else
+    ``TFT_PIPELINE_DEPTH`` (default 3), floored at 1 (depth 1 = serial).
+
+    Re-read per stream forcing — the knob is cheap and tests/benchmarks
+    flip it between runs.
+    """
+    d = explicit if explicit is not None \
+        else env_int("TFT_PIPELINE_DEPTH", DEFAULT_DEPTH)
+    return max(1, d)
+
+
+class ReadyResult:
+    """A pre-computed pending: drains to a value already in hand.
+
+    The generic fallback for executors without a ``submit`` half (e.g.
+    :class:`~..engine.executor.PaddingExecutor` wrapping a native core):
+    the block runs eagerly — through the executor's full resilient path —
+    at submit time, so the stream stays correct (no overlap, same
+    semantics).
+    """
+
+    __slots__ = ("_value",)
+
+    def __init__(self, value):
+        self._value = value
+
+    def drain(self):
+        return self._value
+
+
+def stream_depth(executor) -> Optional[int]:
+    """The depth an executor pins for op-internal streams: a
+    :class:`PipelinedExecutor` carries its own, anything else defers to
+    ``TFT_PIPELINE_DEPTH`` (None)."""
+    if isinstance(executor, PipelinedExecutor):
+        return executor.depth
+    return None
+
+
+def submit(executor, comp, arrays, pad_ok: bool = True):
+    """Submit one block on ``executor``: its async ``submit`` half when it
+    has one, else the eager :class:`ReadyResult` fallback. Returns an
+    object with a ``drain()`` method."""
+    sub = getattr(executor, "submit", None)
+    if sub is not None:
+        return sub(comp, arrays, pad_ok=pad_ok)
+    return ReadyResult(executor.run(comp, arrays, pad_ok=pad_ok))
+
+
+def run_pipelined(blocks: Sequence[B],
+                  serial_fn: Callable[[B], R],
+                  submit_fn: Callable[[B], object],
+                  drain_fn: Callable[[object, B], R],
+                  depth: Optional[int] = None) -> List[R]:
+    """Run a block stream through a bounded in-flight window, in order.
+
+    ``serial_fn(b)`` is the unchanged serial per-block function — used
+    verbatim when the effective depth is 1 or the stream has at most one
+    block, so ``TFT_PIPELINE_DEPTH=1`` IS the pre-pipeline engine.
+    ``submit_fn(b)`` starts a block (returns a pending with ``drain()``,
+    or any finished value the paired ``drain_fn`` recognizes);
+    ``drain_fn(pending, b)`` completes it. Drains are strictly FIFO:
+    results come back in block order.
+    """
+    blocks = list(blocks)
+    d = pipeline_depth(depth)
+    if d <= 1 or len(blocks) <= 1:
+        return [serial_fn(b) for b in blocks]
+
+    out: List[R] = []
+    window: "deque" = deque()
+
+    def drain_one() -> None:
+        pending, b = window.popleft()
+        with span("pipeline.drain"):
+            out.append(drain_fn(pending, b))
+        counters.inc("pipeline.drained")
+
+    for b in blocks:
+        with span("pipeline.submit"):
+            window.append((submit_fn(b), b))
+        counters.inc("pipeline.submitted")
+        gauge("pipeline.occupancy", len(window))
+        if len(window) >= d:
+            drain_one()
+    while window:
+        drain_one()
+    return out
+
+
+class PipelinedExecutor:
+    """A block-stream runner bound to an inner executor and a depth.
+
+    Thin orchestration handle over :func:`run_pipelined` /
+    :func:`submit` for callers outside ``engine.ops`` that want the same
+    windowed execution over their own block streams::
+
+        pex = PipelinedExecutor(default_executor(), depth=4)
+        results = pex.map(block_arrays, comp)          # ordered host dicts
+
+    ``run`` delegates to the inner executor unchanged, so a
+    ``PipelinedExecutor`` is accepted anywhere an ``executor=`` argument
+    is (the six ops pipeline their own streams internally; handing them a
+    ``PipelinedExecutor`` additionally pins the depth without consulting
+    ``TFT_PIPELINE_DEPTH``).
+    """
+
+    def __init__(self, inner, depth: Optional[int] = None):
+        self.inner = inner
+        self._depth = depth
+
+    @property
+    def depth(self) -> int:
+        return pipeline_depth(self._depth)
+
+    @property
+    def pad_rows(self) -> bool:
+        return getattr(self.inner, "pad_rows", False)
+
+    @property
+    def compile_count(self) -> int:
+        return self.inner.compile_count
+
+    def run(self, comp, arrays, pad_ok: bool = True):
+        return self.inner.run(comp, arrays, pad_ok=pad_ok)
+
+    def submit(self, comp, arrays, pad_ok: bool = True):
+        return submit(self.inner, comp, arrays, pad_ok=pad_ok)
+
+    def map(self, block_arrays: Sequence, comp,
+            pad_ok: bool = True) -> List:
+        """Run ``comp`` over a sequence of input mappings, pipelined,
+        results in input order."""
+        return run_pipelined(
+            block_arrays,
+            lambda a: self.inner.run(comp, a, pad_ok=pad_ok),
+            lambda a: self.submit(comp, a, pad_ok=pad_ok),
+            lambda p, a: p.drain(),
+            depth=self.depth)
+
+    def clear(self):
+        self.inner.clear()

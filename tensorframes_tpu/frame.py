@@ -400,17 +400,17 @@ class TensorFrame:
         from . import api
         return api.map_rows(fetches, self, executor=executor)
 
-    def reduce_blocks(self, fetches):
+    def reduce_blocks(self, fetches, executor=None):
         from . import api
-        return api.reduce_blocks(fetches, self)
+        return api.reduce_blocks(fetches, self, executor=executor)
 
-    def reduce_rows(self, fetches):
+    def reduce_rows(self, fetches, executor=None):
         from . import api
-        return api.reduce_rows(fetches, self)
+        return api.reduce_rows(fetches, self, executor=executor)
 
-    def filter(self, predicate) -> "TensorFrame":
+    def filter(self, predicate, executor=None) -> "TensorFrame":
         from . import api
-        return api.filter_rows(predicate, self)
+        return api.filter_rows(predicate, self, executor=executor)
 
     def limit(self, n: int) -> "TensorFrame":
         """The first ``n`` rows (in block order). Lazy."""

@@ -79,9 +79,13 @@ def rows_to_columns(rows: Sequence[Sequence], schema: Schema,
     """
     ncols = len(schema)
     out: Dict[str, Column] = {}
+    # one C-level transpose instead of re-indexing [r[j] for r in rows]
+    # per column (O(rows*cols) Python indexing on the ingest path);
+    # len(), not truthiness: rows may be a 2-D ndarray
+    transposed = tuple(zip(*rows)) if len(rows) else ((),) * ncols
     for j, field in enumerate(schema):
         np_dt = field.dtype.np_storage
-        cells = [r[j] for r in rows]
+        cells = transposed[j]
         if fast:
             try:
                 arr = np.asarray(cells, dtype=np_dt)

@@ -588,6 +588,23 @@ class PjrtReplicatedExecutable:
 # Engine integration
 # ---------------------------------------------------------------------------
 
+class _PjrtPending:
+    """In-flight native dispatch: ``drain()`` joins the worker future.
+
+    The worker already executed through the executor's full resilient
+    path, so a failure here re-raises (attributed to this block by the
+    pipeline's FIFO drain) rather than re-running.
+    """
+
+    __slots__ = ("_future",)
+
+    def __init__(self, future):
+        self._future = future
+
+    def drain(self) -> Dict[str, np.ndarray]:
+        return self._future.result()
+
+
 def _lower_stablehlo(comp: Computation, arrays: Mapping[str, np.ndarray],
                      in_names, out_names) -> bytes:
     """Lower a LIVE computation at these concrete shapes to StableHLO text.
@@ -650,6 +667,7 @@ class PjrtBlockExecutor:
         self._cache: "weakref.WeakKeyDictionary[Computation, Dict[Tuple, PjrtExecutable]]" = \
             weakref.WeakKeyDictionary()
         self._lock = threading.Lock()
+        self._pool = None  # lazily-built single worker for submit()
         self.compile_count = 0
 
     def _compiled(self, comp: Computation, dev_arrays: Dict,
@@ -707,6 +725,27 @@ class PjrtBlockExecutor:
         # ABORTED / ...) in its message, which is exactly what the
         # transient classifier keys on
         return default_policy().call(attempt, op="pjrt.execute")
+
+    def submit(self, comp: Computation, arrays: Mapping[str, np.ndarray],
+               pad_ok: bool = True) -> "_PjrtPending":
+        """Submit half for the pipelined engine (``engine/pipeline.py``):
+        the native dispatch runs on a dedicated worker thread — the
+        ctypes execute call releases the GIL, so the main thread marshals
+        the next blocks while C++ computes this one. The worker runs the
+        FULL resilient :meth:`run` (retry policy included), so ``drain``
+        re-raises a failure instead of re-running it; one worker keeps
+        device dispatches serialized like the serial path.
+        """
+        pool = self._pool
+        if pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+            with self._lock:
+                if self._pool is None:
+                    self._pool = ThreadPoolExecutor(
+                        max_workers=1,
+                        thread_name_prefix="tfr-pjrt-submit")
+                pool = self._pool
+        return _PjrtPending(pool.submit(self.run, comp, arrays, pad_ok))
 
     def run_blocks_parallel(self, comp: Computation, blocks,
                             ) -> "list[Dict[str, np.ndarray]]":

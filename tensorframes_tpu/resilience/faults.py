@@ -26,6 +26,7 @@ compile      ``engine.executor.BlockExecutor`` signature compile
 dispatch     ``engine.executor.BlockExecutor`` block dispatch
 pad_compile  ``engine.executor.PaddingExecutor`` bucketed-compile path
 oom          ``engine.executor.BlockExecutor`` dispatch, OOM-shaped
+drain        ``engine.executor.PendingBlock.drain`` pipelined readback
 pjrt_execute ``native_pjrt.PjrtBlockExecutor`` native-core dispatch
 dmap         ``parallel.distributed.dmap_blocks`` mesh dispatch
 ========== ===========================================================

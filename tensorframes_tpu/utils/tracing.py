@@ -31,8 +31,8 @@ from typing import Dict, Iterator, Optional
 
 from .logging import get_logger
 
-__all__ = ["Timings", "timings", "Counters", "counters", "span", "enable",
-           "disable", "enabled", "profile"]
+__all__ = ["Timings", "timings", "Counters", "counters", "span", "gauge",
+           "enable", "disable", "enabled", "profile"]
 
 _log = get_logger("utils.tracing")
 
@@ -170,6 +170,20 @@ def span(name: str) -> Iterator[None]:
             dt = time.perf_counter() - t0
             timings.add(name, dt)
             _log.trace("span %s: %.6fs", name, dt)
+
+
+def gauge(name: str, value: float) -> None:
+    """Sample a dimensionless value into the :data:`timings` registry.
+
+    Same zero-cost-when-off contract as :func:`span`, but for quantities
+    that are levels rather than durations — e.g. the pipelined engine
+    samples its in-flight window size into ``pipeline.occupancy`` at every
+    submit, so ``timings.snapshot()['pipeline.occupancy']['mean_s']`` reads
+    as the mean window occupancy (the ``_s`` suffix is vestigial for
+    gauges). No-op unless tracing is enabled.
+    """
+    if _enabled:
+        timings.add(name, float(value))
 
 
 @contextlib.contextmanager

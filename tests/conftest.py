@@ -41,3 +41,6 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "resilience: retry/fallback/fault-injection suite "
                    "(run-tests.sh runs this lane standalone too)")
+    config.addinivalue_line(
+        "markers", "pipeline: pipelined block-execution suite "
+                   "(run-tests.sh --pipeline runs this lane standalone)")

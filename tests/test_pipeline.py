@@ -1,0 +1,356 @@
+"""Pipelined block execution: ordering, resilience composition, counters.
+
+The streaming engine (``engine/pipeline.py``) keeps a bounded window of
+in-flight blocks; these tests prove the contracts the serial engine
+promised are preserved under overlap — output ordering at every depth,
+drain-time errors re-run synchronously through the retry/OOM-split/
+pad-fallback machinery and attributed to the right block, empty blocks
+flow through the window, and ``TFT_PIPELINE_DEPTH=1`` is bit-identical
+to the serial path. Runs standalone via ``run-tests.sh --pipeline``.
+"""
+
+import numpy as np
+import pytest
+
+import tensorframes_tpu as tft
+from tensorframes_tpu import resilience as rz
+from tensorframes_tpu.engine.executor import BlockExecutor
+from tensorframes_tpu.engine.pipeline import (PipelinedExecutor,
+                                              pipeline_depth, run_pipelined)
+from tensorframes_tpu.resilience import faults
+from tensorframes_tpu.utils import tracing
+from tensorframes_tpu.utils.tracing import counters
+
+pytestmark = pytest.mark.pipeline
+
+
+@pytest.fixture(autouse=True)
+def _fast_and_clean(monkeypatch):
+    """Millisecond backoffs + clean counters/faults for every test."""
+    monkeypatch.setenv("TFT_RETRY_BASE_DELAY", "0.001")
+    monkeypatch.setenv("TFT_RETRY_MAX_DELAY", "0.01")
+    monkeypatch.delenv("TFT_PIPELINE_DEPTH", raising=False)
+    counters.reset()
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _depth(monkeypatch, d):
+    monkeypatch.setenv("TFT_PIPELINE_DEPTH", str(d))
+
+
+def _counters_consistent():
+    sub = counters.get("pipeline.submitted")
+    drn = counters.get("pipeline.drained")
+    fb = counters.get("pipeline.sync_fallbacks")
+    assert sub == drn, (sub, drn)
+    assert fb <= drn
+    return sub
+
+
+# ---------------------------------------------------------------------------
+# depth knob + runner primitives
+# ---------------------------------------------------------------------------
+
+class TestDepthKnob:
+    def test_default_and_env(self, monkeypatch):
+        assert pipeline_depth() == 3
+        _depth(monkeypatch, 8)
+        assert pipeline_depth() == 8
+        assert pipeline_depth(2) == 2  # explicit wins over env
+
+    def test_floor_at_one(self, monkeypatch):
+        _depth(monkeypatch, 0)
+        assert pipeline_depth() == 1
+        assert pipeline_depth(-3) == 1
+
+    def test_malformed_env_falls_back(self, monkeypatch):
+        monkeypatch.setenv("TFT_PIPELINE_DEPTH", "many")
+        assert pipeline_depth() == 3
+
+
+class TestRunner:
+    def test_window_is_bounded_and_fifo(self):
+        """At depth 3, never more than 3 undrained submissions exist and
+        results come back in submission order."""
+        events = []
+
+        def submit(b):
+            events.append(("s", b))
+            return b
+
+        def drain(p, b):
+            events.append(("d", b))
+            return p * 10
+
+        out = run_pipelined(list(range(7)), lambda b: b * 10, submit,
+                            drain, depth=3)
+        assert out == [b * 10 for b in range(7)]
+        in_flight = 0
+        peak = 0
+        drained = []
+        for kind, b in events:
+            if kind == "s":
+                in_flight += 1
+                peak = max(peak, in_flight)
+            else:
+                in_flight -= 1
+                drained.append(b)
+        assert peak == 3
+        assert drained == sorted(drained)
+
+    def test_depth_one_uses_serial_fn_only(self):
+        calls = []
+        out = run_pipelined(
+            [1, 2, 3],
+            lambda b: calls.append(b) or b,
+            lambda b: pytest.fail("submit must not run at depth 1"),
+            lambda p, b: pytest.fail("drain must not run at depth 1"),
+            depth=1)
+        assert calls == [1, 2, 3] and out == [1, 2, 3]
+
+    def test_single_block_stream_stays_serial(self):
+        out = run_pipelined(
+            ["only"],
+            lambda b: b.upper(),
+            lambda b: pytest.fail("no pipeline for one block"),
+            lambda p, b: None,
+            depth=4)
+        assert out == ["ONLY"]
+        assert counters.get("pipeline.submitted") == 0
+
+
+# ---------------------------------------------------------------------------
+# ordering through the ops
+# ---------------------------------------------------------------------------
+
+class TestOrdering:
+    @pytest.mark.parametrize("depth", [1, 3, 8])
+    def test_map_blocks_order_preserved(self, monkeypatch, depth):
+        _depth(monkeypatch, depth)
+        df = tft.frame({"x": np.arange(40.0)}, num_partitions=6)
+        out = df.map_blocks(lambda x: {"y": x * 2.0}).collect()
+        got = np.asarray([r["y"] for r in out], float).ravel()
+        np.testing.assert_array_equal(got, np.arange(40.0) * 2.0)
+        if depth > 1:
+            assert _counters_consistent() == 6
+
+    @pytest.mark.parametrize("depth", [1, 3, 8])
+    def test_map_rows_and_filter_order_preserved(self, monkeypatch, depth):
+        _depth(monkeypatch, depth)
+        df = tft.frame({"x": np.arange(30.0)}, num_partitions=5)
+        out = df.map_rows(lambda x: {"z": x + 0.5}).collect()
+        got = np.asarray([r["z"] for r in out], float).ravel()
+        np.testing.assert_array_equal(got, np.arange(30.0) + 0.5)
+        kept = df.filter(lambda x: x % 2.0 == 0.0).collect()
+        got = np.asarray([r["x"] for r in kept], float).ravel()
+        np.testing.assert_array_equal(got, np.arange(0.0, 30.0, 2.0))
+
+    @pytest.mark.parametrize("depth", [1, 3, 8])
+    def test_reduce_blocks_partials_pipelined(self, monkeypatch, depth):
+        _depth(monkeypatch, depth)
+        df = tft.frame({"x": np.arange(24.0)}, num_partitions=4)
+        total = df.reduce_blocks(lambda x_input: {"x": x_input.sum()})
+        assert float(total) == float(np.arange(24.0).sum())
+
+    def test_depth1_bit_identical_to_depth3(self, monkeypatch):
+        rng = np.random.default_rng(7)
+        data = rng.standard_normal(101)
+        df = tft.frame({"x": data}, num_partitions=7)
+        fetch = lambda x: {"y": np.float64(1.0) / (x * x + 0.125)}  # noqa: E731
+        _depth(monkeypatch, 3)
+        piped = df.map_blocks(fetch).collect()
+        _depth(monkeypatch, 1)
+        serial = df.map_blocks(fetch).collect()
+        a = np.asarray([r["y"] for r in piped])
+        b = np.asarray([r["y"] for r in serial])
+        assert a.tobytes() == b.tobytes()  # bit-identical, not just close
+
+
+# ---------------------------------------------------------------------------
+# resilience composition under pipelining
+# ---------------------------------------------------------------------------
+
+class TestPipelineResilience:
+    def test_drain_error_attributed_to_right_block(self, monkeypatch):
+        """One injected drain fault: every block's values still come back
+        right (a wrong-block re-run would duplicate or drop a partition)
+        and exactly one sync fallback is recorded."""
+        _depth(monkeypatch, 3)
+        df = tft.frame({"x": np.arange(24.0)}, num_partitions=4)
+        with faults.inject("drain", fail_n=1):
+            out = df.map_blocks(lambda x: {"y": x * 5.0}).collect()
+        got = np.asarray([r["y"] for r in out], float).ravel()
+        np.testing.assert_array_equal(got, np.arange(24.0) * 5.0)
+        assert counters.get("pipeline.sync_fallbacks") == 1
+        assert _counters_consistent() == 4
+
+    def test_submit_error_defers_to_sync_recovery(self, monkeypatch):
+        """A transient fault at the async submit (compile site) re-runs
+        that block synchronously; the sync path absorbs further injected
+        faults through its retry loop."""
+        _depth(monkeypatch, 2)
+        df = tft.frame({"x": np.arange(12.0)}, num_partitions=3)
+        with faults.inject("compile", fail_n=4):
+            out = df.map_blocks(lambda x: {"y": x + 2.0}).collect()
+        got = np.asarray([r["y"] for r in out], float).ravel()
+        np.testing.assert_array_equal(got, np.arange(12.0) + 2.0)
+        assert counters.get("pipeline.sync_fallbacks") >= 1
+        _counters_consistent()
+
+    def test_oom_split_recovers_under_pipelining(self, monkeypatch):
+        """OOM faults outlasting the async submits reach the sync
+        recovery's dispatch, which splits the block and re-runs the
+        halves (map_rows = row-local contract)."""
+        _depth(monkeypatch, 2)
+        df = tft.frame({"x": np.arange(16.0)}, num_partitions=2)
+        with faults.inject("oom", fail_n=3):
+            out = df.map_rows(lambda x: {"y": x * 3.0}).collect()
+        got = np.asarray([r["y"] for r in out], float).ravel()
+        np.testing.assert_array_equal(got, np.arange(16.0) * 3.0)
+        assert counters.get("oom_split.dispatches") >= 1
+        assert counters.get("pipeline.sync_fallbacks") >= 1
+        _counters_consistent()
+
+    def test_pad_fallback_recovers_under_pipelining(self, monkeypatch):
+        """pad_compile faults outlasting the async submits hit the sync
+        recovery's padded path, which falls back to the exact shape."""
+        _depth(monkeypatch, 2)
+        # 7 and 6-row partitions pad to the 8-bucket
+        df = tft.frame({"x": np.arange(13.0)}, num_partitions=2)
+        with faults.inject("pad_compile", fail_n=3):
+            out = df.map_rows(lambda x: {"y": x + 10.0}).collect()
+        got = np.asarray([r["y"] for r in out], float).ravel()
+        np.testing.assert_array_equal(got, np.arange(13.0) + 10.0)
+        assert counters.get("pad_fallback.compiles") >= 1
+        assert counters.get("pipeline.sync_fallbacks") >= 1
+        _counters_consistent()
+
+    def test_permanent_unpadded_error_reraises_without_rerun(
+            self, monkeypatch):
+        """A deterministic (non-transient, non-OOM) failure on the
+        exact-shape async path re-raises at drain — no duplicate
+        execution, no bogus 'recovery' in the fallback counter."""
+        _depth(monkeypatch, 2)
+        df = tft.frame({"x": np.arange(8.0)}, num_partitions=2)
+        with faults.inject("dispatch", fail_n=1, transient=False):
+            with pytest.raises(rz.InjectedFault):
+                df.map_blocks(lambda x: {"y": x + 1.0}).collect()
+        assert counters.get("pipeline.sync_fallbacks") == 0
+
+    def test_permanent_padded_error_still_tries_sync_fallback(
+            self, monkeypatch):
+        """A permanent failure on the PADDED async path must keep the
+        sync re-run: its exact-shape fallback can still recover."""
+        _depth(monkeypatch, 2)
+        # 7/6-row partitions pad to the 8-bucket on the map_rows path
+        df = tft.frame({"x": np.arange(13.0)}, num_partitions=2)
+        with faults.inject("pad_compile", fail_n=2, transient=False):
+            out = df.map_rows(lambda x: {"y": x - 1.0}).collect()
+        got = np.asarray([r["y"] for r in out], float).ravel()
+        np.testing.assert_array_equal(got, np.arange(13.0) - 1.0)
+        assert counters.get("pipeline.sync_fallbacks") == 2
+
+    def test_permanent_error_still_raises_at_drain(self, monkeypatch):
+        """The sync recovery re-raises genuine failures: a fault armed
+        past every recovery attempt propagates out of collect()."""
+        monkeypatch.setenv("TFT_RETRY_MAX_ATTEMPTS", "1")
+        _depth(monkeypatch, 2)
+        df = tft.frame({"x": np.arange(8.0)}, num_partitions=2)
+        with faults.inject("dispatch", fail_n=100):
+            with pytest.raises(rz.InjectedFault):
+                df.map_blocks(lambda x: {"y": x + 1.0}).collect()
+
+
+# ---------------------------------------------------------------------------
+# window edge cases
+# ---------------------------------------------------------------------------
+
+class TestWindowEdges:
+    def test_empty_blocks_flow_through_window(self, monkeypatch):
+        _depth(monkeypatch, 3)
+        # 3 rows over 5 partitions -> repartition makes some 0-row blocks
+        df = tft.frame({"x": np.arange(3.0)}, num_partitions=1)
+        df5 = df.repartition(5)
+        assert sum(b.num_rows == 0 for b in df5.blocks()) >= 2
+        out = df5.map_blocks(lambda x: {"y": x + 1.0}).collect()
+        got = np.asarray([r["y"] for r in out], float).ravel()
+        np.testing.assert_array_equal(got, np.arange(3.0) + 1.0)
+        assert _counters_consistent() == 5
+
+    def test_all_empty_frame(self, monkeypatch):
+        _depth(monkeypatch, 3)
+        df = tft.frame({"x": np.arange(2.0)}, num_partitions=1)
+        empty = df.filter(lambda x: x > 99.0).repartition(4)
+        out = empty.map_rows(lambda x: {"y": x * 2.0})
+        assert out.count() == 0
+
+    def test_depth_exceeding_block_count(self, monkeypatch):
+        _depth(monkeypatch, 64)
+        df = tft.frame({"x": np.arange(12.0)}, num_partitions=3)
+        out = df.map_blocks(lambda x: {"y": x - 1.0}).collect()
+        got = np.asarray([r["y"] for r in out], float).ravel()
+        np.testing.assert_array_equal(got, np.arange(12.0) - 1.0)
+        assert _counters_consistent() == 3
+
+
+# ---------------------------------------------------------------------------
+# PipelinedExecutor + donation + occupancy
+# ---------------------------------------------------------------------------
+
+class TestPipelinedExecutor:
+    def test_pins_depth_over_env(self, monkeypatch):
+        _depth(monkeypatch, 1)  # env says serial...
+        pex = PipelinedExecutor(BlockExecutor(), depth=3)
+        assert pex.depth == 3
+        df = tft.frame({"x": np.arange(20.0)}, num_partitions=4)
+        out = df.map_blocks(lambda x: {"y": x * 2.0},
+                            executor=pex).collect()
+        got = np.asarray([r["y"] for r in out], float).ravel()
+        np.testing.assert_array_equal(got, np.arange(20.0) * 2.0)
+        # ...but the executor's pinned depth actually pipelined
+        assert _counters_consistent() == 4
+
+    def test_map_helper_orders_results(self):
+        ex = PipelinedExecutor(BlockExecutor(), depth=2)
+        from tensorframes_tpu.engine import ops as _ops
+        df = tft.frame({"x": np.arange(4.0)})
+        comp = _ops._map_computation(lambda x: {"y": x * 2.0}, df.schema,
+                                     block_level=True)
+        streams = [{"x": np.full(3, float(i))} for i in range(5)]
+        outs = ex.map(streams, comp)
+        for i, o in enumerate(outs):
+            np.testing.assert_array_equal(o["y"], np.full(3, 2.0 * i))
+
+    def test_padded_submit_uses_donating_executable(self, monkeypatch):
+        """The padded staging path compiles a donating variant distinct
+        from the plain executable (cache keys differ), and both produce
+        the same values."""
+        ex = BlockExecutor(pad_rows=True)
+        from tensorframes_tpu.engine import ops as _ops
+        df = tft.frame({"x": np.arange(5.0)})
+        comp = _ops._map_computation(lambda x: {"y": x + 1.0}, df.schema,
+                                     block_level=True)
+        arrays = {"x": np.arange(5.0)}  # pads to the 8-bucket
+        monkeypatch.setenv("TFT_DONATE", "1")  # default-off on CPU
+        out_async = ex.submit(comp, arrays).drain()
+        donating = ex.compile_count
+        monkeypatch.setenv("TFT_DONATE", "0")
+        out_plain = ex.run(comp, arrays)
+        np.testing.assert_array_equal(out_async["y"], out_plain["y"])
+        assert ex.compile_count == donating + 1  # distinct executables
+
+    def test_occupancy_gauge_sampled(self, monkeypatch):
+        _depth(monkeypatch, 3)
+        tracing.timings.reset()
+        tracing.enable()
+        try:
+            df = tft.frame({"x": np.arange(30.0)}, num_partitions=6)
+            df.map_blocks(lambda x: {"y": x + 1.0}).blocks()
+        finally:
+            tracing.disable()
+        snap = tracing.timings.snapshot()
+        occ = snap.get("pipeline.occupancy")
+        assert occ is not None and occ["count"] == 6
+        assert occ["max_s"] <= 3  # never exceeds the window

@@ -153,9 +153,14 @@ class TestFaults:
 # ---------------------------------------------------------------------------
 
 class TestEngineResilience:
-    def test_map_blocks_succeeds_on_third_attempt(self):
+    def test_map_blocks_succeeds_on_third_attempt(self, monkeypatch):
         """Acceptance: inject("compile", fail_n=2) → a 3-block map
-        succeeds on the 3rd attempt and exactly 2 retries are recorded."""
+        succeeds on the 3rd attempt and exactly 2 retries are recorded.
+
+        Pinned to the serial engine: under pipelining an async-submit
+        fault is recovered by a sync re-run instead of an in-place retry
+        (tests/test_pipeline.py covers that composition)."""
+        monkeypatch.setenv("TFT_PIPELINE_DEPTH", "1")
         df = tft.frame({"x": np.arange(12.0)}, num_partitions=3)
         with faults.inject("compile", fail_n=2):
             out = df.map_blocks(lambda x: {"y": x * 2.0}).collect()
@@ -215,6 +220,23 @@ class TestEngineResilience:
         # padded-8 compile + exact 2-row + exact 3-row (a re-padding
         # regression would cache-hit the 8-bucket and stay at 1)
         assert ex.compile_count == 3
+
+    def test_padding_executor_pad_fallback_oom_still_splits(self):
+        # double failure on the composable padding wrapper: the bucketed
+        # compile dies (non-OOM) AND the exact-shape fallback OOMs — the
+        # path is still row-local, so the split must engage, not the
+        # job die (degradation matrix: row-local OOM -> split)
+        from tensorframes_tpu.engine.executor import PaddingExecutor
+        ex = PaddingExecutor(BlockExecutor())
+        df = tft.frame({"x": np.arange(5.0)}, num_partitions=1)
+        with faults.inject("pad_compile", fail_n=1):
+            with faults.inject("oom", fail_n=1):
+                out = df.map_rows(lambda x: {"y": x + 1.0},
+                                  executor=ex).collect()
+        got = np.asarray([r["y"] for r in out], float).ravel()
+        np.testing.assert_allclose(got, np.arange(5.0) + 1.0)
+        assert counters.get("pad_fallback.compiles") == 1
+        assert counters.get("oom_split.dispatches") == 1
 
     def test_oom_without_row_local_contract_propagates(self):
         # block-level computations may be cross-row: splitting would be

@@ -76,7 +76,10 @@ def test_span_enabled_records_stats():
     assert "stage" in tracing.timings.report()
 
 
-def test_engine_stages_report_spans():
+def test_engine_stages_report_spans(monkeypatch):
+    # serial engine spans; the pipelined stream's spans/gauges are
+    # covered by tests/test_pipeline.py
+    monkeypatch.setenv("TFT_PIPELINE_DEPTH", "1")
     tracing.enable()
     df = tft.frame({"x": np.arange(8.0)}, num_partitions=2)
     out = tft.map_blocks(lambda x: {"z": x + 3.0}, df)
