@@ -150,6 +150,95 @@ def _child(platform: str) -> None:
     finally:
         os.environ.pop("TFT_PIPELINE_DEPTH", None)
 
+    # secondary metric (never costs the headline): the observability
+    # layer's cost on the SAME 1M-row host-engine map_blocks workload.
+    # Three modes: "bypass" (query_trace/add_event short-circuited at
+    # their first flag check — the closest runtime stand-in for the
+    # pre-observability engine), "off" (the hooks run their normal
+    # disabled checks — the default production path), "on" (TFT_TRACE=1
+    # with query traces, block events, and stage attribution). The
+    # acceptance bar is the off-vs-bypass delta: the disabled layer must
+    # cost <2%. Wall-clock budgeted like the pipeline secondary.
+    tracing_secondary = None
+    trace_budget_s = 45.0
+    trace_t0 = time.perf_counter()
+    try:
+        from tensorframes_tpu.observability import events as _obs_events
+        from tensorframes_tpu.utils import tracing as _tracing
+
+        tdf = tft.frame({"x": x}, num_partitions=8)
+        tdf.cache()
+        tcomp = Computation.trace(
+            lambda x: {"z": x + 3.0},
+            [TensorSpec("x", _dt.double, Shape(Unknown))])
+
+        def _force_once() -> float:
+            t0 = time.perf_counter()
+            tdf.map_blocks(tcomp, trim=True).blocks()
+            return time.perf_counter() - t0
+
+        def _measure_bypass() -> float:
+            with _obs_events.bypass():
+                return _force_once()
+
+        def _measure_off() -> float:
+            return _force_once()
+
+        def _measure_on() -> float:
+            _tracing.enable()
+            try:
+                return _force_once()
+            finally:
+                _tracing.disable()
+
+        from statistics import median as _median
+
+        # The acceptance bar (off regresses <2% vs the layer stripped
+        # out) is measured FIRST and alone, as alternating pairs with
+        # the in-pair order flipped each round: sequential clumps
+        # confound with machine drift, fixed ordering adds position
+        # bias, min-of is unstable between near-identical distributions,
+        # and tracing-ON iterations in the same loop leave allocation/GC
+        # debt that lands asymmetrically — each effect alone dwarfs the
+        # disabled layer's real (nanoseconds/block) cost on a ~10ms
+        # workload. Medians over ~80 interleaved pairs are stable.
+        _tracing.disable()
+        _force_once()  # warm the compile cache once for every mode
+        samples = {"bypass": [], "off": [], "on": []}
+        rounds = 0
+        pair_budget_s = trace_budget_s * 0.75
+        while rounds < 250 and (time.perf_counter() - trace_t0
+                                < pair_budget_s or rounds < 2):
+            if rounds % 2:
+                samples["off"].append(_measure_off())
+                samples["bypass"].append(_measure_bypass())
+            else:
+                samples["bypass"].append(_measure_bypass())
+                samples["off"].append(_measure_off())
+            rounds += 1
+        # tracing-ON cost is informational (the documented price of
+        # TFT_TRACE=1), measured after the off/bypass pairs
+        while len(samples["on"]) < 20 and (
+                time.perf_counter() - trace_t0 < trace_budget_s
+                or not samples["on"]):
+            samples["on"].append(_measure_on())
+
+        bypass_rps = N_ROWS / _median(samples["bypass"])
+        off_rps = N_ROWS / _median(samples["off"])
+        on_rps = N_ROWS / _median(samples["on"])
+        off_overhead_pct = (bypass_rps - off_rps) / bypass_rps * 100.0
+        tracing_secondary = {
+            "bypass_rows_per_s": round(bypass_rps, 1),
+            "off_rows_per_s": round(off_rps, 1),
+            "on_rows_per_s": round(on_rps, 1),
+            "off_overhead_pct": round(off_overhead_pct, 2),
+            "on_overhead_pct": round(
+                (bypass_rps - on_rps) / bypass_rps * 100.0, 2),
+            "off_within_2pct": bool(off_overhead_pct < 2.0),
+        }
+    except Exception as e:  # noqa: BLE001 - headline must survive
+        tracing_secondary = {"error": str(e)[:300]}
+
     # reference structure: Rows materialized in and out per block
     schema = df.schema
     t0 = time.perf_counter()
@@ -172,6 +261,7 @@ def _child(platform: str) -> None:
         "row_path_rows_per_s": round(ref, 1),
         "executor": executor,
         "pipelined_vs_serial": pipeline_secondary,
+        "tracing_overhead": tracing_secondary,
     }
 
     if plat == "tpu":

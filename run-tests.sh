@@ -25,6 +25,14 @@ if [ "${1:-}" = "--pipeline" ]; then
   exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m pipeline "$@"
 fi
 
+# --observability: run only the query-trace/metrics/explain lane
+# (tests/test_observability.py) — fast, CPU-only, no native build needed
+if [ "${1:-}" = "--observability" ]; then
+  shift
+  echo "== observability lane (pytest -m observability, CPU) =="
+  exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m observability "$@"
+fi
+
 echo "== building native runtime (libtfruntime.so) =="
 make -C native
 

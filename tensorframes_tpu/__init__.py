@@ -31,8 +31,11 @@ from .shape import Shape, Unknown
 from . import dtypes
 from . import utils
 from .utils.logging import initialize_logging
+from .utils.tracing import dump_stats
 from .schema import Field, Schema
 from .frame import Block, GroupedFrame, Row, TensorFrame
+from . import observability
+from .observability import last_query_report
 from .computation import Computation, TensorSpec, analyze_graph
 from .api import (
     aggregate, analyze, block, explain, filter_rows, frame, map_blocks,
@@ -70,5 +73,8 @@ __all__ = [
     "utils",
     "builder",
     "initialize_logging",
+    "observability",
+    "last_query_report",
+    "dump_stats",
     "__version__",
 ]

@@ -86,7 +86,12 @@ def analyze(df: TensorFrame) -> TensorFrame:
 
 def explain(df: TensorFrame) -> str:
     """Pretty-print the frame's tensor info (DataFrameInfo.explain
-    analogue, reference ``DataFrameInfo.scala:24-38``)."""
+    analogue, reference ``DataFrameInfo.scala:24-38``).
+
+    This is the SCHEMA description (reference-parity surface). For the
+    execution report of a forcing — rows/blocks/bytes, retries, wall
+    time by stage — use the method ``df.explain()``
+    (``docs/observability.md``)."""
     lines = [f"TensorFrame with {len(df.schema)} column(s), "
              f"{df.num_partitions} partition(s):"]
     for f in df.schema:

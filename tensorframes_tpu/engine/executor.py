@@ -25,10 +25,11 @@ import numpy as np
 from .. import dtypes as _dt
 from .. import native as _native
 from ..computation import Computation
+from ..observability.events import add_event as _obs_event
 from ..resilience import (default_policy, env_bool, faults, is_oom,
                           is_permanent)
 from ..utils.logging import get_logger
-from ..utils.tracing import counters, span
+from ..utils.tracing import counters, enabled as _tracing_enabled, span
 
 __all__ = ["BlockExecutor", "PaddingExecutor", "PendingBlock",
            "default_executor", "default_padding_executor"]
@@ -100,6 +101,7 @@ def _oom_split_run(executor, comp: Computation, arrays: Mapping,
                    for s in comp.outputs)):
         raise cause
     counters.inc("oom_split.dispatches")
+    _obs_event("oom_split", rows=n_rows, error=type(cause).__name__)
     _log.warning(
         "block dispatch hit an OOM-shaped failure (%s); re-dispatching "
         "as two %d/%d-row halves", cause, n_rows // 2,
@@ -211,6 +213,8 @@ class PendingBlock:
             # exact-shape fallback can still recover them.
             raise self._error
         counters.inc("pipeline.sync_fallbacks")
+        _obs_event("sync_fallback", error=type(self._error).__name__,
+                   padded=self._pad_to is not None)
         _log.warning(
             "async fast path failed for a block (%s); re-running it "
             "synchronously through the resilient path", self._error)
@@ -283,8 +287,21 @@ class BlockExecutor:
                         else jax.jit(comp.fn)
                     per_comp[sig] = fn
                     self.compile_count += 1
+                    counters.inc("compile_cache.misses")
+                    _obs_event("compile_cache", hit=False)
                     _log.debug("compile #%d for signature %s",
                                self.compile_count, sig)
+                elif _tracing_enabled():  # raced another thread to it
+                    counters.inc("compile_cache.hits")
+                    _obs_event("compile_cache", hit=True)
+        elif _tracing_enabled():
+            # hit bookkeeping only under tracing: hits are a per-dispatch
+            # perf stat, and the counter's global mutex must not serialize
+            # the lock-free fast path above when observability is off
+            # (misses are rare and already inside the compile lock, so
+            # they stay always-on)
+            counters.inc("compile_cache.hits")
+            _obs_event("compile_cache", hit=True)
         return fn
 
     def _donate_padded(self) -> bool:
@@ -398,6 +415,8 @@ class BlockExecutor:
                 if is_oom(e):
                     return _oom_split_run(self, comp, arrays, n_rows, e)
                 counters.inc("pad_fallback.compiles")
+                _obs_event("pad_fallback", pad_to=pad_to, rows=n_rows,
+                           error=type(e).__name__)
                 _log.warning(
                     "bucketed %d-row compile/dispatch failed (%s); "
                     "falling back to the exact %d-row shape",
@@ -492,6 +511,8 @@ class PaddingExecutor:
             # a failing bucketed compile must not take the job down when
             # the exact shape (the no-padding semantics) can still run
             counters.inc("pad_fallback.compiles")
+            _obs_event("pad_fallback", pad_to=pad_to, rows=n_rows,
+                       error=type(e).__name__)
             _log.warning(
                 "bucketed %d-row compile failed (%s); falling back to "
                 "the exact %d-row shape", pad_to, e, n_rows)

@@ -37,6 +37,7 @@ from .. import dtypes as _dt
 from ..computation import Computation, TensorSpec
 from ..frame import Block, GroupedFrame, Row, TensorFrame
 from ..marshal import Column
+from ..observability.events import traced_query
 from ..schema import Field, Schema
 from ..shape import Shape, Unknown
 from ..utils.logging import get_logger
@@ -574,6 +575,7 @@ def filter_rows(predicate: Fetches, df: TensorFrame,
 # reduce_blocks / reduce_rows
 # ---------------------------------------------------------------------------
 
+@traced_query("reduce_blocks")
 def reduce_blocks(fetches: Fetches, df: TensorFrame,
                   executor: Optional[BlockExecutor] = None) -> Dict[str, np.ndarray]:
     """Reduce the whole frame to one row. Eager.
@@ -613,6 +615,7 @@ def reduce_blocks(fetches: Fetches, df: TensorFrame,
         return ex.run(comp, stacked, pad_ok=False)
 
 
+@traced_query("reduce_rows")
 def reduce_rows(fetches: Fetches, df: TensorFrame,
                 executor: Optional[BlockExecutor] = None) -> Dict[str, np.ndarray]:
     """Pairwise-reduce the whole frame to one row. Eager.
@@ -1015,6 +1018,7 @@ def _aggregate_segmented_fold(comp, fetch_names, fetch_blocks, fact,
     return cols
 
 
+@traced_query("aggregate")
 def aggregate(fetches: Fetches, grouped: GroupedFrame,
               buffer_size: int = DEFAULT_BUFFER_SIZE,
               executor: Optional[BlockExecutor] = None) -> TensorFrame:

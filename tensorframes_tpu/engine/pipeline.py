@@ -35,6 +35,11 @@ Observability: ``pipeline.submitted`` / ``pipeline.drained`` /
 (``utils.tracing.counters``); window occupancy is sampled into the
 ``pipeline.occupancy`` gauge and submit/drain run inside
 ``pipeline.submit`` / ``pipeline.drain`` spans when tracing is enabled.
+With an active :class:`~..observability.QueryTrace` each block also
+records typed ``block_submit``/``block_compute``/``block_drain`` events
+on its in-flight slot's track plus per-submit occupancy samples — the
+chrome-trace export (``docs/observability.md``) renders one track per
+slot so depth tuning becomes visual.
 """
 
 from __future__ import annotations
@@ -42,6 +47,7 @@ from __future__ import annotations
 from collections import deque
 from typing import Callable, List, Optional, Sequence, TypeVar
 
+from ..observability import events as _obs
 from ..resilience import env_int
 from ..utils.logging import get_logger
 from ..utils.tracing import counters, gauge, span
@@ -124,23 +130,62 @@ def run_pipelined(blocks: Sequence[B],
     """
     blocks = list(blocks)
     d = pipeline_depth(depth)
+    trace = _obs.current_trace()
     if d <= 1 or len(blocks) <= 1:
-        return [serial_fn(b) for b in blocks]
+        if trace is None:
+            return [serial_fn(b) for b in blocks]
+        out0: List[R] = []
+        for i, b in enumerate(blocks):
+            rows, nbytes = _obs.block_meta(b)
+            t0 = trace.clock()
+            r = serial_fn(b)
+            rows_out, _ = _obs.block_meta(r)
+            trace.add("block_run", name=f"block {i}", ts=t0,
+                      dur=trace.clock() - t0, track=1, block=i,
+                      rows=rows, bytes=nbytes, rows_out=rows_out)
+            out0.append(r)
+        return out0
 
     out: List[R] = []
+    # window entries: (pending, block, index, submit_end_ts)
     window: "deque" = deque()
 
     def drain_one() -> None:
-        pending, b = window.popleft()
+        pending, b, i, t_sub = window.popleft()
+        slot = i % d + 1
+        t0 = 0.0
+        if trace is not None:
+            t0 = trace.clock()
+            # the block's in-flight residency: submit end -> drain start
+            trace.add("block_compute", name=f"compute b{i}", ts=t_sub,
+                      dur=max(t0 - t_sub, 0.0), track=slot, block=i)
         with span("pipeline.drain"):
-            out.append(drain_fn(pending, b))
+            result = drain_fn(pending, b)
+        out.append(result)
         counters.inc("pipeline.drained")
+        if trace is not None:
+            rows_out, _ = _obs.block_meta(result)
+            trace.add("block_drain", name=f"drain b{i}", ts=t0,
+                      dur=trace.clock() - t0, track=slot, block=i,
+                      rows_out=rows_out)
 
-    for b in blocks:
+    for i, b in enumerate(blocks):
+        t0 = 0.0
+        rows = nbytes = None
+        if trace is not None:
+            rows, nbytes = _obs.block_meta(b)
+            t0 = trace.clock()
         with span("pipeline.submit"):
-            window.append((submit_fn(b), b))
+            pending = submit_fn(b)
+        t1 = trace.clock() if trace is not None else 0.0
+        window.append((pending, b, i, t1))
         counters.inc("pipeline.submitted")
         gauge("pipeline.occupancy", len(window))
+        if trace is not None:
+            trace.add("block_submit", name=f"submit b{i}", ts=t0,
+                      dur=t1 - t0, track=i % d + 1, block=i, rows=rows,
+                      bytes=nbytes)
+            trace.add("occupancy", value=len(window))
         if len(window) >= d:
             drain_one()
     while window:

@@ -29,6 +29,7 @@ import numpy as np
 
 from . import dtypes as _dt
 from .marshal import Column, columns_to_rows, rows_to_columns
+from .observability import events as _obs
 from .schema import Field, Schema
 from .shape import Shape, Unknown
 
@@ -196,6 +197,9 @@ class TensorFrame:
         self._cache: Optional[List[Block]] = None
         self._num_partitions = num_partitions
         self._plan = plan
+        # the QueryTrace of this frame's forcing (None until forced with
+        # tracing enabled); rendered by explain()
+        self._trace = None
 
     # -- construction ------------------------------------------------------
     @staticmethod
@@ -251,7 +255,14 @@ class TensorFrame:
     # -- evaluation --------------------------------------------------------
     def blocks(self) -> List[Block]:
         if self._cache is None:
-            self._cache = self._thunk()
+            # forcing IS the query: open a correlated trace (no-op with
+            # tracing off; a forcing nested inside another query joins
+            # the ambient trace and yields None here)
+            with _obs.query_trace(self._plan.split("(", 1)[0],
+                                  plan=self._plan) as t:
+                self._cache = self._thunk()
+            if t is not None:
+                self._trace = t
         return self._cache
 
     def collect(self) -> List[Row]:
@@ -590,6 +601,25 @@ class TensorFrame:
         return api.analyze(self)
 
     # -- introspection -----------------------------------------------------
+    def explain(self) -> str:
+        """Human-readable execution report of this frame's forcing: rows,
+        blocks, bytes marshalled, retries, OOM splits, sync fallbacks,
+        compile-cache behavior, and wall time by stage
+        (``docs/observability.md``).
+
+        Renders the trace recorded when the frame was forced with tracing
+        enabled (``TFT_TRACE=1``). An untraced (or unforced) frame is
+        (re-)forced once with tracing temporarily enabled process-wide —
+        i.e. calling ``explain()`` post-hoc re-executes this frame's plan
+        and pays that cost; force under ``TFT_TRACE=1`` to avoid it. For
+        eager results (``reduce_*``/``aggregate``) use
+        ``tft.last_query_report()``. Distinct from the function
+        ``tft.explain(df)``, which describes the SCHEMA (reference
+        parity).
+        """
+        from .observability import frame_report
+        return frame_report(self)
+
     def explain_tensors(self) -> str:
         return self._schema.tree_string()
 

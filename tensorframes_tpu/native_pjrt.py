@@ -27,7 +27,10 @@ import numpy as np
 
 from . import dtypes as _dt
 from .computation import Computation
+from .observability import events as _obs
 from .utils.logging import get_logger
+from .utils.tracing import counters as _counters
+from .utils.tracing import enabled as _tracing_enabled
 
 __all__ = ["available", "PjrtCoreClient", "PjrtBlockExecutor",
            "PjrtDeviceBuffer"]
@@ -683,11 +686,17 @@ class PjrtBlockExecutor:
         per_comp = self._cache.get(comp)
         exe = None if per_comp is None else per_comp.get(sig)
         if exe is not None:
+            if _tracing_enabled():  # hit stats must not lock the fast path
+                _counters.inc("compile_cache.hits")
+                _obs.add_event("compile_cache", hit=True, native=True)
             return exe
         with self._lock:
             per_comp = self._cache.setdefault(comp, {})
             exe = per_comp.get(sig)
             if exe is not None:
+                if _tracing_enabled():
+                    _counters.inc("compile_cache.hits")
+                    _obs.add_event("compile_cache", hit=True, native=True)
                 return exe
             dyn = getattr(comp, "_native_dynamic", None)
             if dyn:
@@ -703,6 +712,8 @@ class PjrtBlockExecutor:
                        if n_replicas > 1 else self.client.compile(hlo))
             per_comp[sig] = exe
             self.compile_count += 1
+            _counters.inc("compile_cache.misses")
+            _obs.add_event("compile_cache", hit=False, native=True)
             _log.debug("native compile #%d for %s", self.compile_count,
                        sig)
             return exe
@@ -724,7 +735,14 @@ class PjrtBlockExecutor:
         # PjrtCoreError carries the PJRT status word (UNAVAILABLE /
         # ABORTED / ...) in its message, which is exactly what the
         # transient classifier keys on
-        return default_policy().call(attempt, op="pjrt.execute")
+        trace = _obs.current_trace()
+        if trace is None:
+            return default_policy().call(attempt, op="pjrt.execute")
+        t0 = trace.clock()
+        out = default_policy().call(attempt, op="pjrt.execute")
+        trace.add("dispatch", name="pjrt.execute", ts=t0,
+                  dur=trace.clock() - t0)
+        return out
 
     def submit(self, comp: Computation, arrays: Mapping[str, np.ndarray],
                pad_ok: bool = True) -> "_PjrtPending":
@@ -745,7 +763,11 @@ class PjrtBlockExecutor:
                         max_workers=1,
                         thread_name_prefix="tfr-pjrt-submit")
                 pool = self._pool
-        return _PjrtPending(pool.submit(self.run, comp, arrays, pad_ok))
+        # wrap_context carries the submitting query's correlation id
+        # (contextvars) onto the worker thread, so events the resilient
+        # run records over there still attach to the right QueryTrace
+        return _PjrtPending(pool.submit(_obs.wrap_context(self.run),
+                                        comp, arrays, pad_ok))
 
     def run_blocks_parallel(self, comp: Computation, blocks,
                             ) -> "list[Dict[str, np.ndarray]]":

@@ -1,0 +1,80 @@
+"""Query-scoped observability: correlated events, timelines, metrics.
+
+The reference's only observability was ``logDebug`` narration and
+self-timed perf suites (SURVEY.md §5); the port's first pass was a flat,
+process-global ``span``/``counters`` registry (:mod:`..utils.tracing`).
+Once the engine pipelines blocks and queries overlap, flat registries
+stop answering the questions that matter — *which query's* retry tripped
+the OOM split, where did block 17 of *this* query spend its time. This
+package adds the query dimension on top of the existing primitives
+(every ``span``/``counters`` call site keeps working unchanged):
+
+- :mod:`.events` — :class:`QueryTrace` + contextvar correlation: every
+  public API forcing gets a unique query id; engine, pipeline,
+  resilience, and native-PJRT layers attach typed events (block
+  submit/compute/drain, retries with their classified error, OOM splits,
+  pad/sync fallbacks, compile-cache hits/misses, occupancy samples).
+  Finished traces land in a bounded ring buffer and an optional JSONL
+  sink (``TFT_TRACE_FILE``); :meth:`QueryTrace.to_chrome_trace` exports
+  a Perfetto/chrome://tracing timeline with one track per pipeline slot.
+- :mod:`.metrics` — Prometheus text-format export
+  (:func:`metrics_text`) and an opt-in loopback HTTP endpoint
+  (:func:`serve_metrics`, ``TFT_METRICS_PORT``; binds 127.0.0.1 only).
+- :mod:`.report` — ``frame.explain()`` / :func:`last_query_report`:
+  the human-readable per-stage breakdown.
+
+Everything is zero-cost-when-off: with tracing disabled
+(``TFT_TRACE`` unset), :func:`query_trace` yields ``None`` and every
+hook is a single ``None`` check. See ``docs/observability.md``.
+"""
+
+from __future__ import annotations
+
+import os
+
+from ..utils import tracing as _tracing
+from ..utils.logging import get_logger
+from .events import (Event, QueryTrace, add_event, block_meta, bypass,
+                     clear_ring, current_trace, last_query, query_trace,
+                     recent_events, traced_query, wrap_context)
+from .metrics import metrics_port, metrics_text, serve_metrics, stop_metrics
+from .report import frame_report, last_query_report, render
+
+__all__ = [
+    "Event", "QueryTrace", "query_trace", "current_trace", "add_event",
+    "wrap_context", "traced_query", "last_query", "recent_events",
+    "clear_ring", "block_meta", "bypass",
+    "metrics_text", "serve_metrics", "stop_metrics", "metrics_port",
+    "frame_report", "last_query_report", "render",
+]
+
+_log = get_logger("observability")
+
+# credit every span to the active query's stage breakdown as well as to
+# the flat registry (one slot; this package owns it)
+from .events import _on_span as _span_observer  # noqa: E402
+
+_tracing.set_span_observer(_span_observer)
+
+
+def _maybe_autostart() -> None:
+    """Opt-in metrics endpoint: ``TFT_METRICS_PORT=<port>`` starts the
+    loopback server at import (``0`` picks a free port)."""
+    raw = os.environ.get("TFT_METRICS_PORT")
+    if not raw:
+        return
+    try:
+        port = int(raw)
+    except ValueError:
+        _log.warning("ignoring malformed TFT_METRICS_PORT=%r", raw)
+        return
+    try:
+        serve_metrics(port)
+    except (OSError, OverflowError, ValueError) as e:
+        # OverflowError: the socket layer's out-of-range-port error —
+        # a bad env value must warn, never break `import tensorframes_tpu`
+        _log.warning("metrics endpoint failed to start on port %s: %s",
+                     raw, e)
+
+
+_maybe_autostart()

@@ -1,0 +1,120 @@
+"""Human-readable per-query reports: ``frame.explain()`` and
+``tft.last_query_report()``.
+
+The structured replacement for the reference's ``logDebug`` narration
+(SURVEY.md §5): instead of grepping interleaved log lines, one call
+renders what a query actually did — rows, blocks, bytes marshalled,
+retries, OOM splits, sync fallbacks, compile-cache behavior, and wall
+time by stage — all from the query's own :class:`~.events.QueryTrace`,
+so overlapping queries can no longer contaminate each other's numbers.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..utils import tracing
+from . import events as _events
+
+__all__ = ["render", "frame_report", "last_query_report"]
+
+
+def _fmt_bytes(n: int) -> str:
+    v = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if v < 1024.0 or unit == "TiB":
+            return f"{v:.1f} {unit}" if unit != "B" else f"{int(v)} B"
+        v /= 1024.0
+    return f"{int(n)} B"
+
+
+def _fmt_secs(s: float) -> str:
+    if s < 1e-3:
+        return f"{s * 1e6:.0f} us"
+    if s < 1.0:
+        return f"{s * 1e3:.2f} ms"
+    return f"{s:.3f} s"
+
+
+def render(trace: "_events.QueryTrace") -> str:
+    """Render one finished (or in-flight) trace as an aligned report."""
+    s = trace.summary()
+    lines = [
+        f"query {s['query_id']} · {s['op']} · "
+        f"{_fmt_secs(s['duration_s'])} · {s['blocks']} block(s)",
+        f"  rows     : {s['rows_in']} in / {s['rows_out']} out · "
+        f"{_fmt_bytes(s['bytes_in'])} marshalled",
+    ]
+    occ = (f", mean occupancy {s['occupancy_mean']:.2f}"
+           if s["occupancy_mean"] is not None else "")
+    if s["slots"] or occ:
+        lines.append(f"  pipeline : {s['slots']} in-flight slot(s){occ}, "
+                     f"{s['sync_fallbacks']} sync fallback(s)")
+    lines.append(
+        f"  resilience: {s['retries']} retried, {s['giveups']} gave up, "
+        f"{s['oom_splits']} oom split(s), "
+        f"{s['pad_fallbacks']} pad fallback(s)")
+    lines.append(
+        f"  compile  : {s['compile_misses']} miss(es) / "
+        f"{s['compile_hits']} hit(s)")
+    extra = f" (+{s['dropped']} dropped)" if s["dropped"] else ""
+    lines.append(f"  events   : {s['events']} recorded{extra}")
+    if trace.stages:
+        lines.append("  wall time by stage:")
+        width = max(len(k) for k in trace.stages)
+        for name in sorted(trace.stages,
+                           key=lambda k: -trace.stages[k][1]):
+            count, total = trace.stages[name]
+            lines.append(f"    {name:<{width}} {int(count):6d}x "
+                         f"{total:12.6f}s")
+    return "\n".join(lines)
+
+
+def frame_report(df) -> str:
+    """``TensorFrame.explain()`` backend: the execution report of the
+    frame's forcing.
+
+    If the frame was already forced while tracing was on, its recorded
+    trace renders directly. Otherwise the frame is (re-)forced once with
+    tracing temporarily enabled — ``explain()`` is an explicit request
+    for observability, so it pays for one traced execution rather than
+    returning nothing.
+    """
+    t = getattr(df, "_trace", None)
+    if t is None:
+        if _events.current_trace() is not None:
+            # re-forcing inside an active query would join that trace
+            # and record nothing for this frame: full cost, no report
+            return ("(no query trace recorded — explain() was called "
+                    "inside another active query; call it after that "
+                    "query finishes)")
+        was = tracing.enabled()
+        if not was:
+            tracing.enable()
+        old_cache = df._cache
+        try:
+            df._cache = None  # re-force under a trace
+            df.blocks()
+        except BaseException:
+            df._cache = old_cache  # a failed re-force must not lose
+            raise                  # the previously computed result
+        finally:
+            if not was:
+                tracing.disable()
+        t = getattr(df, "_trace", None)
+    if t is None:
+        return ("(no query trace recorded — the frame was forced inside "
+                "another query or tracing stayed off)")
+    return render(t)
+
+
+def last_query_report() -> str:
+    """Report of the most recently finished query (eager ops — reduce /
+    aggregate / the mesh d-ops — have no frame to hang ``explain()``
+    on; this is their equivalent)."""
+    t = _events.last_query()
+    if t is None:
+        return ("(no query recorded yet — enable tracing with TFT_TRACE=1 "
+                "or tensorframes_tpu.utils.tracing.enable() and run a "
+                "query)")
+    return render(t)

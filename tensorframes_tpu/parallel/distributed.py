@@ -41,6 +41,7 @@ from ..resilience import default_policy as _default_policy, faults as _faults
 from ..schema import Schema
 from .collectives import COMBINERS
 from .mesh import DeviceMesh
+from ..observability.events import traced_query
 from ..utils.logging import get_logger
 from ..utils.tracing import span
 
@@ -257,6 +258,7 @@ def _read_global(a) -> np.ndarray:
     return gathered.reshape((-1,) + tuple(a.shape[1:]))
 
 
+@traced_query("distribute")
 def distribute(df: TensorFrame, mesh: DeviceMesh) -> DistributedFrame:
     """Shard a host frame over the mesh's data axis.
 
@@ -296,6 +298,7 @@ def distribute(df: TensorFrame, mesh: DeviceMesh) -> DistributedFrame:
     return DistributedFrame(mesh, df.schema, cols, n)
 
 
+@traced_query("dmap_blocks")
 def dmap_blocks(fetches, dist: DistributedFrame, trim: bool = False,
                 row_aligned: Optional[bool] = None) -> DistributedFrame:
     """Mesh-parallel map: one jit dispatch, all shards in parallel.
@@ -393,6 +396,7 @@ def dmap_blocks(fetches, dist: DistributedFrame, trim: bool = False,
                                          else None))
 
 
+@traced_query("dfilter")
 def dfilter(predicate, dist: DistributedFrame) -> DistributedFrame:
     """Mesh filter: keep the rows where ``predicate`` holds (nonzero).
 
@@ -509,6 +513,7 @@ _dsort_cache: "OrderedDict[tuple, object]" = OrderedDict()
 _DSORT_CACHE_CAP = 32
 
 
+@traced_query("dsort")
 def dsort(keys, dist: DistributedFrame, descending: bool = False
           ) -> DistributedFrame:
     """Rows globally sorted by scalar key column(s), on the mesh.
@@ -873,6 +878,7 @@ def _dsort_columnsort(dist, keys, descending, tensor_names, arrays,
         return fn(valid_dev, *arrays)
 
 
+@traced_query("dreduce_blocks")
 def dreduce_blocks(fetches, dist: DistributedFrame):
     """Mesh-parallel reduce to one row.
 
@@ -1255,6 +1261,7 @@ def _device_key_columns(dist: DistributedFrame, keys, key_table,
             for i, k in enumerate(keys)}, count
 
 
+@traced_query("daggregate")
 def daggregate(fetches, dist: DistributedFrame, keys,
                max_groups: Optional[int] = None) -> TensorFrame:
     """Mesh-distributed keyed aggregation.

@@ -34,6 +34,8 @@ import threading
 import time
 from typing import Callable, Optional, TypeVar
 
+from ..observability.events import add_event as _obs_event
+from ..observability.events import current_trace as _current_trace
 from ..utils.logging import get_logger
 from ..utils.tracing import counters, span
 
@@ -118,6 +120,17 @@ def check_deadline(op: str = "operation") -> None:
 
 
 # -- retry policy ------------------------------------------------------------
+
+def _error_kind(exc: BaseException) -> str:
+    """The classifier's verdict as an event label (oom / transient /
+    permanent) — what the retry decision was actually based on."""
+    from .classify import is_oom, is_transient
+    if is_oom(exc):
+        return "oom"
+    if is_transient(exc):
+        return "transient"
+    return "permanent"
+
 
 def env_float(name: str, default: Optional[float]) -> Optional[float]:
     """Float env knob; unset/empty/malformed (warned) → ``default``."""
@@ -223,6 +236,15 @@ class RetryPolicy:
                     # sleeping would blow the deadline: give up now with
                     # the deadline error, carrying the real failure
                     counters.inc(f"retry.{op}.giveups")
+                    if _current_trace() is not None:
+                        # kind classification only when a trace listens:
+                        # the giveup/retry paths must stay zero-cost
+                        # with tracing off (re-classifying str(exc) per
+                        # attempt is not free)
+                        _obs_event("giveup", name=op,
+                                   attempts=attempt + 1,
+                                   error=type(last).__name__,
+                                   kind=_error_kind(last), deadline=True)
                     _log.error(
                         "%s: transient failure and only %.3fs left on "
                         "the deadline (backoff %.3fs); giving up", op,
@@ -231,12 +253,21 @@ class RetryPolicy:
                         f"{op}: deadline reached after {attempt + 1} "
                         f"attempt(s)") from last
                 counters.inc(f"retry.{op}.retries")
+                if _current_trace() is not None:
+                    _obs_event("retry", name=op, attempt=attempt + 1,
+                               backoff_s=delay,
+                               error=type(last).__name__,
+                               kind=_error_kind(last))
                 _log.warning(
                     "%s: transient failure (attempt %d/%d), retrying in "
                     "%.3fs: %s", op, attempt + 1, self.max_attempts,
                     delay, last)
                 sleep(delay)
             counters.inc(f"retry.{op}.giveups")
+            if _current_trace() is not None:
+                _obs_event("giveup", name=op, attempts=self.max_attempts,
+                           error=type(last).__name__,
+                           kind=_error_kind(last))
             _log.error("%s: giving up after %d attempt(s): %s",
                        op, self.max_attempts, last)
             assert last is not None

@@ -8,8 +8,8 @@ modules the engine imports.
 
 from . import checkpoint
 from .logging import TRACE, get_logger, initialize_logging, set_level
-from .tracing import (Timings, disable, enable, enabled, profile, span,
-                      timings)
+from .tracing import (Timings, disable, dump_stats, enable, enabled,
+                      profile, span, timings)
 
 __all__ = [
     "checkpoint",
@@ -24,4 +24,5 @@ __all__ = [
     "disable",
     "enabled",
     "profile",
+    "dump_stats",
 ]

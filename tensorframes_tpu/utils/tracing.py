@@ -10,15 +10,21 @@ replacement is real instrumentation:
    ``jax.profiler.TraceAnnotation``, so host stages line up with device ops
    in the profiler UI;
  - :class:`Timings` — a process-wide registry of per-stage statistics
-   (count / total / min / max seconds), the structured replacement for the
-   reference's log-line narration; the engine's hot stages (validate,
-   convert, execute, convertBack) report here;
+   (count / total / min / max seconds) plus dimensionless gauges, the
+   structured replacement for the reference's log-line narration; the
+   engine's hot stages (validate, convert, execute, convertBack) report
+   here;
  - :func:`profile` — wraps ``jax.profiler.start_trace/stop_trace`` for a
    whole-program device trace dump viewable in TensorBoard/XProf.
 
 All hooks are zero-cost-when-off: ``span`` skips stat collection and device
 annotation unless tracing is enabled (it is during :func:`profile`, under
 ``TFT_TRACE=1``, or after :func:`enable`).
+
+Per-QUERY attribution (which query's block 17, which query's retry) lives
+one layer up in :mod:`tensorframes_tpu.observability`, which registers a
+span observer here (:func:`set_span_observer`) so every span is also
+credited to the active query trace.
 """
 
 from __future__ import annotations
@@ -27,12 +33,13 @@ import contextlib
 import os
 import threading
 import time
-from typing import Dict, Iterator, Optional
+from typing import Callable, Dict, Iterator, Optional
 
 from .logging import get_logger
 
 __all__ = ["Timings", "timings", "Counters", "counters", "span", "gauge",
-           "enable", "disable", "enabled", "profile"]
+           "enable", "disable", "enabled", "profile", "dump_stats",
+           "set_span_observer"]
 
 _log = get_logger("utils.tracing")
 
@@ -60,11 +67,48 @@ class _Stat:
                 "min_s": self.min if self.count else 0.0, "max_s": self.max}
 
 
+class _GaugeStat:
+    """Stats for a sampled LEVEL (window occupancy, queue depth): gauges
+    are dimensionless, so their stat keys carry no ``_s`` unit suffix and
+    they track ``last`` (the newest sample) instead of ``total``."""
+
+    __slots__ = ("count", "total", "min", "max", "last")
+
+    def __init__(self):
+        self.count = 0
+        self.total = 0.0
+        self.min = float("inf")
+        self.max = float("-inf")
+        self.last = 0.0
+
+    def add(self, value: float):
+        self.count += 1
+        self.total += value
+        if value < self.min:
+            self.min = value
+        if value > self.max:
+            self.max = value
+        self.last = value
+
+    def as_dict(self) -> Dict[str, float]:
+        mean = self.total / self.count if self.count else 0.0
+        return {"count": self.count, "mean": mean,
+                "min": self.min if self.count else 0.0,
+                "max": self.max if self.count else 0.0,
+                "last": self.last}
+
+
+# gauges whose pre-0.2 snapshot entries used the span keys (mean_s/...):
+# readable under BOTH key sets for one release, then the aliases go away
+_GAUGE_LEGACY_ALIASES = ("pipeline.occupancy",)
+
+
 class Timings:
-    """Thread-safe per-stage timing registry."""
+    """Thread-safe per-stage timing registry (+ gauge samples)."""
 
     def __init__(self):
         self._stats: Dict[str, _Stat] = {}
+        self._gauges: Dict[str, _GaugeStat] = {}
         self._lock = threading.Lock()
 
     def add(self, name: str, dt: float) -> None:
@@ -74,25 +118,80 @@ class Timings:
                 stat = self._stats[name] = _Stat()
             stat.add(dt)
 
-    def snapshot(self) -> Dict[str, Dict[str, float]]:
+    def add_gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            stat = self._gauges.get(name)
+            if stat is None:
+                stat = self._gauges[name] = _GaugeStat()
+            stat.add(value)
+
+    def spans_snapshot(self) -> Dict[str, Dict[str, float]]:
         with self._lock:
             return {k: v.as_dict() for k, v in self._stats.items()}
+
+    def gauges_snapshot(self) -> Dict[str, Dict[str, float]]:
+        with self._lock:
+            out = {}
+            for k, v in self._gauges.items():
+                d = v.as_dict()
+                if k in _GAUGE_LEGACY_ALIASES:
+                    # deprecated (one release): the old duration-suffixed
+                    # keys these gauges were first published under
+                    d["mean_s"] = d["mean"]
+                    d["min_s"] = d["min"]
+                    d["max_s"] = d["max"]
+                out[k] = d
+            return out
+
+    def snapshot(self) -> Dict[str, Dict[str, float]]:
+        """Spans and gauges in one dict; span entries use ``*_s`` keys,
+        gauge entries unit-less ``mean``/``min``/``max``/``last``."""
+        out = self.spans_snapshot()
+        out.update(self.gauges_snapshot())
+        return out
 
     def reset(self) -> None:
         with self._lock:
             self._stats.clear()
+            self._gauges.clear()
 
-    def report(self) -> str:
-        snap = self.snapshot()
-        if not snap:
+    def report(self, include_counters: bool = True) -> str:
+        """One merged human-readable report: spans, gauges, and (by
+        default) the always-on :data:`counters`."""
+        spans = self.spans_snapshot()
+        gauges = self.gauges_snapshot()
+        counts = counters.snapshot() if include_counters else {}
+        if not spans and not gauges and not counts:
             return "(no spans recorded; enable tracing first)"
-        width = max(len(k) for k in snap)
-        lines = ["%-*s %8s %12s %12s" % (width, "span", "count",
-                                         "total_s", "mean_s")]
-        for name in sorted(snap, key=lambda k: -snap[k]["total_s"]):
-            s = snap[name]
-            lines.append("%-*s %8d %12.6f %12.6f"
-                         % (width, name, s["count"], s["total_s"], s["mean_s"]))
+        lines = []
+        if spans:
+            width = max(len(k) for k in spans)
+            lines.append("%-*s %8s %12s %12s" % (width, "span", "count",
+                                                 "total_s", "mean_s"))
+            for name in sorted(spans, key=lambda k: -spans[k]["total_s"]):
+                s = spans[name]
+                lines.append("%-*s %8d %12.6f %12.6f"
+                             % (width, name, s["count"], s["total_s"],
+                                s["mean_s"]))
+        else:
+            lines.append("(no spans recorded; enable tracing first)")
+        if gauges:
+            width = max(len(k) for k in gauges)
+            lines.append("")
+            lines.append("%-*s %8s %12s %12s %12s" % (width, "gauge",
+                                                      "count", "mean",
+                                                      "max", "last"))
+            for name in sorted(gauges):
+                g = gauges[name]
+                lines.append("%-*s %8d %12.4f %12.4f %12.4f"
+                             % (width, name, g["count"], g["mean"],
+                                g["max"], g["last"]))
+        if counts:
+            width = max(len(k) for k in counts)
+            lines.append("")
+            lines.append("%-*s %8s" % (width, "counter", "value"))
+            for name in sorted(counts):
+                lines.append("%-*s %8d" % (width, name, counts[name]))
         return "\n".join(lines)
 
 
@@ -131,6 +230,13 @@ class Counters:
 
 counters = Counters()
 
+
+def dump_stats(file=None) -> None:
+    """Print spans + gauges + counters in one report (the quick "what did
+    that run do" convenience; ``tft.dump_stats()``)."""
+    print(timings.report(include_counters=True), file=file)
+
+
 _enabled = os.environ.get("TFT_TRACE", "") not in ("", "0", "false")
 
 
@@ -148,6 +254,17 @@ def enabled() -> bool:
     return _enabled
 
 
+# the observability layer's per-query stage attribution: called as
+# (name, dt_seconds) at the end of every recorded span. One slot, set
+# once at import of tensorframes_tpu.observability.
+_span_observer: Optional[Callable[[str, float], None]] = None
+
+
+def set_span_observer(fn: Optional[Callable[[str, float], None]]) -> None:
+    global _span_observer
+    _span_observer = fn
+
+
 def _device_annotation(name: str):
     try:
         import jax.profiler
@@ -158,18 +275,39 @@ def _device_annotation(name: str):
 
 @contextlib.contextmanager
 def span(name: str) -> Iterator[None]:
-    """Time a named stage; no-op (two dict lookups) when tracing is off."""
+    """Time a named stage; no-op (two dict lookups) when tracing is off.
+
+    Monotonic-safe against the device annotation: a trace-annotation
+    context that fails on entry or exit (some backends raise once the
+    profiler session is torn down) can neither lose the host timing nor
+    mask the body's own exception — annotation failures are logged and
+    swallowed.
+    """
     if not _enabled:
         yield
         return
-    with _device_annotation(name):
-        t0 = time.perf_counter()
-        try:
-            yield
-        finally:
-            dt = time.perf_counter() - t0
-            timings.add(name, dt)
-            _log.trace("span %s: %.6fs", name, dt)
+    ann = _device_annotation(name)
+    try:
+        ann.__enter__()
+    except Exception as e:  # annotation is best-effort decoration
+        _log.debug("trace annotation enter failed for %s: %s", name, e)
+        ann = None
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        dt = time.perf_counter() - t0
+        timings.add(name, dt)
+        obs = _span_observer
+        if obs is not None:
+            obs(name, dt)
+        _log.trace("span %s: %.6fs", name, dt)
+        if ann is not None:
+            try:
+                ann.__exit__(None, None, None)
+            except Exception as e:
+                _log.debug("trace annotation exit failed for %s: %s",
+                           name, e)
 
 
 def gauge(name: str, value: float) -> None:
@@ -178,12 +316,13 @@ def gauge(name: str, value: float) -> None:
     Same zero-cost-when-off contract as :func:`span`, but for quantities
     that are levels rather than durations — e.g. the pipelined engine
     samples its in-flight window size into ``pipeline.occupancy`` at every
-    submit, so ``timings.snapshot()['pipeline.occupancy']['mean_s']`` reads
-    as the mean window occupancy (the ``_s`` suffix is vestigial for
-    gauges). No-op unless tracing is enabled.
+    submit, so ``timings.snapshot()['pipeline.occupancy']['mean']`` reads
+    as the mean window occupancy. Gauges keep their own stat family
+    (``mean``/``min``/``max``/``last``, no seconds suffix). No-op unless
+    tracing is enabled.
     """
     if _enabled:
-        timings.add(name, float(value))
+        timings.add_gauge(name, float(value))
 
 
 @contextlib.contextmanager
@@ -191,7 +330,10 @@ def profile(log_dir: str, host_spans: bool = True) -> Iterator[None]:
     """Capture a full XLA device trace to ``log_dir`` (TensorBoard format).
 
     Also enables host spans for the duration so the :data:`timings` registry
-    covers the same window.
+    covers the same window. A failing ``stop_trace`` (a torn-down or
+    double-stopped profiler session) is logged, never raised — it must not
+    mask an exception from the profiled body, nor fail a body that
+    succeeded.
     """
     import jax
 
@@ -204,5 +346,10 @@ def profile(log_dir: str, host_spans: bool = True) -> Iterator[None]:
     finally:
         if not was:
             disable()
-        jax.profiler.stop_trace()
-        _log.info("profile written to %s", log_dir)
+        try:
+            jax.profiler.stop_trace()
+        except Exception as e:
+            _log.error("jax.profiler.stop_trace() failed (trace in %s "
+                       "may be incomplete): %s", log_dir, e)
+        else:
+            _log.info("profile written to %s", log_dir)

@@ -44,3 +44,7 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "pipeline: pipelined block-execution suite "
                    "(run-tests.sh --pipeline runs this lane standalone)")
+    config.addinivalue_line(
+        "markers", "observability: query-trace/metrics/explain suite "
+                   "(run-tests.sh --observability runs this lane "
+                   "standalone)")

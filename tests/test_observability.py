@@ -1,0 +1,548 @@
+"""Query-scoped observability suite (tier-1; marker ``observability``).
+
+Proves the PR-3 contract end-to-end on CPU: query-id correlation across
+the pipeline (including worker threads), chrome-trace export validity,
+Prometheus text-format rendering + escaping, ring-buffer bounding, the
+explain()/counters consistency, the gauge stat-family fix, the merged
+stats report, profile()/span() exception safety — and that with tracing
+disabled the event layer records nothing at all.
+"""
+
+import json
+import re
+import threading
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+import tensorframes_tpu as tft
+from tensorframes_tpu import observability as obs
+from tensorframes_tpu.engine.executor import BlockExecutor
+from tensorframes_tpu.observability import events as obs_events
+from tensorframes_tpu.resilience import faults
+from tensorframes_tpu.utils import tracing
+
+pytestmark = pytest.mark.observability
+
+
+@pytest.fixture(autouse=True)
+def _clean_observability():
+    tracing.disable()
+    tracing.timings.reset()
+    tracing.counters.reset()
+    obs.clear_ring()
+    obs_events._reset_last_query()
+    yield
+    tracing.disable()
+    tracing.timings.reset()
+    tracing.counters.reset()
+    obs.clear_ring()
+    obs_events._reset_last_query()
+
+
+def _depth(monkeypatch, d):
+    monkeypatch.setenv("TFT_PIPELINE_DEPTH", str(d))
+
+
+def _traced_map(monkeypatch, n=30, parts=6, depth=3):
+    _depth(monkeypatch, depth)
+    tracing.enable()
+    df = tft.frame({"x": np.arange(float(n))}, num_partitions=parts)
+    out = df.map_blocks(lambda x: {"y": x + 1.0})
+    out.blocks()
+    return df, out, out._trace
+
+
+# ---------------------------------------------------------------------------
+# correlation / context propagation
+# ---------------------------------------------------------------------------
+
+class TestCorrelation:
+    def test_forcing_opens_query_trace(self, monkeypatch):
+        _, out, t = _traced_map(monkeypatch)
+        assert t is not None
+        assert t.op == "map_blocks"
+        assert re.fullmatch(r"q\d+", t.query_id)
+        assert t.duration is not None and t.duration >= 0
+
+    def test_query_ids_unique_per_query(self, monkeypatch):
+        _, _, t1 = _traced_map(monkeypatch)
+        _, _, t2 = _traced_map(monkeypatch)
+        assert t1.query_id != t2.query_id
+
+    def test_nested_forcings_join_outer_query(self, monkeypatch):
+        # a chained lazy plan forces upstream frames inside one query:
+        # exactly ONE trace, owned by the outermost forcing
+        _depth(monkeypatch, 3)
+        tracing.enable()
+        df = tft.frame({"x": np.arange(20.0)}, num_partitions=4)
+        mid = df.map_blocks(lambda x: {"y": x + 1.0})
+        top = mid.map_blocks(lambda y: {"z": y * 2.0})
+        top.blocks()
+        assert top._trace is not None
+        assert mid._trace is None  # joined the ambient query
+        assert obs.last_query() is top._trace
+
+    def test_query_id_survives_worker_threads(self):
+        tracing.enable()
+        seen = []
+        with obs.query_trace("threaded") as t:
+            with ThreadPoolExecutor(max_workers=2) as pool:
+                fs = [pool.submit(obs.wrap_context(obs.current_trace))
+                      for _ in range(4)]
+                seen = [f.result() for f in fs]
+            # an UNwrapped hop must not see the trace (that would mean
+            # thread-inherited globals, not context propagation)
+            with ThreadPoolExecutor(max_workers=1) as pool:
+                bare = pool.submit(obs.current_trace).result()
+        assert all(s is t for s in seen)
+        assert bare is None
+
+    def test_pipeline_worker_thread_events_attach_to_query(self,
+                                                           monkeypatch):
+        """An executor that dispatches on its own worker thread (the
+        native-PJRT submit pattern, via wrap_context) records events that
+        land on the submitting query's trace."""
+        _depth(monkeypatch, 3)
+        tracing.enable()
+        inner = BlockExecutor()
+        worker_qids = []
+
+        class ThreadedExecutor:
+            pad_rows = False
+
+            def __init__(self):
+                self._pool = ThreadPoolExecutor(max_workers=1)
+
+            @property
+            def compile_count(self):
+                return inner.compile_count
+
+            def run(self, comp, arrays, pad_ok=True):
+                return inner.run(comp, arrays, pad_ok=pad_ok)
+
+            def submit(self, comp, arrays, pad_ok=True):
+                def work():
+                    t = obs.current_trace()
+                    worker_qids.append(t.query_id if t else None)
+                    obs.add_event("worker_dispatch")
+                    return inner.run(comp, arrays, pad_ok=pad_ok)
+
+                fut = self._pool.submit(obs.wrap_context(work))
+
+                class P:
+                    def drain(self):
+                        return fut.result()
+
+                return P()
+
+            def clear(self):
+                inner.clear()
+
+        df = tft.frame({"x": np.arange(24.0)}, num_partitions=6)
+        out = df.map_blocks(lambda x: {"y": x - 1.0},
+                            executor=ThreadedExecutor())
+        got = np.asarray([r["y"] for r in out.collect()], float).ravel()
+        np.testing.assert_array_equal(got, np.arange(24.0) - 1.0)
+        t = out._trace
+        assert t is not None
+        assert worker_qids == [t.query_id] * 6
+        assert t.count("worker_dispatch") == 6
+
+    def test_eager_reduce_records_last_query(self, monkeypatch):
+        _depth(monkeypatch, 3)
+        tracing.enable()
+        df = tft.frame({"x": np.arange(12.0)}, num_partitions=3)
+        val = tft.reduce_blocks(lambda x_input: {"x": x_input.sum(0)}, df)
+        assert float(val) == float(np.arange(12.0).sum())
+        t = obs.last_query()
+        assert t is not None and t.op == "reduce_blocks"
+        assert "reduce_blocks" in tft.last_query_report()
+
+
+# ---------------------------------------------------------------------------
+# chrome trace export
+# ---------------------------------------------------------------------------
+
+class TestChromeTrace:
+    def test_chrome_trace_valid_and_sorted(self, monkeypatch, tmp_path):
+        _, out, t = _traced_map(monkeypatch, n=30, parts=6, depth=3)
+        path = tmp_path / "trace.json"
+        text = t.to_chrome_trace(file=str(path))
+        doc = json.loads(text)
+        assert json.loads(path.read_text()) == doc
+        evs = doc["traceEvents"]
+        assert evs, "no events exported"
+        for e in evs:
+            for field in ("ph", "ts", "pid", "tid"):
+                assert field in e, (field, e)
+        ts = [e["ts"] for e in evs]
+        assert ts == sorted(ts)
+
+    def test_per_block_events_on_slot_tracks_share_query_id(
+            self, monkeypatch):
+        _, out, t = _traced_map(monkeypatch, n=30, parts=6, depth=3)
+        doc = json.loads(t.to_chrome_trace())
+        evs = [e for e in doc["traceEvents"] if e["ph"] != "M"]
+        assert {e["args"]["query_id"] for e in evs} == {t.query_id}
+        by_cat = {}
+        for e in evs:
+            by_cat.setdefault(e.get("cat"), []).append(e)
+        assert len(by_cat["block_submit"]) == 6
+        assert len(by_cat["block_compute"]) == 6
+        assert len(by_cat["block_drain"]) == 6
+        # per-slot tracks: depth 3 -> tids 1..3, plus the query track 0
+        block_tids = {e["tid"] for cat in ("block_submit", "block_drain")
+                      for e in by_cat[cat]}
+        assert block_tids == {1, 2, 3}
+        # slot thread names exported for perfetto
+        names = {e["args"]["name"] for e in doc["traceEvents"]
+                 if e["ph"] == "M" and e["name"] == "thread_name"}
+        assert {"query", "slot 0", "slot 1", "slot 2"} <= names
+
+    def test_serial_depth_records_block_runs(self, monkeypatch):
+        _, out, t = _traced_map(monkeypatch, n=12, parts=3, depth=1)
+        assert t.count("block_run") == 3
+        s = t.summary()
+        assert s["blocks"] == 3 and s["rows_in"] == 12
+
+
+# ---------------------------------------------------------------------------
+# explain() / summary vs counters
+# ---------------------------------------------------------------------------
+
+class TestExplain:
+    def test_explain_counts_match_counters(self, monkeypatch):
+        base = tracing.counters.snapshot()
+        _, out, t = _traced_map(monkeypatch, n=30, parts=6, depth=3)
+        s = t.summary()
+        now = tracing.counters.snapshot()
+
+        def delta(name):
+            return now.get(name, 0) - base.get(name, 0)
+
+        assert s["blocks"] == delta("pipeline.submitted") == 6
+        assert s["rows_in"] == 30 and s["rows_out"] == 30
+        assert s["bytes_in"] == 30 * np.dtype(float).itemsize
+        assert s["sync_fallbacks"] == delta("pipeline.sync_fallbacks") == 0
+        assert s["compile_misses"] == delta("compile_cache.misses")
+        assert s["compile_hits"] == delta("compile_cache.hits")
+        report = out.explain()
+        assert "30 in / 30 out" in report
+        assert "6 block(s)" in report
+        assert t.query_id in report
+        assert "wall time by stage" in report
+
+    def test_explain_reports_sync_fallbacks(self, monkeypatch):
+        _depth(monkeypatch, 3)
+        tracing.enable()
+        base = tracing.counters.snapshot()
+        df = tft.frame({"x": np.arange(20.0)}, num_partitions=4)
+        out = df.map_blocks(lambda x: {"y": x + 1.0})
+        # two async submit faults -> two blocks recover through the sync
+        # fallback path; the trace must agree with the global counters
+        with faults.inject("dispatch", fail_n=2):
+            out.blocks()
+        got = np.asarray([r["y"] for r in out.collect()], float).ravel()
+        np.testing.assert_array_equal(got, np.arange(20.0) + 1.0)
+        t = out._trace
+        s = t.summary()
+        now = tracing.counters.snapshot()
+
+        def delta(name):
+            return now.get(name, 0) - base.get(name, 0)
+
+        assert s["sync_fallbacks"] == delta("pipeline.sync_fallbacks") == 2
+        fb = [e for e in t.events if e.etype == "sync_fallback"]
+        assert [e.args["error"] for e in fb] == ["InjectedFault"] * 2
+        assert "2 sync fallback(s)" in out.explain()
+
+    def test_explain_reports_retries_with_classified_error(
+            self, monkeypatch):
+        _depth(monkeypatch, 1)  # serial path: the fault hits the retry
+        monkeypatch.setenv("TFT_RETRY_BASE_DELAY", "0.001")
+        tracing.enable()
+        base = tracing.counters.snapshot()
+        df = tft.frame({"x": np.arange(12.0)}, num_partitions=3)
+        out = df.map_blocks(lambda x: {"y": x + 1.0})
+        with faults.inject("dispatch", fail_n=1):
+            out.blocks()
+        t = out._trace
+        s = t.summary()
+        now = tracing.counters.snapshot()
+        delta = (now.get("retry.executor.dispatch.retries", 0)
+                 - base.get("retry.executor.dispatch.retries", 0))
+        assert s["retries"] == delta == 1
+        retry = [e for e in t.events if e.etype == "retry"][0]
+        assert retry.args["error"] == "InjectedFault"
+        assert retry.args["kind"] == "transient"
+        assert "1 retried" in out.explain()
+
+    def test_explain_forces_untraced_frame(self, monkeypatch):
+        _depth(monkeypatch, 3)
+        df = tft.frame({"x": np.arange(10.0)}, num_partitions=2)
+        out = df.map_blocks(lambda x: {"y": x * 3.0})
+        out.blocks()  # forced with tracing OFF: no trace recorded
+        assert out._trace is None
+        report = out.explain()  # re-forces once, tracing temporarily on
+        assert out._trace is not None
+        assert "map_blocks" in report
+        assert not tracing.enabled()  # restored
+
+    def test_last_query_report_without_queries(self):
+        assert "no query recorded" in tft.last_query_report()
+
+
+# ---------------------------------------------------------------------------
+# sinks: ring buffer + JSONL file
+# ---------------------------------------------------------------------------
+
+class TestSinks:
+    def test_ring_buffer_bounded_under_10k_events(self, monkeypatch):
+        monkeypatch.setenv("TFT_TRACE_RING", "1000")
+        obs.clear_ring()
+        tracing.enable()
+        with obs.query_trace("flood") as t:
+            for i in range(10_500):
+                t.add("tick", i=i)
+        ring = obs.recent_events()
+        assert len(ring) == 1000  # bounded, newest kept
+        assert ring[-1]["i"] == 10_499
+        assert t.dropped == 0  # per-trace bound is separate
+
+    def test_per_trace_event_bound_drops_and_counts(self):
+        tracing.enable()
+        with obs.query_trace("flood") as t:
+            t._max_events = 10
+            for i in range(25):
+                t.add("tick", i=i)
+        assert len(t.events) == 10
+        assert t.dropped == 15
+        assert tracing.counters.get("trace.events_dropped") == 15
+        assert "+15 dropped" in t.report()
+
+    def test_jsonl_file_sink(self, monkeypatch, tmp_path):
+        path = tmp_path / "events.jsonl"
+        monkeypatch.setenv("TFT_TRACE_FILE", str(path))
+        _, out, t = _traced_map(monkeypatch, n=12, parts=3, depth=3)
+        lines = [json.loads(line) for line in
+                 path.read_text().splitlines()]
+        heads = [r for r in lines if r["type"] == "query"]
+        assert any(h["query_id"] == t.query_id for h in heads)
+        evs = [r for r in lines if r.get("query_id") == t.query_id
+               and r["type"] != "query"]
+        assert len(evs) == len(t.events)
+        assert all("ts" in e for e in evs)
+
+
+# ---------------------------------------------------------------------------
+# Prometheus metrics
+# ---------------------------------------------------------------------------
+
+_PROM_LINE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[a-zA-Z_][a-zA-Z0-9_]*='
+    r'"(?:[^"\\\n]|\\["\\n])*"(,[a-zA-Z_][a-zA-Z0-9_]*='
+    r'"(?:[^"\\\n]|\\["\\n])*")*\})? '
+    r'(-?\d+(\.\d+)?([eE][+-]?\d+)?|[+-]Inf|NaN)$')
+
+
+class TestMetrics:
+    def test_metrics_text_parses_as_prometheus(self, monkeypatch):
+        _traced_map(monkeypatch)
+        text = obs.metrics_text()
+        assert text.endswith("\n")
+        for line in text.splitlines():
+            if not line or line.startswith("#"):
+                continue
+            assert _PROM_LINE.match(line), line
+        assert 'tft_counter_total{name="pipeline.submitted"} 6' \
+            in text
+        assert 'tft_span_seconds_count{span="pipeline.submit"} 6' in text
+        assert 'tft_gauge{name="pipeline.occupancy",stat="mean"}' in text
+        assert "tft_trace_ring_events" in text
+
+    def test_label_escaping(self):
+        tracing.counters.inc('weird"name\\with\nnasties')
+        text = obs.metrics_text()
+        line = next(ln for ln in text.splitlines()
+                    if "weird" in ln)
+        assert _PROM_LINE.match(line), line
+        assert '\\"' in line and "\\\\" in line and "\\n" in line
+        assert "\n" not in line  # the raw newline never leaks through
+
+    def test_endpoint_serves_metrics_on_loopback(self):
+        tracing.counters.inc("endpoint.smoke")
+        port = obs.serve_metrics(0)
+        try:
+            assert obs.metrics_port() == port
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+                assert r.status == 200
+                assert "text/plain" in r.headers["Content-Type"]
+                body = r.read().decode()
+            assert 'tft_counter_total{name="endpoint.smoke"} 1' in body
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/nope", timeout=10)
+        finally:
+            obs.stop_metrics()
+        assert obs.metrics_port() is None
+
+    def test_rebind_to_different_port_raises(self):
+        port = obs.serve_metrics(0)
+        try:
+            assert obs.serve_metrics(0) == port  # idempotent
+            assert obs.serve_metrics(port) == port
+            with pytest.raises(RuntimeError, match="already running"):
+                obs.serve_metrics(port + 1)  # silently dead scrape target
+        finally:
+            obs.stop_metrics()
+
+
+# ---------------------------------------------------------------------------
+# zero-cost-when-off
+# ---------------------------------------------------------------------------
+
+class TestZeroCostWhenOff:
+    def test_no_events_recorded_with_tracing_disabled(self, monkeypatch):
+        _depth(monkeypatch, 3)
+        assert not tracing.enabled()
+        df = tft.frame({"x": np.arange(20.0)}, num_partitions=4)
+        out = df.map_blocks(lambda x: {"y": x + 1.0})
+        out.blocks()
+        tft.reduce_blocks(lambda x_input: {"x": x_input.sum(0)}, df)
+        assert out._trace is None
+        assert obs.last_query() is None
+        assert obs.recent_events() == []
+        assert obs.current_trace() is None
+        assert tracing.timings.snapshot() == {}
+
+    def test_add_event_without_trace_is_noop(self):
+        obs.add_event("orphan", detail="nothing listens")
+        assert obs.recent_events() == []
+
+    def test_bypass_strips_layer_even_when_enabled(self):
+        tracing.enable()
+        with obs_events.bypass():
+            with obs.query_trace("stripped") as t:
+                assert t is None
+        assert obs.last_query() is None
+
+
+# ---------------------------------------------------------------------------
+# satellite: gauge stat family + merged report + dump_stats
+# ---------------------------------------------------------------------------
+
+class TestStatsSatellites:
+    def test_gauge_has_own_stat_family(self):
+        tracing.enable()
+        for v in (1.0, 3.0, 2.0):
+            tracing.gauge("my.level", v)
+        snap = tracing.timings.snapshot()
+        g = snap["my.level"]
+        assert g == {"count": 3, "mean": 2.0, "min": 1.0, "max": 3.0,
+                     "last": 2.0}
+        assert "mean_s" not in g  # no vestigial seconds suffix
+
+    def test_occupancy_legacy_alias_kept_one_release(self):
+        tracing.enable()
+        tracing.gauge("pipeline.occupancy", 2.0)
+        tracing.gauge("pipeline.occupancy", 4.0)
+        occ = tracing.timings.snapshot()["pipeline.occupancy"]
+        assert occ["mean"] == 3.0 and occ["last"] == 4.0
+        # deprecated aliases (pre-0.2 key names) still readable
+        assert occ["mean_s"] == occ["mean"]
+        assert occ["max_s"] == occ["max"]
+
+    def test_report_merges_counters_and_gauges(self):
+        tracing.enable()
+        with tracing.span("stagey"):
+            pass
+        tracing.gauge("leveley", 5.0)
+        tracing.counters.inc("county.things", 3)
+        rep = tracing.timings.report()
+        assert "stagey" in rep
+        assert "leveley" in rep
+        assert "county.things" in rep
+        assert "gauge" in rep and "counter" in rep
+
+    def test_dump_stats_prints_everything(self, capsys):
+        tracing.enable()
+        with tracing.span("dumped.span"):
+            pass
+        tracing.gauge("dumped.gauge", 1.0)
+        tracing.counters.inc("dumped.counter")
+        tft.dump_stats()
+        out = capsys.readouterr().out
+        for name in ("dumped.span", "dumped.gauge", "dumped.counter"):
+            assert name in out
+
+
+# ---------------------------------------------------------------------------
+# satellite: profile()/span() exception safety
+# ---------------------------------------------------------------------------
+
+class TestTracingExceptionSafety:
+    def test_profile_stop_failure_does_not_mask_body_error(
+            self, monkeypatch, tmp_path):
+        import jax
+
+        # fake session: a real one left open by the raising stop_trace
+        # would wedge every later jax.profiler user in the process
+        monkeypatch.setattr(jax.profiler, "start_trace",
+                            lambda log_dir, **k: None)
+        monkeypatch.setattr(jax.profiler, "stop_trace",
+                            _raise_runtime_error)
+        with pytest.raises(ValueError, match="body failed"):
+            with tracing.profile(str(tmp_path)):
+                raise ValueError("body failed")
+        assert not tracing.enabled()
+
+    def test_profile_stop_failure_does_not_fail_successful_body(
+            self, monkeypatch, tmp_path):
+        import jax
+
+        monkeypatch.setattr(jax.profiler, "start_trace",
+                            lambda log_dir, **k: None)
+        monkeypatch.setattr(jax.profiler, "stop_trace",
+                            _raise_runtime_error)
+        with tracing.profile(str(tmp_path)):
+            pass  # succeeded; the failing stop must be swallowed+logged
+        assert not tracing.enabled()
+
+    def test_span_survives_annotation_exit_failure(self, monkeypatch):
+        class EvilAnnotation:
+            def __enter__(self):
+                return self
+
+            def __exit__(self, *exc):
+                raise RuntimeError("annotation teardown exploded")
+
+        monkeypatch.setattr(tracing, "_device_annotation",
+                            lambda name: EvilAnnotation())
+        tracing.enable()
+        with tracing.span("guarded"):
+            pass  # must not raise
+        snap = tracing.timings.snapshot()
+        assert snap["guarded"]["count"] == 1  # timing still recorded
+
+    def test_span_survives_annotation_enter_failure(self, monkeypatch):
+        class Unenterable:
+            def __enter__(self):
+                raise RuntimeError("no profiler session")
+
+            def __exit__(self, *exc):
+                raise AssertionError("never entered, never exited")
+
+        monkeypatch.setattr(tracing, "_device_annotation",
+                            lambda name: Unenterable())
+        tracing.enable()
+        with tracing.span("guarded2"):
+            pass
+        assert tracing.timings.snapshot()["guarded2"]["count"] == 1
+
+
+def _raise_runtime_error(*a, **k):
+    raise RuntimeError("profiler session already gone")

@@ -1,11 +1,19 @@
 #!/usr/bin/env python
-"""Static check: no bare ``except:`` clauses under tensorframes_tpu/.
+"""Static checks on exception handling under tensorframes_tpu/.
 
-A bare except swallows ``BaseException`` — including KeyboardInterrupt,
-DeadlineExceeded, and injected faults — which blinds the resilience
-layer's transient/oom/permanent classifier. ``except Exception`` (or a
-narrower type) is always available instead. AST-based, so strings and
-comments never false-positive.
+1. No bare ``except:`` anywhere: a bare except swallows ``BaseException``
+   — including KeyboardInterrupt, DeadlineExceeded, and injected faults —
+   which blinds the resilience layer's transient/oom/permanent
+   classifier. ``except Exception`` (or a narrower type) is always
+   available instead.
+
+2. No ``except Exception: pass`` under ``tensorframes_tpu/observability/``:
+   the observability layer is the last place a failure may vanish
+   silently — an event sink or metrics endpoint that swallows an error
+   without at least logging it hides exactly the evidence it exists to
+   surface. Handle it or log it (``_log.debug`` is enough).
+
+AST-based, so strings and comments never false-positive.
 """
 
 import ast
@@ -13,6 +21,25 @@ import pathlib
 import sys
 
 ROOT = pathlib.Path(__file__).resolve().parent.parent / "tensorframes_tpu"
+OBS_ROOT = ROOT / "observability"
+
+
+def _is_exception_name(node) -> bool:
+    return isinstance(node, ast.Name) and node.id == "Exception"
+
+
+def _swallows_silently(handler: ast.ExceptHandler) -> bool:
+    """``except Exception: pass`` (or ``...``): no logging, no re-raise,
+    no handling — the silent-swallow shape."""
+    if not _is_exception_name(handler.type):
+        return False
+    if len(handler.body) != 1:
+        return False
+    stmt = handler.body[0]
+    return isinstance(stmt, ast.Pass) or (
+        isinstance(stmt, ast.Expr)
+        and isinstance(stmt.value, ast.Constant)
+        and stmt.value.value is Ellipsis)
 
 
 def main() -> int:
@@ -23,12 +50,20 @@ def main() -> int:
         except SyntaxError as e:
             bad.append(f"{path}:{e.lineno}: syntax error: {e.msg}")
             continue
+        in_obs = OBS_ROOT in path.parents
         for node in ast.walk(tree):
-            if isinstance(node, ast.ExceptHandler) and node.type is None:
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if node.type is None:
                 bad.append(
                     f"{path}:{node.lineno}: bare 'except:' — catch "
                     f"'Exception' (or narrower) so the resilience "
                     f"classifier can see what failed")
+            elif in_obs and _swallows_silently(node):
+                bad.append(
+                    f"{path}:{node.lineno}: 'except Exception: pass' — "
+                    f"the observability layer must not swallow errors "
+                    f"silently; log the failure (or catch narrower)")
     for line in bad:
         print(line, file=sys.stderr)
     if bad:
