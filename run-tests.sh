@@ -26,7 +26,9 @@ if [ "${1:-}" = "--pipeline" ]; then
 fi
 
 # --observability: run only the query-trace/metrics/explain lane
-# (tests/test_observability.py) — fast, CPU-only, no native build needed
+# (tests/test_observability.py, incl. the mesh/device half: per-device
+# tracks, HBM watermarks, skew reports, histograms, slow-query log) —
+# fast, CPU-only (8 virtual devices via conftest), no native build needed
 if [ "${1:-}" = "--observability" ]; then
   shift
   echo "== observability lane (pytest -m observability, CPU) =="

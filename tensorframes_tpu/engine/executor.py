@@ -16,6 +16,7 @@ reductions and trim never pad).
 from __future__ import annotations
 
 import threading
+import time
 import weakref
 from typing import Dict, Mapping, Optional, Tuple
 
@@ -26,10 +27,12 @@ from .. import dtypes as _dt
 from .. import native as _native
 from ..computation import Computation
 from ..observability.events import add_event as _obs_event
+from ..observability.events import current_trace as _obs_current_trace
 from ..resilience import (default_policy, env_bool, faults, is_oom,
                           is_permanent)
 from ..utils.logging import get_logger
-from ..utils.tracing import counters, enabled as _tracing_enabled, span
+from ..utils.tracing import (counters, enabled as _tracing_enabled,
+                             histograms, span)
 
 __all__ = ["BlockExecutor", "PaddingExecutor", "PendingBlock",
            "default_executor", "default_padding_executor"]
@@ -101,7 +104,24 @@ def _oom_split_run(executor, comp: Computation, arrays: Mapping,
                    for s in comp.outputs)):
         raise cause
     counters.inc("oom_split.dispatches")
-    _obs_event("oom_split", rows=n_rows, error=type(cause).__name__)
+    # OOM forensics: tag the split with the HBM watermark observed at
+    # the moment it fired (backends without memory_stats contribute
+    # nothing; gated on an active trace so the untraced path never
+    # calls memory_stats)
+    hbm: Dict = {}
+    if _obs_current_trace() is not None:
+        try:
+            from ..observability import device as _obs_device
+            wm = _obs_device.watermark()
+            if wm is not None:
+                hbm = {"hbm_live_bytes": wm["live_bytes"],
+                       "hbm_peak_bytes": wm["peak_bytes"]}
+        except Exception as e:
+            # best-effort forensics, but never silently: a regression in
+            # the sampler must not make watermark tags vanish unnoticed
+            _log.debug("OOM watermark sample failed: %s", e)
+    _obs_event("oom_split", rows=n_rows, error=type(cause).__name__,
+               **hbm)
     _log.warning(
         "block dispatch hit an OOM-shaped failure (%s); re-dispatching "
         "as two %d/%d-row halves", cause, n_rows // 2,
@@ -129,6 +149,20 @@ def _next_bucket(n: int, minimum: int = 8) -> int:
     while b < n:
         b *= 2
     return b
+
+
+def _timed_first_dispatch(fn, dev_arrays):
+    """First dispatch of a freshly-jitted signature: jax traces and
+    XLA-compiles synchronously inside this call (only execution is
+    async), so its duration IS the compile time. Feeds the always-on
+    ``compile_seconds`` histogram and, when a query trace listens, a
+    ``compile`` event."""
+    t0 = time.perf_counter()
+    out = fn(dev_arrays)
+    dt = time.perf_counter() - t0
+    histograms.observe("compile_seconds", dt, engine="jax")
+    _obs_event("compile", name="jax", dur=dt, engine="jax")
+    return out
 
 
 def _row_count(comp: Computation, arrays: Mapping) -> Optional[int]:
@@ -267,6 +301,11 @@ class BlockExecutor:
 
     def _compiled(self, comp: Computation, sig: Tuple,
                   donate: bool = False):
+        """Returns ``(fn, fresh)`` — ``fresh`` is True when THIS call
+        created the jitted wrapper (a compile-cache miss): the caller
+        times the first dispatch and attributes it as compile time
+        (jax compiles lazily at first call, so the wrapper's creation
+        itself costs nothing)."""
         # Double-checked locking: the lock-free fast path is safe under
         # the GIL (a dict read racing a dict write sees either the old or
         # the new table, never a torn one); EVERY mutation of the
@@ -276,6 +315,7 @@ class BlockExecutor:
         # (tests/test_resilience.py::TestConcurrentDispatch).
         if donate:
             sig = ("donate",) + sig
+        fresh = False
         per_comp = self._cache.get(comp)
         fn = None if per_comp is None else per_comp.get(sig)
         if fn is None:
@@ -286,6 +326,7 @@ class BlockExecutor:
                     fn = jax.jit(comp.fn, donate_argnums=0) if donate \
                         else jax.jit(comp.fn)
                     per_comp[sig] = fn
+                    fresh = True
                     self.compile_count += 1
                     counters.inc("compile_cache.misses")
                     _obs_event("compile_cache", hit=False)
@@ -302,7 +343,7 @@ class BlockExecutor:
             # they stay always-on)
             counters.inc("compile_cache.hits")
             _obs_event("compile_cache", hit=True)
-        return fn
+        return fn, fresh
 
     def _donate_padded(self) -> bool:
         # donation only ever applies to the padded staging path, whose
@@ -326,11 +367,14 @@ class BlockExecutor:
 
         def attempt():
             faults.check("compile")
-            fn = self._compiled(comp, sig, donate=donate)
+            fn, fresh = self._compiled(comp, sig, donate=donate)
             faults.check("dispatch")
             faults.check("oom")
             with span("executor.dispatch"):
-                out = fn(dev_arrays)
+                if fresh:
+                    out = _timed_first_dispatch(fn, dev_arrays)
+                else:
+                    out = fn(dev_arrays)
                 # JAX dispatch is async: an execution failure would
                 # otherwise surface at convert_back's np.asarray, OUTSIDE
                 # this retry and the OOM-split handlers (it also keeps
@@ -452,12 +496,15 @@ class BlockExecutor:
                 dev_arrays = _pad_inputs(comp, dev_arrays, pad_to, n_rows)
                 donate = self._donate_padded()
             faults.check("compile")
-            fn = self._compiled(comp, self._sig(comp, dev_arrays),
-                                donate=donate)
+            fn, fresh = self._compiled(comp, self._sig(comp, dev_arrays),
+                                       donate=donate)
             faults.check("dispatch")
             faults.check("oom")
             with span("executor.dispatch_async"):
-                out = fn(dev_arrays)
+                # a fresh signature compiles synchronously inside this
+                # call even on the async path — worth attributing
+                out = (_timed_first_dispatch(fn, dev_arrays) if fresh
+                       else fn(dev_arrays))
             return PendingBlock(self, comp, arrays, pad_ok, out=out,
                                 pad_to=pad_to, n_rows=n_rows)
         except Exception as e:

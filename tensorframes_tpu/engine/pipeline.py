@@ -47,6 +47,7 @@ from __future__ import annotations
 from collections import deque
 from typing import Callable, List, Optional, Sequence, TypeVar
 
+from ..observability import device as _obs_device
 from ..observability import events as _obs
 from ..resilience import env_int
 from ..utils.logging import get_logger
@@ -168,6 +169,9 @@ def run_pipelined(blocks: Sequence[B],
             trace.add("block_drain", name=f"drain b{i}", ts=t0,
                       dur=trace.clock() - t0, track=slot, block=i,
                       rows_out=rows_out)
+            # HBM watermark around the drain (latched no-op on backends
+            # without memory_stats, e.g. CPU)
+            _obs_device.sample(trace, "block_drain")
 
     for i, b in enumerate(blocks):
         t0 = 0.0

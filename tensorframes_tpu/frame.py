@@ -604,7 +604,10 @@ class TensorFrame:
     def explain(self) -> str:
         """Human-readable execution report of this frame's forcing: rows,
         blocks, bytes marshalled, retries, OOM splits, sync fallbacks,
-        compile-cache behavior, and wall time by stage
+        compile-cache behavior (with compile seconds), wall time by
+        stage, and — when the forcing touched the mesh layer — a mesh
+        section with per-device rows/bytes/time, a straggler ratio, and
+        HBM watermarks where the backend reports memory stats
         (``docs/observability.md``).
 
         Renders the trace recorded when the frame was forced with tracing

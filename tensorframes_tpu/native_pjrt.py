@@ -21,6 +21,7 @@ from __future__ import annotations
 import ctypes
 import os
 import threading
+import time
 from typing import Dict, Mapping, Optional, Tuple
 
 import numpy as np
@@ -31,6 +32,7 @@ from .observability import events as _obs
 from .utils.logging import get_logger
 from .utils.tracing import counters as _counters
 from .utils.tracing import enabled as _tracing_enabled
+from .utils.tracing import histograms as _histograms
 
 __all__ = ["available", "PjrtCoreClient", "PjrtBlockExecutor",
            "PjrtDeviceBuffer"]
@@ -698,6 +700,7 @@ class PjrtBlockExecutor:
                     _counters.inc("compile_cache.hits")
                     _obs.add_event("compile_cache", hit=True, native=True)
                 return exe
+            t_c = time.perf_counter()  # native compiles are synchronous
             dyn = getattr(comp, "_native_dynamic", None)
             if dyn:
                 exe = self.client.compile_dynamic(
@@ -710,10 +713,14 @@ class PjrtBlockExecutor:
                                        [s.name for s in comp.outputs])
                 exe = (self.client.compile_replicated(hlo, n_replicas)
                        if n_replicas > 1 else self.client.compile(hlo))
+            dt = time.perf_counter() - t_c
             per_comp[sig] = exe
             self.compile_count += 1
             _counters.inc("compile_cache.misses")
+            _histograms.observe("compile_seconds", dt, engine="native")
             _obs.add_event("compile_cache", hit=False, native=True)
+            _obs.add_event("compile", name="native", dur=dt,
+                           engine="native")
             _log.debug("native compile #%d for %s", self.compile_count,
                        sig)
             return exe

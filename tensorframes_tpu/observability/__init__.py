@@ -17,11 +17,19 @@ package adds the query dimension on top of the existing primitives
   Finished traces land in a bounded ring buffer and an optional JSONL
   sink (``TFT_TRACE_FILE``); :meth:`QueryTrace.to_chrome_trace` exports
   a Perfetto/chrome://tracing timeline with one track per pipeline slot.
+- :mod:`.device` — HBM watermark sampling (``Device.memory_stats()``
+  where the backend supports it, graceful ``None`` fallback on CPU) at
+  query start/end and around block drains; OOM splits carry the
+  observed watermark.
 - :mod:`.metrics` — Prometheus text-format export
-  (:func:`metrics_text`) and an opt-in loopback HTTP endpoint
-  (:func:`serve_metrics`, ``TFT_METRICS_PORT``; binds 127.0.0.1 only).
+  (:func:`metrics_text`), including proper histogram families
+  (``tft_query_latency_seconds``, ``tft_compile_seconds``), and an
+  opt-in loopback HTTP endpoint (:func:`serve_metrics`,
+  ``TFT_METRICS_PORT``; binds 127.0.0.1 only).
 - :mod:`.report` — ``frame.explain()`` / :func:`last_query_report`:
-  the human-readable per-stage breakdown.
+  the human-readable per-stage breakdown, plus a mesh section
+  (per-device rows/bytes/time, straggler ratio, imbalance warning)
+  for queries that touched the distributed layer.
 
 Everything is zero-cost-when-off: with tracing disabled
 (``TFT_TRACE`` unset), :func:`query_trace` yields ``None`` and every
@@ -34,16 +42,18 @@ import os
 
 from ..utils import tracing as _tracing
 from ..utils.logging import get_logger
-from .events import (Event, QueryTrace, add_event, block_meta, bypass,
-                     clear_ring, current_trace, last_query, query_trace,
-                     recent_events, traced_query, wrap_context)
+from .events import (DEVICE_TRACK_BASE, Event, QueryTrace, add_event,
+                     block_meta, bypass, clear_ring, current_trace,
+                     last_query, query_trace, recent_events, traced_query,
+                     wrap_context)
+from . import device
 from .metrics import metrics_port, metrics_text, serve_metrics, stop_metrics
 from .report import frame_report, last_query_report, render
 
 __all__ = [
     "Event", "QueryTrace", "query_trace", "current_trace", "add_event",
     "wrap_context", "traced_query", "last_query", "recent_events",
-    "clear_ring", "block_meta", "bypass",
+    "clear_ring", "block_meta", "bypass", "DEVICE_TRACK_BASE", "device",
     "metrics_text", "serve_metrics", "stop_metrics", "metrics_port",
     "frame_report", "last_query_report", "render",
 ]

@@ -1,0 +1,127 @@
+"""Device-memory accounting: HBM watermarks attached to query traces.
+
+The backend's allocator statistics (``Device.memory_stats()`` — populated
+by the TPU/GPU PJRT clients, typically ``None`` on CPU) are sampled at
+query start/end and around block drains, so a finished
+:class:`~.events.QueryTrace` carries the live/peak HBM bytes the query
+actually saw — and an OOM split (``engine/executor.py``) is tagged with
+the watermark observed at the moment it fired, turning OOM forensics from
+guesswork into data.
+
+Zero-cost-when-off: every entry point is called only with an ACTIVE query
+trace (``TFT_TRACE`` set), so with tracing off no ``memory_stats()`` call
+ever happens. On backends that report nothing (CPU), the first all-``None``
+sample latches the module off for the process — traced CPU runs pay one
+probe, not one per sample (:func:`_reset` re-arms, for tests).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..utils.logging import get_logger
+
+__all__ = ["raw_memory_stats", "sample", "watermark", "supported"]
+
+_log = get_logger("observability.device")
+
+_lock = threading.Lock()
+_unsupported = False  # latched after the first all-None sample
+
+
+def _local_devices() -> List[Any]:
+    """Indirection over ``jax.local_devices()`` (patchable in tests; jax
+    imported lazily so this module never forces backend init on import)."""
+    import jax
+
+    return jax.local_devices()
+
+
+def _reset() -> None:
+    """Re-arm the unsupported latch (tests patch ``_local_devices``)."""
+    global _unsupported
+    with _lock:
+        _unsupported = False
+
+
+def raw_memory_stats() -> Optional[List[Tuple[int, Dict[str, Any]]]]:
+    """``[(device_index, stats_dict), ...]`` for every local device that
+    reports allocator statistics, or ``None`` when the backend supports
+    none (CPU) — in which case the module latches off until :func:`_reset`.
+    """
+    global _unsupported
+    with _lock:
+        if _unsupported:
+            return None
+    try:
+        devices = _local_devices()
+    except Exception as e:  # backend init failure must never kill a query
+        _log.debug("local_devices() failed during memory sample: %s", e)
+        return None
+    out: List[Tuple[int, Dict[str, Any]]] = []
+    for i, d in enumerate(devices):
+        try:
+            ms = d.memory_stats()
+        except Exception:
+            ms = None
+        if ms:
+            out.append((i, ms))
+    if not out:
+        with _lock:
+            _unsupported = True
+        return None
+    return out
+
+
+def watermark() -> Optional[Dict[str, int]]:
+    """Aggregate ``{"live_bytes", "peak_bytes", "devices"}`` across local
+    devices, or ``None`` when the backend reports nothing."""
+    stats = raw_memory_stats()
+    if stats is None:
+        return None
+    live = peak = 0
+    for _, ms in stats:
+        live += int(ms.get("bytes_in_use") or 0)
+        peak += int(ms.get("peak_bytes_in_use") or ms.get("bytes_in_use")
+                    or 0)
+    return {"live_bytes": live, "peak_bytes": peak, "devices": len(stats)}
+
+
+def sample(trace, tag: str, per_device: bool = False
+           ) -> Optional[Dict[str, int]]:
+    """Record one ``hbm_sample`` event on ``trace`` (aggregate across
+    devices; ``per_device=True`` additionally puts one event per device on
+    its device track). Returns the aggregate watermark, or ``None`` when
+    the backend reports no memory stats — the graceful CPU fallback.
+    """
+    if trace is None:
+        return None
+    stats = raw_memory_stats()
+    if stats is None:
+        return None
+    from .events import DEVICE_TRACK_BASE
+
+    live = peak = 0
+    for i, ms in stats:
+        d_live = int(ms.get("bytes_in_use") or 0)
+        d_peak = int(ms.get("peak_bytes_in_use") or d_live)
+        live += d_live
+        peak += d_peak
+        if per_device:
+            trace.add("hbm_sample", name=tag, tag=tag, device=i,
+                      live_bytes=d_live, peak_bytes=d_peak,
+                      track=DEVICE_TRACK_BASE + i)
+    trace.add("hbm_sample", name=tag, tag=tag, live_bytes=live,
+              peak_bytes=peak, devices=len(stats))
+    return {"live_bytes": live, "peak_bytes": peak, "devices": len(stats)}
+
+
+def supported() -> bool:
+    """Whether memory-stats sampling is still armed. Reflects only the
+    LAST probe: True until a probe has latched the module off (so it is
+    optimistically True before any probe, even on a backend that will
+    turn out to report nothing — :func:`raw_memory_stats` is the actual
+    test)."""
+    with _lock:
+        return not _unsupported

@@ -47,9 +47,16 @@ from ..utils.logging import get_logger
 
 __all__ = ["Event", "QueryTrace", "query_trace", "current_trace",
            "add_event", "wrap_context", "traced_query", "last_query",
-           "recent_events", "clear_ring", "block_meta", "bypass"]
+           "recent_events", "clear_ring", "block_meta", "bypass",
+           "DEVICE_TRACK_BASE"]
 
 _log = get_logger("observability.events")
+
+# chrome-trace track (tid) namespace: 0 = query, 1..depth = pipeline
+# slots, DEVICE_TRACK_BASE+i = mesh device i (per-device shard events,
+# HBM samples). Far above any realistic pipeline depth, so the two
+# namespaces can never collide.
+DEVICE_TRACK_BASE = 1000
 
 
 def _env_int(name: str, default: int) -> int:
@@ -68,6 +75,12 @@ def _env_int(name: str, default: int) -> int:
 _qid_counter = itertools.count(1)
 _current: "contextvars.ContextVar[Optional[QueryTrace]]" = \
     contextvars.ContextVar("tft_query_trace", default=None)
+# tracing-off slow-query timer nesting guard: nested forcings must join
+# the ambient (outermost) timed query exactly like traced queries join
+# the ambient trace — without this, one API call logs one slow line per
+# upstream frame it forces
+_slow_active: "contextvars.ContextVar[bool]" = \
+    contextvars.ContextVar("tft_slow_query_active", default=False)
 
 # benchmark hook: strips the event layer entirely (even the enabled()
 # check) so bench.py can measure the disabled layer's residual cost
@@ -175,9 +188,22 @@ class QueryTrace:
                 st[0] += 1
                 st[1] += dt
 
-    def _finish(self) -> None:
+    def _finish(self, error: Optional[str] = None) -> None:
+        try:  # HBM watermark at query end (None fallback on CPU)
+            from . import device as _device
+            _device.sample(self, "query_end", per_device=True)
+        except Exception as e:
+            _log.debug("query-end memory sample failed: %s", e)
         self.duration = self.clock()
+        if error is not None:
+            # a failed query must stay distinguishable from a slow
+            # success — in the latency histogram (its own series), the
+            # slow-query log, and the exported trace/meta
+            self.meta["error"] = error
         tracing.counters.inc("trace.queries")
+        tracing.histograms.observe("query_latency_seconds", self.duration,
+                                   op=self.op,
+                                   outcome="error" if error else "ok")
         if self.dropped:
             tracing.counters.inc("trace.events_dropped", self.dropped)
         with self._lock:
@@ -190,6 +216,20 @@ class QueryTrace:
         path = os.environ.get("TFT_TRACE_FILE")
         if path:
             self._write_jsonl(path, dicts)
+        ms = _slow_query_threshold_ms()
+        if ms is not None and self.duration * 1000.0 >= ms:
+            s = self.summary()
+            rec = {"type": "slow_query", "query_id": self.query_id,
+                   "op": self.op,
+                   "duration_ms": round(self.duration * 1000.0, 3),
+                   "blocks": s["blocks"], "retries": s["retries"],
+                   "oom_splits": s["oom_splits"],
+                   "sync_fallbacks": s["sync_fallbacks"]}
+            if error is not None:
+                rec["error"] = error
+            if s["hbm"] is not None:
+                rec["peak_hbm_bytes"] = s["hbm"]["peak"]
+            _emit_slow(rec)
 
     def _write_jsonl(self, path: str, dicts: List[Dict[str, Any]]) -> None:
         head = {"type": "query", "query_id": self.query_id, "op": self.op,
@@ -211,7 +251,8 @@ class QueryTrace:
     def summary(self) -> Dict[str, Any]:
         """Aggregate the event stream into the per-query totals
         ``explain()`` renders (blocks, rows, bytes, retries, fallbacks,
-        compile-cache hits/misses, pipeline occupancy)."""
+        compile-cache hits/misses, pipeline occupancy, per-device mesh
+        stats with a straggler ratio, and HBM watermarks)."""
         s: Dict[str, Any] = {
             "query_id": self.query_id, "op": self.op,
             "duration_s": self.duration if self.duration is not None
@@ -220,12 +261,18 @@ class QueryTrace:
             "retries": 0, "giveups": 0, "oom_splits": 0,
             "pad_fallbacks": 0, "sync_fallbacks": 0,
             "compile_hits": 0, "compile_misses": 0,
-            "dispatches": 0, "events": 0, "dropped": self.dropped,
+            "compile_seconds": 0.0, "dispatches": 0,
+            "mesh_dispatches": 0, "collectives": 0,
+            "events": 0, "dropped": self.dropped,
             "occupancy_mean": None, "slots": 0,
+            "mesh": None, "hbm": None,
         }
         occ_total = 0.0
         occ_n = 0
         slots = set()
+        # per-device accumulation: device -> [rows, bytes, time_s]
+        devs: Dict[int, list] = {}
+        hbm_live_start = hbm_live_end = hbm_peak = None
         with self._lock:
             events = list(self.events)
         for ev in events:
@@ -253,8 +300,32 @@ class QueryTrace:
                     s["compile_hits"] += 1
                 else:
                     s["compile_misses"] += 1
+            elif ev.etype == "compile":
+                s["compile_seconds"] += float(ev.dur or 0.0)
             elif ev.etype == "dispatch":
                 s["dispatches"] += 1
+            elif ev.etype == "mesh_dispatch":
+                s["mesh_dispatches"] += 1
+            elif ev.etype == "collective":
+                s["collectives"] += 1
+            elif ev.etype == "shard":
+                d = a.get("device")
+                if d is not None:
+                    acc = devs.setdefault(int(d), [0, 0, 0.0])
+                    acc[0] += int(a.get("rows") or 0)
+                    acc[1] += int(a.get("bytes") or 0)
+            elif ev.etype == "shard_compute":
+                d = a.get("device")
+                if d is not None:
+                    acc = devs.setdefault(int(d), [0, 0, 0.0])
+                    acc[2] += float(ev.dur or 0.0)
+            elif ev.etype == "hbm_sample" and a.get("device") is None:
+                live = int(a.get("live_bytes") or 0)
+                peak = int(a.get("peak_bytes") or live)
+                if hbm_live_start is None:
+                    hbm_live_start = live
+                hbm_live_end = live
+                hbm_peak = max(hbm_peak or 0, peak, live)
             elif ev.etype == "occupancy":
                 occ_total += float(a.get("value") or 0.0)
                 occ_n += 1
@@ -262,6 +333,23 @@ class QueryTrace:
         s["slots"] = len(slots)
         if occ_n:
             s["occupancy_mean"] = occ_total / occ_n
+        if devs:
+            times = [acc[2] for acc in devs.values() if acc[2] > 0.0]
+            ratio = None
+            if len(times) >= 2:
+                import statistics
+                med = statistics.median(times)
+                if med > 0.0:
+                    ratio = max(times) / med
+            s["mesh"] = {
+                "devices": {d: {"rows": acc[0], "bytes": acc[1],
+                                "time_s": acc[2]}
+                            for d, acc in sorted(devs.items())},
+                "straggler_ratio": ratio,
+            }
+        if hbm_peak is not None:
+            s["hbm"] = {"live_start": hbm_live_start,
+                        "live_end": hbm_live_end, "peak": hbm_peak}
         return s
 
     def report(self) -> str:
@@ -275,9 +363,12 @@ class QueryTrace:
         One process per query; track (``tid``) 0 carries the query span
         and instantaneous events (retries, OOM splits, fallbacks), tracks
         1..depth are the in-flight pipeline slots with each block's
-        submit/compute/drain phases — occupancy and stall patterns become
-        visible at a glance. Returns the JSON string; ``file`` also
-        writes it out.
+        submit/compute/drain phases, and tracks
+        ``DEVICE_TRACK_BASE + i`` (named ``device i``) carry the mesh
+        layer's per-device shard sizes, readiness timings, and HBM
+        samples — occupancy, stall, and straggler patterns become visible
+        at a glance. Returns the JSON string; ``file`` also writes it
+        out.
         """
         pid = 1
         with self._lock:
@@ -313,10 +404,15 @@ class QueryTrace:
             "args": {"name": f"tensorframes_tpu {self.query_id} "
                              f"({self.op})"}}]
         for tid in sorted(tracks):
+            if tid == 0:
+                tname = "query"
+            elif tid >= DEVICE_TRACK_BASE:
+                tname = f"device {tid - DEVICE_TRACK_BASE}"
+            else:
+                tname = f"slot {tid - 1}"
             meta.append({"name": "thread_name", "ph": "M", "pid": pid,
                          "tid": tid, "ts": 0.0,
-                         "args": {"name": "query" if tid == 0
-                                  else f"slot {tid - 1}"}})
+                         "args": {"name": tname}})
         doc = {"traceEvents": meta + out, "displayTimeUnit": "ms",
                "otherData": {"query_id": self.query_id, "op": self.op,
                              "start_time": self.start_time}}
@@ -341,6 +437,39 @@ def current_trace() -> Optional[QueryTrace]:
     return _current.get()
 
 
+_slow_malformed_warned = False
+
+
+def _slow_query_threshold_ms() -> Optional[float]:
+    """The ``TFT_SLOW_QUERY_MS`` threshold, or ``None`` when unset."""
+    raw = os.environ.get("TFT_SLOW_QUERY_MS")
+    if not raw:
+        return None
+    try:
+        return float(raw)
+    except ValueError:
+        global _slow_malformed_warned
+        if not _slow_malformed_warned:
+            _log.warning("ignoring malformed TFT_SLOW_QUERY_MS=%r", raw)
+            _slow_malformed_warned = True
+        return None
+
+
+def _emit_slow(rec: Dict[str, Any]) -> None:
+    """One condensed slow-query JSONL line: to the ``TFT_TRACE_FILE``
+    sink when set, else the logger."""
+    line = json.dumps(rec, default=str)
+    path = os.environ.get("TFT_TRACE_FILE")
+    if path:
+        try:
+            with _file_lock, open(path, "a") as f:
+                f.write(line + "\n")
+            return
+        except OSError as e:
+            _log.warning("TFT_TRACE_FILE=%s write failed: %s", path, e)
+    _log.warning("slow query: %s", line)
+
+
 @contextlib.contextmanager
 def query_trace(op: str, **meta) -> Iterator[Optional[QueryTrace]]:
     """Open a query-scoped trace around a public-API execution.
@@ -349,17 +478,53 @@ def query_trace(op: str, **meta) -> Iterator[Optional[QueryTrace]]:
     disabled (zero-cost-when-off) or a trace is already active (nested
     API calls join the ambient query instead of fragmenting it; events
     they record attach to the outermost trace).
+
+    ``TFT_SLOW_QUERY_MS``: top-level queries exceeding the threshold emit
+    one condensed JSONL line even with full tracing OFF — the timing then
+    is a bare ``perf_counter`` pair, no trace or events are allocated.
     """
-    if _bypass or not tracing.enabled() or _current.get() is not None:
+    if _bypass:
         yield None
+        return
+    if not tracing.enabled() or _current.get() is not None:
+        ms = _slow_query_threshold_ms()
+        if ms is None or _current.get() is not None or _slow_active.get():
+            yield None
+            return
+        token = _slow_active.set(True)
+        t0 = time.perf_counter()
+        err = None
+        try:
+            yield None
+        except BaseException as e:
+            err = type(e).__name__
+            raise
+        finally:
+            _slow_active.reset(token)
+            dur = time.perf_counter() - t0
+            if dur * 1000.0 >= ms:
+                rec = {"type": "slow_query", "op": op,
+                       "duration_ms": round(dur * 1000.0, 3)}
+                if err is not None:
+                    rec["error"] = err
+                _emit_slow(rec)
         return
     t = QueryTrace(op, meta)
     token = _current.set(t)
+    try:  # HBM watermark at query start (None fallback on CPU)
+        from . import device as _device
+        _device.sample(t, "query_start", per_device=True)
+    except Exception as e:
+        _log.debug("query-start memory sample failed: %s", e)
+    err = None
     try:
         yield t
+    except BaseException as e:
+        err = type(e).__name__
+        raise
     finally:
         _current.reset(token)
-        t._finish()
+        t._finish(error=err)
 
 
 def add_event(etype: str, name: Optional[str] = None,
@@ -388,15 +553,28 @@ def wrap_context(fn: Callable) -> Callable:
     return bound
 
 
-def traced_query(op: str):
+def traced_query(op: str, meta_fn: Optional[Callable] = None):
     """Decorator form of :func:`query_trace` for eager API entry points
-    (``reduce_*``, ``aggregate``, the mesh d-ops)."""
+    (``reduce_*``, ``aggregate``, the mesh d-ops).
+
+    ``meta_fn(*args, **kwargs) -> dict`` extracts entry metadata (mesh
+    shape, shard count, fetch names) from the call so distributed traces
+    are self-describing instead of bare op names. It runs ONLY when a
+    trace actually opened (zero-cost-when-off) and is best-effort — a
+    failure is logged, never raised into the query.
+    """
     def deco(fn: Callable) -> Callable:
         import functools
 
         @functools.wraps(fn)
         def wrapper(*a, **k):
-            with query_trace(op):
+            with query_trace(op) as t:
+                if t is not None and meta_fn is not None:
+                    try:
+                        t.meta.update(meta_fn(*a, **k) or {})
+                    except Exception as e:
+                        _log.debug("traced_query meta_fn for %s failed: "
+                                   "%s", op, e)
                 return fn(*a, **k)
 
         return wrapper

@@ -16,6 +16,13 @@ Families:
 - ``tft_gauge{name="...",stat="mean|min|max|last"}`` and
   ``tft_gauge_samples_total{name="..."}`` — sampled levels (e.g.
   ``pipeline.occupancy``);
+- proper Prometheus **histogram** families (cumulative ``le`` buckets +
+  ``_sum``/``_count``):
+  ``tft_query_latency_seconds{op="...",outcome="ok|error"}`` (one
+  series per query op and outcome, observed at every traced query
+  finish — failures never pollute the success-latency series) and
+  ``tft_compile_seconds{engine="jax|native|native_mesh"}`` (observed at
+  every compile-cache miss, always on);
 - ``tft_trace_ring_events`` — events currently buffered in the ring.
 
 Security note: the endpoint binds ``127.0.0.1`` ONLY — metrics names leak
@@ -99,11 +106,52 @@ def metrics_text() -> str:
         lines.append(f'tft_gauge_samples_total{{name='
                      f'"{_escape_label(name)}"}} {gauges[name]["count"]}')
 
+    lines.extend(_histogram_lines())
+
     lines.append("# HELP tft_trace_ring_events Events currently held in "
                  "the bounded trace ring buffer.")
     lines.append("# TYPE tft_trace_ring_events gauge")
     lines.append(f"tft_trace_ring_events {len(_events.recent_events())}")
     return "\n".join(lines) + "\n"
+
+
+_HIST_HELP = {
+    "query_latency_seconds":
+        "Wall time of traced queries, by op (observed at query finish).",
+    "compile_seconds":
+        "XLA compile duration per compile-cache miss, by engine.",
+}
+
+
+def _histogram_lines() -> list:
+    """Render every :data:`~..utils.tracing.histograms` family in the
+    Prometheus histogram convention: cumulative ``le`` buckets (ending at
+    ``+Inf``) plus ``_sum`` and ``_count`` per label set."""
+    hists = tracing.histograms.snapshot()
+    lines: list = []
+    for fam in sorted({k[0] for k in hists}):
+        metric = f"tft_{fam}"
+        help_text = _HIST_HELP.get(
+            fam, "Bucketed observations (seconds).")
+        lines.append(f"# HELP {metric} {help_text}")
+        lines.append(f"# TYPE {metric} histogram")
+        series = sorted((k for k in hists if k[0] == fam),
+                        key=lambda k: k[1])
+        for key in series:
+            h = hists[key]
+            labels = ",".join(f'{n}="{_escape_label(v)}"'
+                              for n, v in key[1])
+            sep = "," if labels else ""
+            cum = 0
+            for le, c in zip(h["les"], h["counts"]):
+                cum += c
+                le_s = "+Inf" if le == float("inf") else _num(le)
+                lines.append(f'{metric}_bucket{{{labels}{sep}le='
+                             f'"{le_s}"}} {cum}')
+            brace = f"{{{labels}}}" if labels else ""
+            lines.append(f"{metric}_sum{brace} {_num(h['sum'])}")
+            lines.append(f"{metric}_count{brace} {h['count']}")
+    return lines
 
 
 # ---------------------------------------------------------------------------

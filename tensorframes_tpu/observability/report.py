@@ -4,19 +4,44 @@
 The structured replacement for the reference's ``logDebug`` narration
 (SURVEY.md §5): instead of grepping interleaved log lines, one call
 renders what a query actually did — rows, blocks, bytes marshalled,
-retries, OOM splits, sync fallbacks, compile-cache behavior, and wall
-time by stage — all from the query's own :class:`~.events.QueryTrace`,
-so overlapping queries can no longer contaminate each other's numbers.
+retries, OOM splits, sync fallbacks, compile-cache behavior, wall time
+by stage, and (for mesh queries) the per-device breakdown: rows/bytes/
+time per device, a straggler ratio (max/median device time, warned
+above ``TFT_SKEW_WARN``, default 2.0), and HBM watermarks — all from
+the query's own :class:`~.events.QueryTrace`, so overlapping queries
+can no longer contaminate each other's numbers.
 """
 
 from __future__ import annotations
 
+import os
 from typing import Optional
 
 from ..utils import tracing
 from . import events as _events
 
 __all__ = ["render", "frame_report", "last_query_report"]
+
+DEFAULT_SKEW_WARN = 2.0
+
+_skew_malformed_warned = False
+
+
+def _skew_threshold() -> float:
+    raw = os.environ.get("TFT_SKEW_WARN")
+    if not raw:
+        return DEFAULT_SKEW_WARN
+    try:
+        return float(raw)
+    except ValueError:
+        global _skew_malformed_warned
+        if not _skew_malformed_warned:
+            from ..utils.logging import get_logger
+            get_logger("observability.report").warning(
+                "ignoring malformed TFT_SKEW_WARN=%r (using %g)", raw,
+                DEFAULT_SKEW_WARN)
+            _skew_malformed_warned = True
+        return DEFAULT_SKEW_WARN
 
 
 def _fmt_bytes(n: int) -> str:
@@ -54,9 +79,39 @@ def render(trace: "_events.QueryTrace") -> str:
         f"  resilience: {s['retries']} retried, {s['giveups']} gave up, "
         f"{s['oom_splits']} oom split(s), "
         f"{s['pad_fallbacks']} pad fallback(s)")
+    compile_s = (f" · {_fmt_secs(s['compile_seconds'])} compiling"
+                 if s["compile_seconds"] else "")
     lines.append(
         f"  compile  : {s['compile_misses']} miss(es) / "
-        f"{s['compile_hits']} hit(s)")
+        f"{s['compile_hits']} hit(s){compile_s}")
+    if trace.meta:
+        meta = " ".join(f"{k}={v}" for k, v in sorted(trace.meta.items())
+                        if k != "plan")
+        if meta:
+            lines.append(f"  query    : {meta}")
+    mesh = s["mesh"]
+    if mesh is not None:
+        ratio = mesh["straggler_ratio"]
+        ratio_s = (f"straggler ratio {ratio:.2f} (max/median device time)"
+                   if ratio is not None else "straggler ratio n/a")
+        lines.append(f"  mesh     : {len(mesh['devices'])} device(s), "
+                     f"{s['mesh_dispatches']} dispatch(es), "
+                     f"{s['collectives']} collective(s), {ratio_s}")
+        for d, acc in mesh["devices"].items():
+            lines.append(f"    device {d}: {acc['rows']} rows · "
+                         f"{_fmt_bytes(acc['bytes'])} · "
+                         f"{_fmt_secs(acc['time_s'])}")
+        if ratio is not None and ratio > _skew_threshold():
+            lines.append(
+                f"  WARNING  : device time imbalance — the slowest "
+                f"device ran {ratio:.2f}x the median (threshold "
+                f"{_skew_threshold():g}; straggling shard or skewed "
+                f"rows, see the per-device table above)")
+    if s["hbm"] is not None:
+        h = s["hbm"]
+        lines.append(f"  memory   : peak HBM {_fmt_bytes(h['peak'])} "
+                     f"(live {_fmt_bytes(h['live_start'])} -> "
+                     f"{_fmt_bytes(h['live_end'])})")
     extra = f" (+{s['dropped']} dropped)" if s["dropped"] else ""
     lines.append(f"  events   : {s['events']} recorded{extra}")
     if trace.stages:

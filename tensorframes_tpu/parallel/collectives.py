@@ -22,12 +22,19 @@ __all__ = ["Combiner", "COMBINERS"]
 
 class Combiner(NamedTuple):
     """An associative reduction: local block-reduce, mesh collective, and
-    the padding-neutral element."""
+    the padding-neutral element.
+
+    ``ici`` names the XLA collective primitive the cross-shard combine
+    lowers to — the observability layer records it as a typed
+    ``collective`` event on the active query trace, so a trace says not
+    just *that* a mesh reduce ran but *which* ICI traffic it implied.
+    """
 
     name: str
     local: Callable  # (block, axis) -> partial
     collective: Callable  # (partial, axis_name) -> combined
     neutral: Callable  # (dtype) -> scalar
+    ici: str = "psum"  # the collective primitive (trace attribution)
 
 
 def _neutral_min(dt):
@@ -49,20 +56,24 @@ COMBINERS: Dict[str, Combiner] = {
         "sum",
         lambda b, axis=0: jnp.sum(b, axis=axis),
         lambda x, axis_name: jax.lax.psum(x, axis_name),
-        lambda dt: np.array(0, dt)),
+        lambda dt: np.array(0, dt),
+        ici="psum"),
     "min": Combiner(
         "min",
         lambda b, axis=0: jnp.min(b, axis=axis),
         lambda x, axis_name: jax.lax.pmin(x, axis_name),
-        _neutral_min),
+        _neutral_min,
+        ici="pmin"),
     "max": Combiner(
         "max",
         lambda b, axis=0: jnp.max(b, axis=axis),
         lambda x, axis_name: jax.lax.pmax(x, axis_name),
-        _neutral_max),
+        _neutral_max,
+        ici="pmax"),
     "prod": Combiner(
         "prod",
         lambda b, axis=0: jnp.prod(b, axis=axis),
         lambda x, axis_name: jax.lax.all_gather(x, axis_name).prod(axis=0),
-        lambda dt: np.array(1, dt)),
+        lambda dt: np.array(1, dt),
+        ici="all_gather"),
 }

@@ -41,7 +41,8 @@ from ..resilience import default_policy as _default_policy, faults as _faults
 from ..schema import Schema
 from .collectives import COMBINERS
 from .mesh import DeviceMesh
-from ..observability.events import traced_query
+from ..observability.events import (DEVICE_TRACK_BASE, current_trace,
+                                    traced_query)
 from ..utils.logging import get_logger
 from ..utils.tracing import span
 
@@ -60,6 +61,143 @@ def _jitted(comp):
         fn = jax.jit(comp.fn)
         comp._tft_jitted = fn
     return fn
+
+
+# ---------------------------------------------------------------------------
+# mesh-level trace instrumentation (zero-cost-when-off: every helper is
+# called only behind a `trace is not None` check — no events, no
+# per-shard introspection, and no extra readiness barriers otherwise)
+# ---------------------------------------------------------------------------
+
+def _fetch_names(fetches):
+    """Best-effort fetch names for self-describing trace metadata: a
+    Computation's declared outputs or a mapping's keys; ``None`` for an
+    untraced callable (its outputs exist only after tracing)."""
+    names = getattr(fetches, "output_names", None)
+    if names:
+        return sorted(names)
+    if isinstance(fetches, Mapping):
+        return sorted(str(n) for n in fetches)
+    return None
+
+
+def _mesh_meta(dist) -> Dict:
+    m = dist.mesh
+    return {"mesh_shape": dict(m.mesh.shape), "shards": m.num_data_shards,
+            "devices": m.num_devices, "rows": dist.num_rows,
+            "padded_rows": dist.padded_rows}
+
+
+def _meta_with_fetches(fetches=None, dist=None, *a, **k):
+    dist = k.get("dist", dist)
+    fetches = k.get("fetches", fetches)
+    if dist is None:
+        return {}
+    meta = _mesh_meta(dist)
+    names = _fetch_names(fetches)
+    if names is not None:
+        meta["fetches"] = names
+    return meta
+
+
+def _meta_dfilter(predicate=None, dist=None, *a, **k):
+    dist = k.get("dist", dist)
+    return _mesh_meta(dist) if dist is not None else {}
+
+
+def _meta_dsort(keys=None, dist=None, *a, **k):
+    dist = k.get("dist", dist)
+    keys = k.get("keys", keys)
+    if dist is None:
+        return {}
+    meta = _mesh_meta(dist)
+    meta["keys"] = [keys] if isinstance(keys, str) else list(keys or ())
+    return meta
+
+
+def _meta_daggregate(fetches=None, dist=None, keys=None, *a, **k):
+    meta = _meta_with_fetches(fetches, dist, **k)
+    keys = k.get("keys", keys)
+    if meta and keys is not None:
+        meta["keys"] = [keys] if isinstance(keys, str) else list(keys)
+    return meta
+
+
+def _meta_distribute(df=None, mesh=None, *a, **k):
+    mesh = k.get("mesh", mesh)
+    if mesh is None:
+        return {}
+    return {"mesh_shape": dict(mesh.mesh.shape),
+            "shards": mesh.num_data_shards, "devices": mesh.num_devices}
+
+
+def _trace_shards(trace, op: str, dist=None, mesh=None,
+                  arrays=None) -> float:
+    """Record one ``shard`` event per data shard (rows where known, an
+    even-split byte estimate) on the device tracks; returns the dispatch
+    start timestamp for :func:`_trace_mesh_done`."""
+    if dist is not None:
+        mesh = dist.mesh
+        arrays = list(dist.columns.values())
+        try:
+            rows = dist.per_shard_valid()
+        except Exception:
+            rows = None
+    else:
+        rows = None
+    S = mesh.num_data_shards
+    nbytes = 0
+    for a in arrays or ():
+        nb = getattr(a, "nbytes", None)
+        if nb:
+            nbytes += int(nb)
+    per_dev = nbytes // S if S else 0
+    for i in range(S):
+        trace.add("shard", name=f"{op} shard {i}", device=i,
+                  rows=(int(rows[i]) if rows is not None else None),
+                  bytes=per_dev, track=DEVICE_TRACK_BASE + i)
+    return trace.clock()
+
+
+def _trace_mesh_done(trace, outs, t0: float, op: str,
+                     native: bool = False) -> None:
+    """Per-device readiness timings + the op-level mesh dispatch span.
+
+    Readiness is measured by waiting on each device's output shard in
+    data-shard order, so a measured duration is the time until that
+    device AND every earlier one were ready — the max (the straggler) is
+    exact, earlier devices' times are conservative upper bounds. Only
+    runs with tracing on; the untraced path keeps jax's async dispatch
+    barrier-free.
+    """
+    if not native:
+        try:
+            arr = next((a for a in outs
+                        if hasattr(a, "addressable_shards")), None)
+            if arr is not None:
+                shards = list(arr.addressable_shards)
+                by_start = {}
+                for sh in shards:
+                    idx = sh.index
+                    sl = idx[0] if idx else None
+                    start = (sl.start or 0) if isinstance(sl, slice) else 0
+                    by_start.setdefault(start, sh)
+                if len(by_start) > 1:  # row-sharded: data-shard order
+                    ordered = [by_start[k] for k in sorted(by_start)]
+                else:  # replicated result: one copy per device
+                    ordered = sorted(
+                        shards, key=lambda sh: getattr(sh.device, "id", 0))
+                for i, sh in enumerate(ordered):
+                    jax.block_until_ready(sh.data)
+                    t = trace.clock()
+                    trace.add("shard_compute", name=f"{op} d{i}", ts=t0,
+                              dur=max(t - t0, 0.0), device=i,
+                              track=DEVICE_TRACK_BASE + i)
+        except Exception as e:
+            get_logger("distributed").debug(
+                "per-device readiness trace failed for %s: %s", op, e)
+    trace.add("mesh_dispatch", name=op, ts=t0,
+              dur=max(trace.clock() - t0, 0.0), native=native)
 
 
 class DistributedFrame:
@@ -258,7 +396,7 @@ def _read_global(a) -> np.ndarray:
     return gathered.reshape((-1,) + tuple(a.shape[1:]))
 
 
-@traced_query("distribute")
+@traced_query("distribute", _meta_distribute)
 def distribute(df: TensorFrame, mesh: DeviceMesh) -> DistributedFrame:
     """Shard a host frame over the mesh's data axis.
 
@@ -295,10 +433,17 @@ def distribute(df: TensorFrame, mesh: DeviceMesh) -> DistributedFrame:
                 a = _native.convert(a, dd)
         with span("distribute.device_put"):
             cols[f.name] = jax.device_put(a, mesh.row_sharding(a.ndim))
-    return DistributedFrame(mesh, df.schema, cols, n)
+    result = DistributedFrame(mesh, df.schema, cols, n)
+    trace = current_trace()
+    if trace is not None:
+        t0 = _trace_shards(trace, "distribute", dist=result)
+        _trace_mesh_done(trace, [c for c in cols.values()
+                                 if not isinstance(c, np.ndarray)],
+                         t0, "distribute")
+    return result
 
 
-@traced_query("dmap_blocks")
+@traced_query("dmap_blocks", _meta_with_fetches)
 def dmap_blocks(fetches, dist: DistributedFrame, trim: bool = False,
                 row_aligned: Optional[bool] = None) -> DistributedFrame:
     """Mesh-parallel map: one jit dispatch, all shards in parallel.
@@ -367,7 +512,13 @@ def dmap_blocks(fetches, dist: DistributedFrame, trim: bool = False,
 
     # one jit dispatch covers every shard: a transient PJRT failure here
     # would otherwise kill the whole mesh map
+    trace = current_trace()
+    t0 = (_trace_shards(trace, "dmap_blocks", dist=dist)
+          if trace is not None else 0.0)
     out = policy.call(_dispatch, op="dmap_blocks.dispatch")
+    if trace is not None:
+        _trace_mesh_done(trace, [out[s.name] for s in comp.outputs], t0,
+                         "dmap_blocks")
     leads = {out[s.name].shape[0] for s in comp.outputs}
     if len(leads) > 1:
         raise ValueError(
@@ -396,7 +547,7 @@ def dmap_blocks(fetches, dist: DistributedFrame, trim: bool = False,
                                          else None))
 
 
-@traced_query("dfilter")
+@traced_query("dfilter", _meta_dfilter)
 def dfilter(predicate, dist: DistributedFrame) -> DistributedFrame:
     """Mesh filter: keep the rows where ``predicate`` holds (nonzero).
 
@@ -491,8 +642,13 @@ def dfilter(predicate, dist: DistributedFrame) -> DistributedFrame:
         if fn is None:
             fn = jax.jit(build_prog())
             cache[key] = fn
+        trace = current_trace()
+        t0 = (_trace_shards(trace, "dfilter", dist=dist)
+              if trace is not None else 0.0)
         with span("dfilter.dispatch"):
             outs = fn(cnt_dev, *arrays)
+        if trace is not None:
+            _trace_mesh_done(trace, list(outs), t0, "dfilter")
     new_cols: Dict[str, jax.Array] = dict(zip(tensor_names, outs))
     counts = _read_global(outs[len(tensor_names)]).astype(np.int64)
     if host_names:
@@ -513,7 +669,7 @@ _dsort_cache: "OrderedDict[tuple, object]" = OrderedDict()
 _DSORT_CACHE_CAP = 32
 
 
-@traced_query("dsort")
+@traced_query("dsort", _meta_dsort)
 def dsort(keys, dist: DistributedFrame, descending: bool = False
           ) -> DistributedFrame:
     """Rows globally sorted by scalar key column(s), on the mesh.
@@ -675,8 +831,14 @@ def _dsort_local(dist, keys, descending, tensor_names, arrays, valid_dev,
     else:
         _dsort_cache.move_to_end(ckey)
 
+    trace = current_trace()
+    t0 = (_trace_shards(trace, "dsort", dist=dist)
+          if trace is not None else 0.0)
     with span("dsort.dispatch"):
-        return fn(valid_dev, *arrays)
+        outs = fn(valid_dev, *arrays)
+    if trace is not None:
+        _trace_mesh_done(trace, list(outs), t0, "dsort")
+    return outs
 
 
 def _dsort_columnsort(dist, keys, descending, tensor_names, arrays,
@@ -874,11 +1036,23 @@ def _dsort_columnsort(dist, keys, descending, tensor_names, arrays,
     else:
         _dsort_cache.move_to_end(ckey)
 
+    trace = current_trace()
+    t0 = 0.0
+    if trace is not None:
+        t0 = _trace_shards(trace, "dsort", dist=dist)
+        # the compiled pipeline's static exchange schedule (steps 2/4/6/8)
+        trace.add("collective", name="all_to_all", ts=t0, count=2,
+                  op="dsort.columnsort")
+        trace.add("collective", name="ppermute", ts=t0, count=2,
+                  op="dsort.columnsort")
     with span("dsort.columnsort_dispatch"):
-        return fn(valid_dev, *arrays)
+        outs = fn(valid_dev, *arrays)
+    if trace is not None:
+        _trace_mesh_done(trace, list(outs), t0, "dsort")
+    return outs
 
 
-@traced_query("dreduce_blocks")
+@traced_query("dreduce_blocks", _meta_with_fetches)
 def dreduce_blocks(fetches, dist: DistributedFrame):
     """Mesh-parallel reduce to one row.
 
@@ -1006,8 +1180,17 @@ def _collective_reduce(col_combiners: Mapping[str, str],
         nv_dev = jax.make_array_from_callback(
             (mesh.num_data_shards,), mesh.row_sharding(1),
             lambda idx: dist.per_shard_valid().astype(np.int32)[idx])
+        trace = current_trace()
+        t0 = 0.0
+        if trace is not None:
+            t0 = _trace_shards(trace, "dreduce_blocks", dist=dist)
+            for name in names:
+                trace.add("collective", name=combs[name].ici, ts=t0,
+                          column=name, op="dreduce_blocks")
         with span("dreduce_blocks.collective_dispatch"):
             outs = fn(nv_dev, *arrays)
+        if trace is not None:
+            _trace_mesh_done(trace, list(outs), t0, "dreduce_blocks")
     result = {}
     for name, a in zip(names, outs):
         v = np.asarray(a)
@@ -1261,7 +1444,7 @@ def _device_key_columns(dist: DistributedFrame, keys, key_table,
             for i, k in enumerate(keys)}, count
 
 
-@traced_query("daggregate")
+@traced_query("daggregate", _meta_daggregate)
 def daggregate(fetches, dist: DistributedFrame, keys,
                max_groups: Optional[int] = None) -> TensorFrame:
     """Mesh-distributed keyed aggregation.
@@ -1414,8 +1597,17 @@ def daggregate(fetches, dist: DistributedFrame, keys,
             _collective_cache[pkey] = fn
             while len(_collective_cache) > _COLLECTIVE_CACHE_CAP:
                 _collective_cache.popitem(last=False)
+        trace = current_trace()
+        t0 = 0.0
+        if trace is not None:
+            t0 = _trace_shards(trace, "daggregate", dist=dist)
+            for f in fetch_names:
+                trace.add("collective", name=COMBINERS[col_combiners[f]].ici,
+                          ts=t0, column=f, op="daggregate")
         with span("daggregate.dispatch"):
             tables = fn(ids_dev, *arrays)
+        if trace is not None:
+            _trace_mesh_done(trace, list(tables), t0, "daggregate")
 
     if device_keys:
         cols, num_out = _device_key_columns(dist, keys, uniq_dev,
@@ -1579,8 +1771,15 @@ def _segmented_fold(comp, names, mesh: DeviceMesh, arrays, ids_dev,
         # like _collective_cache does
         while len(cache) > 16:
             cache.popitem(last=False)
+    trace = current_trace()
+    t0 = (_trace_shards(trace, "daggregate", mesh=mesh, arrays=arrays)
+          if trace is not None else 0.0)
     with span("daggregate.segmented_fold_dispatch"):
-        return fn(ids_dev, *arrays)
+        outs = fn(ids_dev, *arrays)
+    if trace is not None:
+        _trace_mesh_done(trace, [outs[f] for f in names], t0,
+                         "daggregate")
+    return outs
 
 
 def _generic_daggregate(fetches, dist: DistributedFrame, keys,
@@ -1765,8 +1964,14 @@ def _generic_reduce(fetches, dist: DistributedFrame) -> Dict[str, np.ndarray]:
         fn = cache.get(key)
         if fn is None:
             fn = cache[key] = jax.jit(make_program())
+        trace = current_trace()
+        t0 = (_trace_shards(trace, "dreduce_blocks", dist=dist)
+              if trace is not None else 0.0)
         with span("dreduce_blocks.generic_dispatch"):
             final = fn(*arrays)
+        if trace is not None:
+            _trace_mesh_done(trace, [final[f] for f in names], t0,
+                             "dreduce_blocks")
     out = {}
     for f in fetch_names:
         v = np.asarray(final[f])

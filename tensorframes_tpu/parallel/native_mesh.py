@@ -36,15 +36,38 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from collections import OrderedDict
 from typing import Dict, List, Optional
 
 import numpy as np
 
+from ..observability import events as _obs
 from ..utils.logging import get_logger
+from ..utils.tracing import histograms as _histograms
 from ..utils.tracing import span
 
 _log = get_logger("native_mesh")
+
+
+def _record_compile(dt: float) -> None:
+    """Compile-time attribution for a native SPMD compile: always feeds
+    the ``compile_seconds`` histogram (compiles are rare), and attaches a
+    ``compile`` event to the active query trace when one listens."""
+    _histograms.observe("compile_seconds", dt, engine="native_mesh")
+    _obs.add_event("compile", name="native_mesh", dur=dt,
+                   engine="native_mesh")
+
+
+def _trace_native_dispatch(trace, op: str, args_per_dev) -> float:
+    """Per-device ``shard`` events (actual marshalled bytes per device)
+    before a native replicated execute; returns the dispatch start
+    timestamp. Caller records the matching ``mesh_dispatch`` after."""
+    for p, dev_args in enumerate(args_per_dev):
+        nb = sum(int(getattr(a, "nbytes", 0) or 0) for a in dev_args)
+        trace.add("shard", name=f"{op} shard {p}", device=p, bytes=nb,
+                  native=True, track=_obs.DEVICE_TRACK_BASE + p)
+    return trace.clock()
 
 __all__ = ["executor_for", "NativeMeshExecutor"]
 
@@ -233,12 +256,14 @@ class NativeMeshExecutor:
             with self._lock:
                 entry = per_comp.get(key)
                 if entry is None or entry is _NOT_ROUTABLE:
+                    t_c = time.perf_counter()
                     with _shardy_off():
                         text = jax.jit(
                             flat_fn, in_shardings=in_shardings,
                             out_shardings=tuple(out_shardings),
                         ).lower(*avals).as_text().encode()
                     exe = self.client.compile_spmd(text, n_total)
+                    _record_compile(time.perf_counter() - t_c)
                     entry = (exe, out_avals, out_shardings)
                     self._cache_put(per_comp, key, entry,
                                     self.COMP_CACHE_CAP)
@@ -249,8 +274,14 @@ class NativeMeshExecutor:
                    for n, s in zip(in_names, in_shardings)]
         args_per_dev = [[shards[p] for shards in per_arg]
                         for p in range(n_total)]
+        trace = _obs.current_trace()
+        t0 = (_trace_native_dispatch(trace, "dmap_blocks", args_per_dev)
+              if trace is not None else 0.0)
         with span("native_mesh.dmap_dispatch"):
             outs = exe.execute(args_per_dev)
+        if trace is not None:
+            trace.add("mesh_dispatch", name="dmap_blocks", ts=t0,
+                      dur=max(trace.clock() - t0, 0.0), native=True)
         self.dispatch_count += 1
         result = {}
         for i, (nm, oav, osh) in enumerate(
@@ -308,6 +339,7 @@ class NativeMeshExecutor:
                 entry = cache.get(cache_key)
                 if entry is None or entry is _NOT_ROUTABLE:
                     try:
+                        t_c = time.perf_counter()
                         with _shardy_off():
                             # out_shardings FORCED: ops that post-process
                             # a shard_map result (e.g. dsort's global
@@ -319,6 +351,7 @@ class NativeMeshExecutor:
                                 fn, out_shardings=tuple(out_sh),
                             ).lower(*avals).as_text().encode()
                         exe = self.client.compile_spmd(text, n_total)
+                        _record_compile(time.perf_counter() - t_c)
                     except Exception:
                         # latch: don't re-trace/re-lower on every call
                         # just to fail again
@@ -359,11 +392,19 @@ class NativeMeshExecutor:
                    for a, s in zip(host_args, in_shardings)]
         args_per_dev = [[shards[p] for shards in per_arg]
                         for p in range(n_total)]
+        trace = _obs.current_trace()
+        op = str(cache_key[0]) if isinstance(cache_key, tuple) \
+            and cache_key else "run_sharded"
+        t0 = (_trace_native_dispatch(trace, op, args_per_dev)
+              if trace is not None else 0.0)
         with span("native_mesh.sharded_dispatch"):
             outs = exe.execute(args_per_dev)
         result = [self._assemble([outs[p][i] for p in range(n_total)],
                                  sh, oav.shape, oav.dtype, dev_order)
                   for i, (oav, sh) in enumerate(zip(out_avals, out_sh))]
+        if trace is not None:
+            trace.add("mesh_dispatch", name=op, ts=t0,
+                      dur=max(trace.clock() - t0, 0.0), native=True)
         self.dispatch_count += 1  # after assembly: failures don't count
         return result
 

@@ -26,8 +26,32 @@ from ..utils.compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from .mesh import DeviceMesh
+from ..observability.events import current_trace as _current_trace
 
 __all__ = ["ring_attention", "ring_allreduce"]
+
+
+def _traced_ring_dispatch(kind: str, fn, args, axis: str, devices: int,
+                          hops: int):
+    """Dispatch a ring program, recording a ``collective`` event on the
+    active query trace (host-timed through readiness — tracing ON pays a
+    barrier; the untraced path keeps jax's async dispatch untouched).
+    Inputs that are tracers (the caller is itself under jit) skip the
+    timing: there is no host-visible dispatch to measure there.
+    """
+    trace = _current_trace()
+    if trace is None:
+        return fn(*args)
+    tracer_t = getattr(jax.core, "Tracer", ())
+    if tracer_t and any(isinstance(a, tracer_t) for a in args):
+        return fn(*args)
+    t0 = trace.clock()
+    out = fn(*args)
+    jax.block_until_ready(out)
+    trace.add("collective", name=kind, ts=t0,
+              dur=max(trace.clock() - t0, 0.0), axis=axis,
+              devices=devices, hops=hops)
+    return out
 
 
 def _varying(a, *axes: Optional[str]):
@@ -127,7 +151,9 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     spec = P(batch_axis, axis, head_axis, None)
     fn = shard_map(shard_fn, mesh=mesh.mesh,
                    in_specs=(spec, spec, spec), out_specs=spec)
-    return fn(q, k, v)
+    # n ring steps, each ppermuting k AND v one hop
+    return _traced_ring_dispatch("ring_attention", fn, (q, k, v), axis,
+                                 n, hops=2 * n)
 
 
 def ring_allreduce(x: jax.Array, mesh: DeviceMesh,
@@ -185,4 +211,6 @@ def ring_allreduce(x: jax.Array, mesh: DeviceMesh,
 
     fn = shard_map(shard_fn, mesh=mesh.mesh,
                    in_specs=P(ax), out_specs=P(ax))
-    return fn(x)
+    # reduce-scatter + all-gather: 2(n-1) neighbor hops
+    return _traced_ring_dispatch("ring_allreduce", fn, (x,), ax, n,
+                                 hops=2 * (n - 1))

@@ -37,9 +37,9 @@ from typing import Callable, Dict, Iterator, Optional
 
 from .logging import get_logger
 
-__all__ = ["Timings", "timings", "Counters", "counters", "span", "gauge",
-           "enable", "disable", "enabled", "profile", "dump_stats",
-           "set_span_observer"]
+__all__ = ["Timings", "timings", "Counters", "counters", "Histograms",
+           "histograms", "span", "gauge", "enable", "disable", "enabled",
+           "profile", "dump_stats", "set_span_observer"]
 
 _log = get_logger("utils.tracing")
 
@@ -229,6 +229,77 @@ class Counters:
 
 
 counters = Counters()
+
+
+# Default histogram buckets (seconds): spans compile times (sub-ms jit
+# cache-assembly on reuse up to tens of seconds for a first TPU compile)
+# and per-query latencies. Cumulative `le` semantics are applied at
+# render time (observability.metrics); here each bucket holds its own
+# non-cumulative count.
+DEFAULT_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                   0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
+
+
+class _Hist:
+    __slots__ = ("buckets", "counts", "sum", "count")
+
+    def __init__(self, buckets):
+        self.buckets = tuple(buckets)
+        self.counts = [0] * (len(self.buckets) + 1)  # +1: the +Inf bucket
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, value: float) -> None:
+        i = 0
+        for i, le in enumerate(self.buckets):
+            if value <= le:
+                break
+        else:
+            i = len(self.buckets)  # +Inf
+        self.counts[i] += 1
+        self.sum += value
+        self.count += 1
+
+    def as_dict(self) -> Dict[str, object]:
+        return {"les": self.buckets + (float("inf"),),
+                "counts": list(self.counts),
+                "sum": self.sum, "count": self.count}
+
+
+class Histograms:
+    """Thread-safe histogram registry (Prometheus-style bucketed counts).
+
+    ALWAYS on, like :class:`Counters` — the observation sites are rare
+    events (a compile-cache miss, a finished query), so one lock + one
+    bucket increment per observation never shows up on a hot path.
+    Keyed by ``(family, labels)``: one family (e.g. ``compile_seconds``)
+    renders as one Prometheus histogram metric with one ``le`` series per
+    label set.
+    """
+
+    def __init__(self):
+        self._hists: Dict[tuple, _Hist] = {}
+        self._lock = threading.Lock()
+
+    def observe(self, family: str, value: float, buckets=None,
+                **labels) -> None:
+        key = (family, tuple(sorted(labels.items())))
+        with self._lock:
+            h = self._hists.get(key)
+            if h is None:
+                h = self._hists[key] = _Hist(buckets or DEFAULT_BUCKETS)
+            h.observe(float(value))
+
+    def snapshot(self) -> Dict[tuple, Dict[str, object]]:
+        with self._lock:
+            return {k: v.as_dict() for k, v in self._hists.items()}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._hists.clear()
+
+
+histograms = Histograms()
 
 
 def dump_stats(file=None) -> None:

@@ -6,6 +6,13 @@ Prometheus text-format rendering + escaping, ring-buffer bounding, the
 explain()/counters consistency, the gauge stat-family fix, the merged
 stats report, profile()/span() exception safety — and that with tracing
 disabled the event layer records nothing at all.
+
+The mesh/device half (this PR): per-device shard events and Perfetto
+tracks for the distributed ops, self-describing ``traced_query``
+metadata, straggler-ratio mesh sections in ``explain()``, HBM watermark
+sampling (graceful None on CPU; fake devices prove the recording),
+OOM-split watermark tagging, Prometheus histogram families, and the
+``TFT_SLOW_QUERY_MS`` slow-query log.
 """
 
 import json
@@ -18,10 +25,19 @@ import numpy as np
 import pytest
 
 import tensorframes_tpu as tft
+from tensorframes_tpu import dtypes as _dt
 from tensorframes_tpu import observability as obs
+from tensorframes_tpu.computation import Computation, TensorSpec
 from tensorframes_tpu.engine.executor import BlockExecutor
+from tensorframes_tpu.observability import device as obs_device
 from tensorframes_tpu.observability import events as obs_events
+from tensorframes_tpu.parallel.distributed import (daggregate, dfilter,
+                                                   dmap_blocks,
+                                                   dreduce_blocks, dsort,
+                                                   distribute)
+from tensorframes_tpu.parallel.mesh import local_mesh
 from tensorframes_tpu.resilience import faults
+from tensorframes_tpu.shape import Shape, Unknown
 from tensorframes_tpu.utils import tracing
 
 pytestmark = pytest.mark.observability
@@ -32,14 +48,18 @@ def _clean_observability():
     tracing.disable()
     tracing.timings.reset()
     tracing.counters.reset()
+    tracing.histograms.reset()
     obs.clear_ring()
     obs_events._reset_last_query()
+    obs_device._reset()
     yield
     tracing.disable()
     tracing.timings.reset()
     tracing.counters.reset()
+    tracing.histograms.reset()
     obs.clear_ring()
     obs_events._reset_last_query()
+    obs_device._reset()
 
 
 def _depth(monkeypatch, d):
@@ -478,6 +498,385 @@ class TestStatsSatellites:
         out = capsys.readouterr().out
         for name in ("dumped.span", "dumped.gauge", "dumped.counter"):
             assert name in out
+
+
+# ---------------------------------------------------------------------------
+# mesh & device observability
+# ---------------------------------------------------------------------------
+
+def _mesh_comp(factor=2.0):
+    return Computation.trace(
+        lambda x: {"y": x * factor},
+        [TensorSpec("x", _dt.double, Shape(Unknown))])
+
+
+def _mesh_fixture(n=64):
+    mesh = local_mesh()
+    df = tft.frame({"x": np.arange(float(n))})
+    dist = distribute(df, mesh)
+    return mesh, dist
+
+
+class TestMeshObservability:
+    def test_dmap_records_shard_events_and_entry_meta(self):
+        tracing.enable()
+        mesh, dist = _mesh_fixture()
+        S = mesh.num_data_shards
+        dmap_blocks(_mesh_comp(), dist)
+        t = obs.last_query()
+        assert t is not None and t.op == "dmap_blocks"
+        # traced_query entry metadata: self-describing, not a bare name
+        assert t.meta["shards"] == S
+        assert t.meta["mesh_shape"] == dict(mesh.mesh.shape)
+        assert t.meta["fetches"] == ["y"]
+        assert t.meta["rows"] == 64
+        # one shard event and one readiness timing per data shard
+        assert t.count("shard") == S
+        assert t.count("shard_compute") == S
+        assert t.count("mesh_dispatch") == 1
+        s = t.summary()
+        assert s["mesh"] is not None
+        devs = s["mesh"]["devices"]
+        assert set(devs) == set(range(S))
+        assert all(d["rows"] == 64 // S for d in devs.values())
+        assert all(d["bytes"] > 0 for d in devs.values())
+        assert all(d["time_s"] >= 0.0 for d in devs.values())
+
+    def test_chrome_trace_one_track_per_device(self):
+        tracing.enable()
+        mesh, dist = _mesh_fixture()
+        dmap_blocks(_mesh_comp(), dist)
+        t = obs.last_query()
+        doc = json.loads(t.to_chrome_trace())
+        names = {e["args"]["name"] for e in doc["traceEvents"]
+                 if e["ph"] == "M" and e["name"] == "thread_name"}
+        want = {f"device {i}" for i in range(mesh.num_data_shards)}
+        assert want <= names
+        # device events land on device tracks (tid >= DEVICE_TRACK_BASE)
+        dev_tids = {e["tid"] for e in doc["traceEvents"]
+                    if e.get("cat") in ("shard", "shard_compute")}
+        assert dev_tids == {obs.DEVICE_TRACK_BASE + i
+                            for i in range(mesh.num_data_shards)}
+
+    def test_explain_mesh_section_with_straggler_ratio(self):
+        tracing.enable()
+        mesh, dist = _mesh_fixture()
+        dmap_blocks(_mesh_comp(), dist)
+        report = tft.last_query_report()
+        assert "mesh" in report
+        assert "straggler ratio" in report
+        for i in range(mesh.num_data_shards):
+            assert f"device {i}:" in report
+        ratio = obs.last_query().summary()["mesh"]["straggler_ratio"]
+        assert ratio is None or ratio >= 1.0
+
+    def test_skew_warning_above_threshold(self, monkeypatch):
+        monkeypatch.setenv("TFT_SKEW_WARN", "1.5")
+        tracing.enable()
+        with obs.query_trace("skewed") as t:
+            for i in range(4):
+                t.add("shard", device=i, rows=10, bytes=80,
+                      track=obs.DEVICE_TRACK_BASE + i)
+                t.add("shard_compute", device=i, ts=0.0,
+                      dur=1.0 if i == 3 else 0.1,
+                      track=obs.DEVICE_TRACK_BASE + i)
+        report = obs.render(t)
+        assert "WARNING" in report and "imbalance" in report
+        assert t.summary()["mesh"]["straggler_ratio"] == pytest.approx(10.0)
+
+    def test_mesh_ops_record_collectives(self):
+        tracing.enable()
+        mesh, dist = _mesh_fixture()
+        dreduce_blocks({"x": "sum"}, dist)
+        t = obs.last_query()
+        assert t.op == "dreduce_blocks"
+        coll = [e for e in t.events if e.etype == "collective"]
+        assert [e.name for e in coll] == ["psum"]
+        dsort("x", dist)
+        t = obs.last_query()
+        names = {e.name for e in t.events if e.etype == "collective"}
+        assert ({"all_to_all", "ppermute"} <= names
+                or mesh.num_data_shards == 1)
+
+    def test_dfilter_and_daggregate_record_mesh_events(self):
+        tracing.enable()
+        mesh, dist = _mesh_fixture()
+        S = mesh.num_data_shards
+        pred = Computation.trace(
+            lambda x: {"keep": x < 32.0},
+            [TensorSpec("x", _dt.double, Shape(Unknown))])
+        dfilter(pred, dist)
+        t = obs.last_query()
+        assert t.op == "dfilter" and t.count("shard") == S
+        assert t.count("mesh_dispatch") == 1
+        df2 = tft.frame({"k": np.arange(16) % 4,
+                         "v": np.arange(16.0)})
+        dist2 = distribute(df2, mesh)
+        daggregate({"v": "sum"}, dist2, "k")
+        t = obs.last_query()
+        assert t.op == "daggregate"
+        assert t.meta["keys"] == ["k"] and t.meta["fetches"] == ["v"]
+        assert t.count("collective") == 1
+        assert t.count("mesh_dispatch") == 1
+
+    def test_interleaved_queries_distinct_ids_no_track_collisions(self):
+        tracing.enable()
+        mesh, dist = _mesh_fixture(n=32)
+        comp = _mesh_comp()
+        dmap_blocks(comp, dist)  # warm the jit so both workers overlap
+        barrier = threading.Barrier(2)
+
+        def worker(i):
+            barrier.wait()
+            with obs.query_trace(f"interleaved") as t:
+                dmap_blocks(comp, dist)
+            return t
+
+        with ThreadPoolExecutor(max_workers=2) as pool:
+            ts = list(pool.map(worker, range(2)))
+        assert all(t is not None for t in ts)
+        assert ts[0].query_id != ts[1].query_id
+        for t in ts:
+            doc = json.loads(t.to_chrome_trace())
+            evs = [e for e in doc["traceEvents"] if e["ph"] != "M"]
+            # every event carries exactly this trace's correlation id
+            assert {e["args"]["query_id"] for e in evs} == {t.query_id}
+            tracks = [(e["tid"], e["args"]["name"])
+                      for e in doc["traceEvents"]
+                      if e["ph"] == "M" and e["name"] == "thread_name"]
+            assert len(tracks) == len(set(tracks))  # no collisions
+            assert t.count("shard") == mesh.num_data_shards
+
+    def test_ring_allreduce_records_collective_event(self):
+        import jax
+
+        from tensorframes_tpu.parallel.ring import ring_allreduce
+        tracing.enable()
+        mesh = local_mesh()
+        n = mesh.num_data_shards
+        x = jax.device_put(np.arange(float(n * 4)).reshape(n, 4),
+                           mesh.row_sharding(2))
+        with obs.query_trace("ring") as t:
+            out = ring_allreduce(x, mesh)
+        np.testing.assert_allclose(
+            np.asarray(out)[0], np.asarray(x).sum(axis=0))
+        ev = [e for e in t.events if e.etype == "collective"]
+        assert len(ev) == 1 and ev[0].name == "ring_allreduce"
+        assert ev[0].args["hops"] == 2 * (n - 1)
+        assert ev[0].dur is not None and ev[0].dur >= 0.0
+
+    def test_mesh_ops_record_nothing_with_tracing_off(self):
+        assert not tracing.enabled()
+        mesh, dist = _mesh_fixture(n=16)
+        dmap_blocks(_mesh_comp(), dist)
+        dreduce_blocks({"x": "sum"}, dist)
+        assert obs.last_query() is None
+        assert obs.recent_events() == []
+
+
+# ---------------------------------------------------------------------------
+# device memory (HBM watermarks)
+# ---------------------------------------------------------------------------
+
+class _FakeDevice:
+    def __init__(self, live, peak):
+        self._stats = {"bytes_in_use": live, "peak_bytes_in_use": peak}
+
+    def memory_stats(self):
+        return self._stats
+
+
+class TestDeviceMemory:
+    def test_cpu_backend_is_a_graceful_none(self):
+        # the real CPU backend reports nothing (or an empty dict):
+        # sampling must return None and latch off, never raise
+        tracing.enable()
+        with obs.query_trace("probe") as t:
+            got = obs_device.sample(t, "probe")
+        if got is None:
+            assert not obs_device.supported()
+        else:  # a backend that DOES report stats records the event
+            assert t.count("hbm_sample") >= 1
+
+    def test_fake_devices_record_watermarks_in_explain(self, monkeypatch):
+        monkeypatch.setattr(obs_device, "_local_devices",
+                            lambda: [_FakeDevice(100, 300),
+                                     _FakeDevice(50, 200)])
+        obs_device._reset()
+        tracing.enable()
+        df = tft.frame({"x": np.arange(8.0)}, num_partitions=2)
+        out = df.map_blocks(lambda x: {"y": x + 1.0})
+        out.blocks()
+        t = out._trace
+        s = t.summary()
+        assert s["hbm"] is not None
+        assert s["hbm"]["peak"] == 500  # summed across devices
+        assert s["hbm"]["live_start"] == 150
+        report = out.explain()
+        assert "peak HBM" in report
+        # per-device samples land on the device tracks at query start/end
+        per_dev = [e for e in t.events if e.etype == "hbm_sample"
+                   and (e.args or {}).get("device") is not None]
+        assert {e.args["device"] for e in per_dev} == {0, 1}
+
+    def test_oom_split_tagged_with_watermark(self, monkeypatch):
+        monkeypatch.setattr(obs_device, "_local_devices",
+                            lambda: [_FakeDevice(111, 222)])
+        obs_device._reset()
+        tracing.enable()
+        df = tft.frame({"x": np.arange(16.0)}, num_partitions=1)
+        out = df.map_rows(lambda x: {"y": x * 3.0})
+        with faults.inject("oom", fail_n=1):
+            out.blocks()
+        t = out._trace
+        splits = [e for e in t.events if e.etype == "oom_split"]
+        assert splits and splits[0].args["hbm_peak_bytes"] == 222
+        assert splits[0].args["hbm_live_bytes"] == 111
+
+    def test_no_memory_stats_calls_with_tracing_off(self, monkeypatch):
+        calls = []
+
+        def probed():
+            calls.append(1)
+            return []
+
+        monkeypatch.setattr(obs_device, "_local_devices", probed)
+        obs_device._reset()
+        assert not tracing.enabled()
+        df = tft.frame({"x": np.arange(8.0)}, num_partitions=2)
+        df.map_blocks(lambda x: {"y": x + 1.0}).blocks()
+        assert calls == []  # zero-cost-when-off: no device probing at all
+
+
+# ---------------------------------------------------------------------------
+# satellite: Prometheus histograms
+# ---------------------------------------------------------------------------
+
+class TestHistograms:
+    def test_histogram_families_valid_and_cumulative(self, monkeypatch):
+        _traced_map(monkeypatch)  # one compile miss + one finished query
+        text = obs.metrics_text()
+        for line in text.splitlines():
+            if not line or line.startswith("#"):
+                continue
+            assert _PROM_LINE.match(line), line
+        assert "# TYPE tft_query_latency_seconds histogram" in text
+        assert "# TYPE tft_compile_seconds histogram" in text
+        buckets, count = [], None
+        for line in text.splitlines():
+            if line.startswith(
+                    'tft_query_latency_seconds_bucket{op="map_blocks"'):
+                buckets.append(int(line.rsplit(" ", 1)[1]))
+            elif line.startswith(
+                    'tft_query_latency_seconds_count{op="map_blocks"'):
+                count = int(line.rsplit(" ", 1)[1])
+        assert buckets, "no latency buckets rendered"
+        assert buckets == sorted(buckets)  # cumulative le semantics
+        assert buckets[-1] == count == 1   # +Inf bucket equals _count
+        assert 'le="+Inf"' in text
+        assert 'tft_compile_seconds_bucket{engine="jax",le="+Inf"} 1' \
+            in text
+        assert "tft_compile_seconds_sum" in text
+
+    def test_compile_seconds_observed_even_untraced(self, monkeypatch):
+        # the histogram is always-on (like counters): a compile miss with
+        # tracing off still observes
+        _depth(monkeypatch, 1)
+        assert not tracing.enabled()
+        df = tft.frame({"x": np.arange(6.0)}, num_partitions=2)
+        df.map_blocks(lambda x: {"y": x - 1.0}).blocks()
+        snap = tracing.histograms.snapshot()
+        key = ("compile_seconds", (("engine", "jax"),))
+        assert key in snap and snap[key]["count"] >= 1
+
+    def test_counter_and_gauge_output_unchanged(self, monkeypatch):
+        # byte-compatibility: the pre-histogram families render the same
+        _traced_map(monkeypatch)
+        text = obs.metrics_text()
+        assert 'tft_counter_total{name="pipeline.submitted"} 6' in text
+        assert 'tft_gauge{name="pipeline.occupancy",stat="mean"}' in text
+        assert "tft_trace_ring_events" in text
+
+
+# ---------------------------------------------------------------------------
+# satellite: slow-query log
+# ---------------------------------------------------------------------------
+
+class TestSlowQueryLog:
+    def test_logs_jsonl_with_tracing_off(self, monkeypatch, tmp_path):
+        path = tmp_path / "slow.jsonl"
+        monkeypatch.setenv("TFT_SLOW_QUERY_MS", "0")
+        monkeypatch.setenv("TFT_TRACE_FILE", str(path))
+        assert not tracing.enabled()
+        df = tft.frame({"x": np.arange(8.0)}, num_partitions=2)
+        df.map_blocks(lambda x: {"y": x + 1.0}).blocks()
+        recs = [json.loads(line) for line in
+                path.read_text().splitlines()]
+        slow = [r for r in recs if r["type"] == "slow_query"]
+        assert len(slow) == 1  # one condensed line, no event stream
+        assert slow[0]["op"] == "map_blocks"
+        assert slow[0]["duration_ms"] >= 0.0
+        assert "query_id" not in slow[0]  # no trace existed
+
+    def test_includes_summary_fields_when_traced(self, monkeypatch,
+                                                 tmp_path):
+        path = tmp_path / "slow.jsonl"
+        monkeypatch.setenv("TFT_SLOW_QUERY_MS", "0")
+        monkeypatch.setenv("TFT_TRACE_FILE", str(path))
+        tracing.enable()
+        df = tft.frame({"x": np.arange(12.0)}, num_partitions=3)
+        out = df.map_blocks(lambda x: {"y": x + 1.0})
+        out.blocks()
+        recs = [json.loads(line) for line in
+                path.read_text().splitlines()]
+        slow = [r for r in recs if r["type"] == "slow_query"]
+        assert len(slow) == 1
+        assert slow[0]["query_id"] == out._trace.query_id
+        assert slow[0]["blocks"] == 3
+        assert slow[0]["retries"] == 0
+
+    def test_fast_queries_stay_silent(self, monkeypatch, tmp_path):
+        path = tmp_path / "slow.jsonl"
+        monkeypatch.setenv("TFT_SLOW_QUERY_MS", "60000")
+        monkeypatch.setenv("TFT_TRACE_FILE", str(path))
+        df = tft.frame({"x": np.arange(8.0)}, num_partitions=2)
+        df.map_blocks(lambda x: {"y": x + 1.0}).blocks()
+        if path.exists():
+            recs = [json.loads(line) for line in
+                    path.read_text().splitlines()]
+            assert not [r for r in recs if r["type"] == "slow_query"]
+
+    def test_failed_query_marked_in_log_and_histogram(self, monkeypatch,
+                                                      tmp_path):
+        path = tmp_path / "slow.jsonl"
+        monkeypatch.setenv("TFT_SLOW_QUERY_MS", "0")
+        monkeypatch.setenv("TFT_TRACE_FILE", str(path))
+        tracing.enable()
+        with pytest.raises(RuntimeError, match="boom"):
+            with obs.query_trace("doomed"):
+                raise RuntimeError("boom")
+        recs = [json.loads(line) for line in
+                path.read_text().splitlines()]
+        slow = [r for r in recs if r["type"] == "slow_query"]
+        assert slow and slow[0]["error"] == "RuntimeError"
+        key = ("query_latency_seconds",
+               (("op", "doomed"), ("outcome", "error")))
+        assert tracing.histograms.snapshot()[key]["count"] == 1
+        assert obs.last_query().meta["error"] == "RuntimeError"
+        # the tracing-off timer branch carries the marker too
+        tracing.disable()
+        with pytest.raises(ValueError):
+            with obs.query_trace("doomed2"):
+                raise ValueError("x")
+        recs = [json.loads(line) for line in
+                path.read_text().splitlines()]
+        assert any(r.get("op") == "doomed2"
+                   and r.get("error") == "ValueError" for r in recs)
+
+    def test_malformed_threshold_ignored(self, monkeypatch):
+        monkeypatch.setenv("TFT_SLOW_QUERY_MS", "not-a-number")
+        df = tft.frame({"x": np.arange(4.0)}, num_partitions=1)
+        df.map_blocks(lambda x: {"y": x + 1.0}).blocks()  # must not raise
 
 
 # ---------------------------------------------------------------------------
