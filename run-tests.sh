@@ -35,6 +35,15 @@ if [ "${1:-}" = "--observability" ]; then
   exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m observability "$@"
 fi
 
+# --serve: run only the multi-tenant serving lane (tests/test_serve.py:
+# scheduler fairness, admission control, quotas, shared compile cache,
+# slot leasing) — fast, CPU-only, no native build needed
+if [ "${1:-}" = "--serve" ]; then
+  shift
+  echo "== serve lane (pytest -m serve, CPU) =="
+  exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m serve "$@"
+fi
+
 echo "== building native runtime (libtfruntime.so) =="
 make -C native
 

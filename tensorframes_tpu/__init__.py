@@ -39,10 +39,12 @@ from .observability import last_query_report
 from .computation import Computation, TensorSpec, analyze_graph
 from .api import (
     aggregate, analyze, block, explain, filter_rows, frame, map_blocks,
-    map_rows, print_schema, reduce_blocks, reduce_rows, row,
+    map_rows, print_schema, reduce_blocks, reduce_rows, row, submit,
 )
 from . import builder
 from . import io
+from . import serve
+from .serve import serve_report
 
 __all__ = [
     "io",
@@ -76,5 +78,8 @@ __all__ = [
     "observability",
     "last_query_report",
     "dump_stats",
+    "serve",
+    "submit",
+    "serve_report",
     "__version__",
 ]

@@ -28,8 +28,33 @@ from .frame import GroupedFrame, TensorFrame, frame
 __all__ = [
     "map_blocks", "map_rows", "reduce_blocks", "reduce_rows", "aggregate",
     "filter_rows", "analyze", "print_schema", "explain", "block", "row",
-    "frame",
+    "frame", "submit",
 ]
+
+
+def submit(dframe: TensorFrame, fetches=None, *, tenant: str = "default",
+           deadline: Optional[float] = None,
+           est_rows: Optional[float] = None,
+           est_bytes: Optional[int] = None,
+           scheduler=None):
+    """Defer a frame's forcing to the multi-tenant query scheduler.
+
+    Instead of forcing inline (``df.blocks()``), the query — ``dframe``
+    with ``fetches`` applied via ``map_blocks`` when given — joins
+    ``tenant``'s bounded FIFO queue on the process-default
+    :class:`~.serve.QueryScheduler` (or an explicit ``scheduler``) and
+    runs under its weighted-fair selection, HBM admission control, and
+    quotas. Returns a :class:`~.serve.SubmittedQuery` future; a full
+    queue or exhausted rows/sec budget raises a classified
+    :class:`~.resilience.QueueFull` / :class:`~.resilience.OverQuota`
+    immediately. ``deadline`` (seconds) bounds queue wait + execution.
+    See ``docs/serving.md``.
+    """
+    from . import serve as _serve
+    sched = scheduler if scheduler is not None \
+        else _serve.default_scheduler()
+    return sched.submit(dframe, fetches, tenant=tenant, deadline=deadline,
+                        est_rows=est_rows, est_bytes=est_bytes)
 
 
 def map_blocks(fetches, dframe: TensorFrame, trim: bool = False,

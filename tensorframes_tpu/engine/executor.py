@@ -35,9 +35,47 @@ from ..utils.tracing import (counters, enabled as _tracing_enabled,
                              histograms, span)
 
 __all__ = ["BlockExecutor", "PaddingExecutor", "PendingBlock",
-           "default_executor", "default_padding_executor"]
+           "default_executor", "default_padding_executor",
+           "set_computation_interner"]
 
 _log = get_logger("engine.executor")
+
+
+# Shared cross-query compile cache hook (the serving layer's interner):
+# when installed, every run/submit first maps its Computation to a
+# process-canonical equivalent, so two tenants tracing the same `x + 3`
+# land on ONE weak-keyed jit cache entry instead of recompiling per
+# Computation object. One slot, installed by serve.QueryScheduler;
+# None (the default) is zero-cost.
+_comp_interner = None
+
+
+def set_computation_interner(fn):
+    """Install (or clear with ``None``) the computation interner; returns
+    the previous hook so callers can restore it."""
+    global _comp_interner
+    prev = _comp_interner
+    _comp_interner = fn
+    return prev
+
+
+def current_computation_interner():
+    """The installed interner (None when off) — lets an uninstalling
+    owner check it still holds the slot before restoring."""
+    return _comp_interner
+
+
+def _intern(comp: Computation) -> Computation:
+    f = _comp_interner
+    if f is None:
+        return comp
+    try:
+        out = f(comp)
+        return out if out is not None else comp
+    except Exception as e:  # interning is an optimization, never a gate
+        _log.debug("computation interner failed (%s); running the "
+                   "un-interned computation", e)
+        return comp
 
 
 def _oom_split_enabled() -> bool:
@@ -445,6 +483,7 @@ class BlockExecutor:
         falls back to the exact shape; an OOM-shaped error on a row-local
         dispatch re-runs the block as two halves.
         """
+        comp = _intern(comp)
         dev_arrays, n_rows = self._convert_inputs(comp, arrays)
         row_local, pad_to = self._plan_pad(n_rows, pad_ok)
 
@@ -486,6 +525,7 @@ class BlockExecutor:
         ``drain()`` re-runs the block synchronously through :meth:`run`
         and therefore through the full resilience machinery.
         """
+        comp = _intern(comp)
         pad_to = None
         try:
             dev_arrays, n_rows = self._convert_inputs(comp, arrays)

@@ -25,6 +25,7 @@ Validation errors mirror ``Operations.scala:7-15``'s exception taxonomy.
 from __future__ import annotations
 
 import inspect
+import threading
 from typing import (Callable, Dict, List, Mapping, NamedTuple, Optional,
                     Sequence, Tuple, Union)
 
@@ -474,23 +475,30 @@ def cached_map_computation(fetches, schema: Schema,
     """`_map_computation` with reuse keyed weakly by the fetches object —
     the map-side twin of :func:`cached_reduce_computation` (a fresh
     Computation per call would defeat every per-Computation jit/program
-    cache downstream)."""
+    cache downstream). Thread-safe: concurrent queries (the serving
+    layer's workers) racing the same fetches converge on ONE canonical
+    Computation — the per-fetches dict is only read/written under
+    ``_comp_cache_lock`` and the insert is a ``setdefault``, so the loser
+    of a trace race adopts the winner's object (tracing itself runs
+    outside the lock)."""
     sig = ("map", block_level,
            tuple((f.name, f.dtype.name,
                   tuple(f.block_shape.dims) if f.block_shape is not None
                   else None)
                  for f in schema))
     try:
-        per = _fetches_comp_cache.setdefault(fetches, {})
+        with _comp_cache_lock:
+            per = _fetches_comp_cache.setdefault(fetches, {})
+            comp = per.get(sig)
     except TypeError:
         per = None
-    if per is not None:
-        comp = per.get(sig)
-        if comp is not None:
-            return comp
+        comp = None
+    if comp is not None:
+        return comp
     comp = _map_computation(fetches, schema, block_level=block_level)
     if per is not None:
-        per[sig] = comp
+        with _comp_cache_lock:
+            comp = per.setdefault(sig, comp)
     return comp
 
 
@@ -898,30 +906,43 @@ import weakref
 # Computation objects rebuilt per call would defeat per-Computation jit
 # caches (every aggregate with callable fetches would re-trace its device
 # program); this weak cache reuses one Computation per (fetches, schema).
+# All access is under _comp_cache_lock: the cache is shared by every
+# forcing thread once the serving layer multiplexes queries, and a
+# lock-free setdefault would hand two racing threads two different
+# Computation objects — silently doubling every downstream jit cache.
 _fetches_comp_cache: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+_comp_cache_lock = threading.Lock()
+# per-Computation host-fold program cache (an OrderedDict used as an
+# LRU): move_to_end/popitem racing across threads corrupts the order
+# book, so every touch is under this lock (jit compilation is not)
+_hostfold_lock = threading.Lock()
 
 
 def cached_reduce_computation(fetches, value_schema, suffixes,
                               block_level: bool):
     """`_reduce_computation` with reuse keyed weakly by the fetches object
-    (callables); unhashable/unweakrefable fetches build fresh."""
+    (callables); unhashable/unweakrefable fetches build fresh.
+    Thread-safe like :func:`cached_map_computation`: racing threads
+    converge on one canonical Computation."""
     sig = (tuple(suffixes), block_level,
            tuple((f.name, f.dtype.name,
                   tuple(f.block_shape.dims) if f.block_shape is not None
                   else None)
                  for f in value_schema))
     try:
-        per = _fetches_comp_cache.setdefault(fetches, {})
+        with _comp_cache_lock:
+            per = _fetches_comp_cache.setdefault(fetches, {})
+            comp = per.get(sig)
     except TypeError:
         per = None
-    if per is not None:
-        comp = per.get(sig)
-        if comp is not None:
-            return comp
+        comp = None
+    if comp is not None:
+        return comp
     comp = _reduce_computation(fetches, value_schema, suffixes,
                                block_level=block_level)
     if per is not None:
-        per[sig] = comp
+        with _comp_cache_lock:
+            comp = per.setdefault(sig, comp)
     return comp
 
 
@@ -953,16 +974,17 @@ def _aggregate_segmented_fold(comp, fetch_names, fetch_blocks, fact,
             a = _native.convert(a, dd)
         dev_blocks.append(a)
 
-    cache = getattr(comp, "_tft_hostfold_cache", None)
-    if cache is None:
-        cache = comp._tft_hostfold_cache = OrderedDict()
     key = (G, n,
            tuple((f, a.shape, str(a.dtype))
                  for f, a in zip(names, dev_blocks)))
-    fn = cache.get(key)
-    if fn is not None:
-        cache.move_to_end(key)
-    else:
+    with _hostfold_lock:
+        cache = getattr(comp, "_tft_hostfold_cache", None)
+        if cache is None:
+            cache = comp._tft_hostfold_cache = OrderedDict()
+        fn = cache.get(key)
+        if fn is not None:
+            cache.move_to_end(key)
+    if fn is None:
         def pair(av, bv):
             out = comp.fn({f + "_input": jnp.stack([av[f], bv[f]])
                            for f in names})
@@ -1002,9 +1024,13 @@ def _aggregate_segmented_fold(comp, fetch_names, fetch_blocks, fact,
             return single_v(table)
 
         fn = jax.jit(program)
-        cache[key] = fn
-        while len(cache) > 64:
-            cache.popitem(last=False)
+        with _hostfold_lock:
+            # a racing thread may have built the same program; keep the
+            # first so every caller dispatches one shared executable
+            fn = cache.setdefault(key, fn)
+            cache.move_to_end(key)
+            while len(cache) > 64:
+                cache.popitem(last=False)
 
     with span("aggregate.segmented_fold"):
         final = fn(ids_sorted, *dev_blocks)

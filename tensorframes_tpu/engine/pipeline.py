@@ -30,6 +30,14 @@ existing retry / OOM-split / pad-fallback machinery
 path exactly: the ops' unchanged per-block function runs in a plain loop,
 bit-identical to the pre-pipeline engine.
 
+Multi-query composition: when the serving layer installs a
+:class:`SlotPool` (``docs/serving.md``), every pipelined stream leases
+one pool slot per in-flight block, bounding TOTAL cross-query block
+concurrency instead of per-stream depth only; waits are counted in
+``pipeline.slot_waits`` and recorded as ``slot_wait`` trace events. With
+no pool installed (the default, anything outside a serving scheduler)
+the leasing path is a single ``None`` check.
+
 Observability: ``pipeline.submitted`` / ``pipeline.drained`` /
 ``pipeline.sync_fallbacks`` are always-on counters
 (``utils.tracing.counters``); window occupancy is sampled into the
@@ -44,17 +52,19 @@ slot so depth tuning becomes visual.
 
 from __future__ import annotations
 
+import threading
 from collections import deque
 from typing import Callable, List, Optional, Sequence, TypeVar
 
 from ..observability import device as _obs_device
 from ..observability import events as _obs
-from ..resilience import env_int
+from ..resilience import check_deadline, env_int
 from ..utils.logging import get_logger
 from ..utils.tracing import counters, gauge, span
 
 __all__ = ["DEFAULT_DEPTH", "pipeline_depth", "stream_depth", "submit",
-           "run_pipelined", "ReadyResult", "PipelinedExecutor"]
+           "run_pipelined", "ReadyResult", "PipelinedExecutor",
+           "SlotPool", "install_slot_pool", "current_slot_pool"]
 
 _log = get_logger("engine.pipeline")
 
@@ -62,6 +72,59 @@ DEFAULT_DEPTH = 3
 
 B = TypeVar("B")
 R = TypeVar("R")
+
+
+class SlotPool:
+    """A process-wide budget of in-flight pipeline blocks, leased by
+    concurrent query streams.
+
+    Without a pool, N queries racing into the engine each open their own
+    ``TFT_PIPELINE_DEPTH`` window — total in-flight memory scales with
+    whoever shows up. The serving layer installs one pool sized to the
+    machine (``serve.QueryScheduler``: workers x depth by default,
+    ``TFT_SERVE_SLOTS`` overrides) and every pipelined stream leases a
+    slot per in-flight block from it, so cross-query block concurrency is
+    bounded globally, not per caller.
+
+    Deadlock-free by construction: a stream that cannot lease drains its
+    OWN oldest in-flight block first (freeing a slot it holds), and
+    blocks only when it holds none — at which point every held slot
+    belongs to a stream that is computing and will drain. Waiting streams
+    honor the ambient resilience deadline.
+    """
+
+    __slots__ = ("slots", "_sem")
+
+    def __init__(self, slots: int):
+        if slots < 1:
+            raise ValueError(f"SlotPool needs >= 1 slot, got {slots}")
+        self.slots = int(slots)
+        self._sem = threading.Semaphore(self.slots)
+
+    def try_acquire(self, timeout: float = 0.0) -> bool:
+        if timeout <= 0:
+            return self._sem.acquire(blocking=False)
+        return self._sem.acquire(timeout=timeout)
+
+    def release(self) -> None:
+        self._sem.release()
+
+
+_slot_pool: Optional[SlotPool] = None
+
+
+def install_slot_pool(pool: Optional[SlotPool]) -> Optional[SlotPool]:
+    """Install (or clear with ``None``) the process slot pool; returns
+    the previous pool so callers can restore it. Streams snapshot the
+    pool at entry, so a swap mid-stream never mismatches a lease."""
+    global _slot_pool
+    prev = _slot_pool
+    _slot_pool = pool
+    return prev
+
+
+def current_slot_pool() -> Optional[SlotPool]:
+    return _slot_pool
 
 
 def pipeline_depth(explicit: Optional[int] = None) -> int:
@@ -148,52 +211,93 @@ def run_pipelined(blocks: Sequence[B],
         return out0
 
     out: List[R] = []
-    # window entries: (pending, block, index, submit_end_ts)
+    # window entries: (pending, block, index, submit_end_ts, leased)
     window: "deque" = deque()
+    pool = _slot_pool  # snapshot: a mid-stream swap must not mismatch
 
     def drain_one() -> None:
-        pending, b, i, t_sub = window.popleft()
+        pending, b, i, t_sub, leased = window.popleft()
         slot = i % d + 1
-        t0 = 0.0
-        if trace is not None:
-            t0 = trace.clock()
-            # the block's in-flight residency: submit end -> drain start
-            trace.add("block_compute", name=f"compute b{i}", ts=t_sub,
-                      dur=max(t0 - t_sub, 0.0), track=slot, block=i)
-        with span("pipeline.drain"):
-            result = drain_fn(pending, b)
-        out.append(result)
-        counters.inc("pipeline.drained")
-        if trace is not None:
-            rows_out, _ = _obs.block_meta(result)
-            trace.add("block_drain", name=f"drain b{i}", ts=t0,
-                      dur=trace.clock() - t0, track=slot, block=i,
-                      rows_out=rows_out)
-            # HBM watermark around the drain (latched no-op on backends
-            # without memory_stats, e.g. CPU)
-            _obs_device.sample(trace, "block_drain")
+        try:
+            t0 = 0.0
+            if trace is not None:
+                t0 = trace.clock()
+                # the block's in-flight residency: submit end -> drain
+                # start
+                trace.add("block_compute", name=f"compute b{i}", ts=t_sub,
+                          dur=max(t0 - t_sub, 0.0), track=slot, block=i)
+            with span("pipeline.drain"):
+                result = drain_fn(pending, b)
+            out.append(result)
+            counters.inc("pipeline.drained")
+            if trace is not None:
+                rows_out, _ = _obs.block_meta(result)
+                trace.add("block_drain", name=f"drain b{i}", ts=t0,
+                          dur=trace.clock() - t0, track=slot, block=i,
+                          rows_out=rows_out)
+                # HBM watermark around the drain (latched no-op on
+                # backends without memory_stats, e.g. CPU)
+                _obs_device.sample(trace, "block_drain")
+        finally:
+            if leased:
+                pool.release()
 
-    for i, b in enumerate(blocks):
-        t0 = 0.0
-        rows = nbytes = None
+    def lease_slot() -> bool:
+        """One slot from the pool, draining our own window to make room
+        when the pool is exhausted (never deadlocks: a stream holding no
+        slots only waits on streams that are computing)."""
+        if pool is None:
+            return False
+        if pool.try_acquire():
+            return True
+        counters.inc("pipeline.slot_waits")
+        t0 = trace.clock() if trace is not None else 0.0
+        while not pool.try_acquire(timeout=0.05):
+            check_deadline("pipeline.slot")
+            if window:
+                drain_one()
         if trace is not None:
-            rows, nbytes = _obs.block_meta(b)
-            t0 = trace.clock()
-        with span("pipeline.submit"):
-            pending = submit_fn(b)
-        t1 = trace.clock() if trace is not None else 0.0
-        window.append((pending, b, i, t1))
-        counters.inc("pipeline.submitted")
-        gauge("pipeline.occupancy", len(window))
-        if trace is not None:
-            trace.add("block_submit", name=f"submit b{i}", ts=t0,
-                      dur=t1 - t0, track=i % d + 1, block=i, rows=rows,
-                      bytes=nbytes)
-            trace.add("occupancy", value=len(window))
-        if len(window) >= d:
+            trace.add("slot_wait", ts=t0, dur=trace.clock() - t0)
+        return True
+
+    try:
+        for i, b in enumerate(blocks):
+            leased = lease_slot()
+            # everything between the lease and the window.append is
+            # guarded: a failure anywhere here (submit, or even a trace
+            # hook) would otherwise strand the lease outside the window
+            try:
+                t0 = 0.0
+                rows = nbytes = None
+                if trace is not None:
+                    rows, nbytes = _obs.block_meta(b)
+                    t0 = trace.clock()
+                with span("pipeline.submit"):
+                    pending = submit_fn(b)
+                t1 = trace.clock() if trace is not None else 0.0
+            except BaseException:
+                if leased:  # never made it into the window
+                    pool.release()
+                raise
+            window.append((pending, b, i, t1, leased))
+            counters.inc("pipeline.submitted")
+            gauge("pipeline.occupancy", len(window))
+            if trace is not None:
+                trace.add("block_submit", name=f"submit b{i}", ts=t0,
+                          dur=t1 - t0, track=i % d + 1, block=i, rows=rows,
+                          bytes=nbytes)
+                trace.add("occupancy", value=len(window))
+            if len(window) >= d:
+                drain_one()
+        while window:
             drain_one()
-    while window:
-        drain_one()
+    finally:
+        # an error unwinding mid-stream must not leak the undrained
+        # entries' leases (their async work finishes on its own)
+        while window:
+            entry = window.popleft()
+            if entry[4]:
+                pool.release()
     return out
 
 

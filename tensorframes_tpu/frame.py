@@ -423,6 +423,18 @@ class TensorFrame:
         from . import api
         return api.filter_rows(predicate, self, executor=executor)
 
+    def submit(self, fetches=None, *, tenant: str = "default",
+               deadline: Optional[float] = None, **kwargs):
+        """Defer this frame's forcing to the multi-tenant query
+        scheduler (``tft.submit``): queued under ``tenant``'s quotas,
+        admitted against the HBM watermark, executed under the weighted-
+        fair scheduler. Returns a ``serve.SubmittedQuery`` future —
+        ``.result()`` yields the forced frame. See ``docs/serving.md``.
+        """
+        from . import api
+        return api.submit(self, fetches, tenant=tenant, deadline=deadline,
+                          **kwargs)
+
     def limit(self, n: int) -> "TensorFrame":
         """The first ``n`` rows (in block order). Lazy."""
         if n < 0:

@@ -75,17 +75,23 @@ def raw_memory_stats() -> Optional[List[Tuple[int, Dict[str, Any]]]]:
 
 
 def watermark() -> Optional[Dict[str, int]]:
-    """Aggregate ``{"live_bytes", "peak_bytes", "devices"}`` across local
-    devices, or ``None`` when the backend reports nothing."""
+    """Aggregate ``{"live_bytes", "peak_bytes", "limit_bytes",
+    "devices"}`` across local devices, or ``None`` when the backend
+    reports nothing. ``limit_bytes`` is 0 when no device reports an
+    allocator limit — the serving layer's admission control treats that
+    as "no enforceable bound" (``TFT_SERVE_HBM_LIMIT_BYTES`` overrides).
+    """
     stats = raw_memory_stats()
     if stats is None:
         return None
-    live = peak = 0
+    live = peak = limit = 0
     for _, ms in stats:
         live += int(ms.get("bytes_in_use") or 0)
         peak += int(ms.get("peak_bytes_in_use") or ms.get("bytes_in_use")
                     or 0)
-    return {"live_bytes": live, "peak_bytes": peak, "devices": len(stats)}
+        limit += int(ms.get("bytes_limit") or 0)
+    return {"live_bytes": live, "peak_bytes": peak, "limit_bytes": limit,
+            "devices": len(stats)}
 
 
 def sample(trace, tag: str, per_device: bool = False

@@ -42,9 +42,32 @@ from ..utils import tracing
 from ..utils.logging import get_logger
 from . import events as _events
 
-__all__ = ["metrics_text", "serve_metrics", "stop_metrics", "metrics_port"]
+__all__ = ["metrics_text", "serve_metrics", "stop_metrics", "metrics_port",
+           "register_metrics_provider", "unregister_metrics_provider"]
 
 _log = get_logger("observability.metrics")
+
+# extra exposition-line providers (the serving layer's live per-tenant
+# queue/inflight gauges): name -> zero-arg callable returning a list of
+# already-formatted Prometheus text lines. Providers render LIVE state
+# (queue depths change between scrapes), which the counter/span
+# registries cannot express.
+_providers_lock = threading.Lock()
+_providers: dict = {}
+
+
+def register_metrics_provider(name: str, fn) -> None:
+    """Add ``fn() -> list[str]`` to every :func:`metrics_text` render
+    under ``name`` (re-registering a name replaces it). A provider that
+    raises is logged and skipped — it can never take the endpoint down.
+    """
+    with _providers_lock:
+        _providers[name] = fn
+
+
+def unregister_metrics_provider(name: str) -> None:
+    with _providers_lock:
+        _providers.pop(name, None)
 
 
 def _escape_label(value: str) -> str:
@@ -107,6 +130,15 @@ def metrics_text() -> str:
                      f'"{_escape_label(name)}"}} {gauges[name]["count"]}')
 
     lines.extend(_histogram_lines())
+
+    with _providers_lock:
+        providers = list(_providers.items())
+    for pname, fn in providers:
+        try:
+            lines.extend(fn())
+        except Exception as e:
+            _log.warning("metrics provider %r failed (skipped this "
+                         "scrape): %s", pname, e)
 
     lines.append("# HELP tft_trace_ring_events Events currently held in "
                  "the bounded trace ring buffer.")

@@ -24,23 +24,31 @@ can fail:
 
 Consumers: ``parallel/cluster.py`` (bootstrap timeout, retry, graceful
 single-process degradation), ``engine/executor.py`` (dispatch retry,
-exact-shape fallback from bucketed compiles, OOM split-block re-dispatch)
-and ``native_pjrt.py`` (native core dispatch retry). The degradation
-matrix — what falls back versus what fails fast — is documented in
-``docs/resilience.md``.
+exact-shape fallback from bucketed compiles, OOM split-block re-dispatch),
+``native_pjrt.py`` (native core dispatch retry), and ``serve/`` — the
+multi-tenant scheduler's load rejections (:class:`QueueFull`,
+:class:`OverQuota`, :class:`AdmissionDeadline`) are classified here so
+clients and retry loops see ``rejected`` / ``over_quota`` /
+``deadline_admission`` kinds instead of anonymous RuntimeErrors. The
+degradation matrix — what falls back versus what fails fast — is
+documented in ``docs/resilience.md``.
 """
 
-from .classify import is_oom, is_permanent, is_transient
+from .classify import (AdmissionDeadline, OverQuota, QueueFull,
+                       ServeRejected, error_kind, is_oom, is_permanent,
+                       is_transient)
 from .faults import InjectedFault, inject
 from .policy import (DEFAULT_POLICY, ClusterInitError, DeadlineExceeded,
-                     RetryPolicy, deadline, default_policy,
+                     RetryPolicy, check_deadline, deadline, default_policy,
                      env_bool, env_float, env_int, remaining_time)
 from . import faults
 
 __all__ = [
     "RetryPolicy", "DeadlineExceeded", "ClusterInitError",
     "DEFAULT_POLICY", "default_policy", "deadline", "remaining_time",
-    "is_transient", "is_oom", "is_permanent",
+    "check_deadline",
+    "is_transient", "is_oom", "is_permanent", "error_kind",
+    "ServeRejected", "QueueFull", "OverQuota", "AdmissionDeadline",
     "env_bool", "env_float", "env_int",
     "faults", "inject", "InjectedFault",
 ]

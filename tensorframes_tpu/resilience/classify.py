@@ -22,8 +22,51 @@ from __future__ import annotations
 
 import os
 
-__all__ = ["is_transient", "is_oom", "is_permanent",
+__all__ = ["is_transient", "is_oom", "is_permanent", "error_kind",
+           "ServeRejected", "QueueFull", "OverQuota", "AdmissionDeadline",
            "TRANSIENT_MARKERS", "OOM_MARKERS"]
+
+
+class ServeRejected(RuntimeError):
+    """A load-related rejection from the serving layer (``serve/``).
+
+    Unlike the engine's failures these are *policy* decisions: the query
+    never ran, and the classification tells the client whether retrying
+    later may succeed. ``kind`` is the classifier label exported on
+    retry/giveup events and server stats; ``retryable`` feeds
+    :func:`is_transient` (a full queue or an exhausted rows/sec budget
+    clears with time; an admission-deadline shed does not retry itself).
+    """
+
+    kind = "rejected"
+    retryable = True
+
+
+class QueueFull(ServeRejected):
+    """Per-tenant submission queue at its bounded depth (backpressure):
+    the submit is rejected instead of queuing unboundedly. Retryable —
+    the queue drains."""
+
+    kind = "rejected"
+    retryable = True
+
+
+class OverQuota(ServeRejected):
+    """The tenant's rows/sec budget (token bucket) cannot cover the
+    query's estimated rows. Retryable — the bucket refills."""
+
+    kind = "over_quota"
+    retryable = True
+
+
+class AdmissionDeadline(ServeRejected):
+    """Admission control could not clear the query within its wait
+    budget or deadline (estimated HBM footprint would cross the
+    high-water mark): the query is shed instead of OOMing mid-flight.
+    Not transient — the caller decides whether to resubmit."""
+
+    kind = "deadline_admission"
+    retryable = False
 
 # XLA/PJRT status words + socket-layer phrases that indicate the failure
 # was environmental, not the program's fault.
@@ -70,6 +113,8 @@ def is_transient(exc: BaseException) -> bool:
 
     if isinstance(exc, InjectedFault):
         return exc.transient
+    if isinstance(exc, ServeRejected):
+        return exc.retryable  # queue drains / bucket refills; sheds don't
     if is_oom(exc):
         return False  # same program, same memory: split, don't retry
     if isinstance(exc, (ConnectionError, TimeoutError)):
@@ -83,3 +128,18 @@ def is_transient(exc: BaseException) -> bool:
 
 def is_permanent(exc: BaseException) -> bool:
     return not is_transient(exc) and not is_oom(exc)
+
+
+def error_kind(exc: BaseException) -> str:
+    """The classifier's verdict as a stable label: the serving layer's
+    own kinds (``rejected`` / ``over_quota`` / ``deadline_admission``)
+    when the exception carries one, else ``oom`` / ``transient`` /
+    ``permanent``. Exported on retry/giveup trace events and in server
+    stats so dashboards never re-derive the classification."""
+    if isinstance(exc, ServeRejected):
+        return exc.kind
+    if is_oom(exc):
+        return "oom"
+    if is_transient(exc):
+        return "transient"
+    return "permanent"

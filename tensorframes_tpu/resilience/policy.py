@@ -123,13 +123,11 @@ def check_deadline(op: str = "operation") -> None:
 
 def _error_kind(exc: BaseException) -> str:
     """The classifier's verdict as an event label (oom / transient /
-    permanent) — what the retry decision was actually based on."""
-    from .classify import is_oom, is_transient
-    if is_oom(exc):
-        return "oom"
-    if is_transient(exc):
-        return "transient"
-    return "permanent"
+    permanent, plus the serving layer's rejected / over_quota /
+    deadline_admission) — what the retry decision was actually based
+    on."""
+    from .classify import error_kind
+    return error_kind(exc)
 
 
 def env_float(name: str, default: Optional[float]) -> Optional[float]:

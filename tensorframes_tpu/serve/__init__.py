@@ -1,0 +1,41 @@
+"""Multi-tenant query serving: scheduler, admission control, quotas.
+
+The library's serving front end (ROADMAP item 2): everything a server
+needs existed in pieces — correlated query traces, classified errors and
+deadlines, HBM watermarks, a Prometheus endpoint, a bounded pipeline
+window — and this package composes them:
+
+- :class:`QueryScheduler` (:mod:`.scheduler`) — per-tenant bounded FIFO
+  queues with weighted-fair (stride) selection, in-flight slot quotas,
+  rows/sec token buckets, per-query deadlines, HBM admission control
+  (wait-or-shed, never OOM mid-flight), and a process-wide
+  :class:`~..engine.pipeline.SlotPool` bounding cross-query in-flight
+  blocks. Rejections are classified resilience errors
+  (:class:`~..resilience.QueueFull`, :class:`~..resilience.OverQuota`,
+  :class:`~..resilience.AdmissionDeadline`).
+- :class:`SharedCompileCache` (:mod:`.cache`) — structural interning of
+  Computations at the executor boundary, so identical workloads from
+  different tenants (the millionth ``x + 3``) share one compiled
+  program.
+- :class:`ServerStats` / :func:`serve_report` (:mod:`.stats`) — per-
+  tenant outcome totals, live queue/in-flight gauges on the metrics
+  endpoint, p99 from ``query_latency_seconds{tenant=...}``.
+
+Entry points: ``tft.submit(df, tenant=..., deadline=...)`` (the
+process-default scheduler) or an explicit ``QueryScheduler`` as a
+context manager. See ``docs/serving.md``.
+"""
+
+from .cache import SharedCompileCache, computation_signature
+from .scheduler import (QueryScheduler, SubmittedQuery, TenantQuota,
+                        default_scheduler, live_scheduler,
+                        set_default_scheduler, shutdown_default_scheduler)
+from .stats import ServerStats, serve_report
+
+__all__ = [
+    "QueryScheduler", "SubmittedQuery", "TenantQuota",
+    "default_scheduler", "set_default_scheduler",
+    "shutdown_default_scheduler", "live_scheduler",
+    "SharedCompileCache", "computation_signature",
+    "ServerStats", "serve_report",
+]

@@ -1,0 +1,184 @@
+"""Shared cross-query compile cache: structural interning of Computations.
+
+The engine's jit caches are keyed by the live :class:`~..computation.
+Computation` object (weakly, so entries die with the computation). That
+is the right bound for one program run — but a server re-traces the same
+user workload per submission: the millionth tenant sending ``x + 3``
+builds a millionth Computation object, and every one compiles its own
+executable. This module closes that gap with *interning*: a Computation
+is reduced to a **structural signature** — its input/output specs plus
+the jaxpr obtained by tracing with SYMBOLIC leading dimensions (the same
+``_sym_avals`` machinery ``Computation.serialize`` uses) and the bytes of
+any captured array constants — and the first Computation seen with a
+given signature becomes canonical. Later equivalents are swapped for the
+canonical object at the executor boundary
+(:func:`~..engine.executor.set_computation_interner`), so every
+downstream per-Computation cache (jit wrappers, padded variants, native
+programs) is shared automatically, with zero changes to the engine's
+cache structure.
+
+Symbolic tracing is the correctness load-bearing choice: two programs
+that merely coincide at one probe size (``x * x.shape[0]`` at 2 rows vs
+``x * 2.0``) produce DIFFERENT jaxprs under a symbolic row count, so they
+are never merged; a program that cannot trace symbolically is marked
+uncacheable and runs un-interned (counted, never failed).
+
+The cache holds canonical Computations STRONGLY (bounded LRU,
+``TFT_SERVE_COMPILE_CACHE`` entries, default 512): keeping the canonical
+object alive is exactly what keeps the engine's weak-keyed jit entries
+warm across queries.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from collections import OrderedDict
+from typing import Optional
+
+import numpy as np
+
+from ..observability.events import add_event as _obs_event
+from ..resilience import env_int
+from ..utils.logging import get_logger
+from ..utils.tracing import counters
+
+__all__ = ["SharedCompileCache", "computation_signature"]
+
+_log = get_logger("serve.cache")
+
+_SIG_ATTR = "_tft_serve_sig"
+_CANON_ATTR = "_tft_serve_canon"
+
+
+def computation_signature(comp) -> Optional[str]:
+    """The structural signature of a Computation, or ``None`` when it
+    cannot be derived safely (then the computation is uncacheable and
+    must run un-interned). Cached on the object — one symbolic trace per
+    Computation per process."""
+    sig = getattr(comp, _SIG_ATTR, False)
+    if sig is not False:
+        return sig
+    try:
+        sig = _build_signature(comp)
+    except Exception as e:
+        _log.debug("computation signature failed (%s: %s); marking "
+                   "uncacheable", type(e).__name__, e)
+        sig = None
+    try:
+        setattr(comp, _SIG_ATTR, sig)
+    except Exception:
+        _log.debug("could not cache signature on %r", comp)
+    return sig
+
+
+def _build_signature(comp) -> str:
+    import jax
+
+    from ..computation import _sym_avals
+
+    avals, _ = _sym_avals(comp.inputs, share_lead_symbol=True)
+    names = comp.input_names
+
+    def flat(*args):
+        return comp.fn(dict(zip(names, args)))
+
+    closed = jax.make_jaxpr(flat)(*avals)
+    h = hashlib.sha256()
+    for s in comp.inputs:
+        h.update(repr((s.name, s.dtype.name, s.shape.dims)).encode())
+    for s in comp.outputs:
+        h.update(repr((s.name, s.dtype.name, s.shape.dims)).encode())
+    h.update(str(closed.jaxpr).encode())
+    # captured array constants become constvars whose VALUES are not in
+    # the jaxpr text — two programs differing only in a captured table
+    # must not merge
+    for c in closed.consts:
+        a = np.asarray(c)
+        h.update(repr((a.shape, str(a.dtype))).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()
+
+
+class SharedCompileCache:
+    """Signature -> canonical Computation (bounded LRU, thread-safe).
+
+    :meth:`intern` is the executor hook: it returns the canonical
+    equivalent of ``comp`` (possibly ``comp`` itself, registering it).
+    Hit/miss/uncacheable totals are exported through the always-on
+    counters (``serve.compile_cache.*``) and, when a query trace is
+    active, as ``shared_compile_cache`` events — compile seconds
+    themselves stay where they always were, in the engine's
+    ``compile_seconds`` histogram (a shared hit simply never reaches it).
+    """
+
+    def __init__(self, capacity: Optional[int] = None):
+        self.capacity = max(1, capacity if capacity is not None
+                            else env_int("TFT_SERVE_COMPILE_CACHE", 512))
+        self._lock = threading.Lock()
+        self._map: "OrderedDict[str, object]" = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+        self.uncacheable = 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._map)
+
+    def intern(self, comp):
+        # resolved once per Computation OBJECT: later blocks of the same
+        # query short-circuit here, so hits count avoided COMPILES (one
+        # per duplicate computation), not block dispatches — and the
+        # per-block cost is one attribute read, no lock
+        canon = getattr(comp, _CANON_ATTR, None)
+        if canon is not None:
+            return canon
+        sig = computation_signature(comp)
+        if sig is None:
+            with self._lock:
+                self.uncacheable += 1
+            counters.inc("serve.compile_cache.uncacheable")
+            return comp
+        with self._lock:
+            canon = self._map.get(sig)
+            if canon is None or canon is comp:
+                self._map[sig] = comp
+                hit = canon is comp  # re-registering canonical: no count
+                if not hit:
+                    self.misses += 1
+                self._map.move_to_end(sig)
+                while len(self._map) > self.capacity:
+                    self._map.popitem(last=False)
+                canon = comp
+                count_miss = not hit
+                hit = False
+            else:
+                self._map.move_to_end(sig)
+                self.hits += 1
+                hit = True
+                count_miss = False
+        try:
+            # the duplicate holds its canonical strongly: even after an
+            # LRU eviction the engine's weak-keyed jit entries stay alive
+            # as long as any equivalent computation does
+            setattr(comp, _CANON_ATTR, canon)
+        except Exception as e:
+            _log.debug("could not cache canonical on %r: %s", comp, e)
+        if hit:
+            counters.inc("serve.compile_cache.hits")
+            _obs_event("shared_compile_cache", hit=True)
+        elif count_miss:
+            counters.inc("serve.compile_cache.misses")
+            _obs_event("shared_compile_cache", hit=False)
+        return canon
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"entries": len(self._map), "hits": self.hits,
+                    "misses": self.misses,
+                    "uncacheable": self.uncacheable,
+                    "capacity": self.capacity}
+
+    def clear(self) -> None:
+        with self._lock:
+            self._map.clear()

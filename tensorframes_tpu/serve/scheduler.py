@@ -1,0 +1,711 @@
+"""Multi-tenant query scheduling: admission, fairness, quotas, deadlines.
+
+The concurrency story of the library used to end at one forcing thread:
+two callers racing into ``frame.blocks()`` contended blindly over the
+engine. :class:`QueryScheduler` is the serving front end that composes
+the pieces the last four PRs built — correlated query traces
+(observability), classified errors and deadlines (resilience), HBM
+watermarks (observability.device), and the bounded pipeline window
+(engine.pipeline) — into one multiplexing layer:
+
+- **Submission** (:meth:`QueryScheduler.submit`): a query is a lazy
+  frame (+ optional fetches), a tenant id, and an optional deadline. It
+  lands on the tenant's bounded FIFO queue; a full queue rejects
+  immediately with a classified :class:`~..resilience.QueueFull`
+  (backpressure, never unbounded buffering), and an exhausted rows/sec
+  token bucket rejects with :class:`~..resilience.OverQuota`.
+- **Weighted-fair selection** (stride scheduling): each tenant carries a
+  virtual pass incremented by ``1/weight`` per served query; workers
+  always serve the eligible tenant with the smallest pass, so completion
+  shares converge to the weight ratio regardless of arrival order.
+  Eligibility = non-empty queue AND in-flight below the tenant's
+  ``max_inflight`` slot quota.
+- **Admission control**: before a query runs, its estimated block
+  footprint is checked against the HBM high-water mark
+  (``observability.device.watermark()``; fraction
+  ``TFT_SERVE_HBM_FRACTION`` of the allocator limit). A query that would
+  cross the mark WAITS (bounded by ``TFT_SERVE_ADMISSION_WAIT_S`` and
+  its own deadline) and is then **shed** with a classified
+  :class:`~..resilience.AdmissionDeadline` — a policy rejection instead
+  of an OOM mid-flight. Backends that report no memory stats (CPU)
+  admit freely.
+- **Execution**: workers force the frame inside a
+  :func:`~..observability.query_trace` carrying the tenant label (the
+  frame's own forcing joins it, so block/retry/compile events correlate
+  to the serving query) and inside a resilience
+  :func:`~..resilience.deadline` scope, so the engine's retry loops and
+  the pipeline's slot waits honor the query deadline. Total in-flight
+  block concurrency across all queries is bounded by the
+  :class:`~..engine.pipeline.SlotPool` the scheduler installs (workers x
+  pipeline depth by default, ``TFT_SERVE_SLOTS`` overrides).
+- **Shared compile cache**: while a scheduler is live, the engine's
+  executors intern every Computation through a
+  :class:`~.cache.SharedCompileCache`, so identical workloads from
+  different tenants share one compiled program
+  (``serve.compile_cache.hits``).
+
+``workers=0`` builds a *manually driven* scheduler — no threads;
+:meth:`QueryScheduler.step` executes exactly one scheduling decision
+synchronously. Tests and benchmarks use it for deterministic ordering.
+
+Env knobs (all ``TFT_SERVE_*``; see ``docs/serving.md``):
+``TFT_SERVE_WORKERS`` (2), ``TFT_SERVE_QUEUE_DEPTH`` (64 per tenant),
+``TFT_SERVE_INFLIGHT`` (2 per tenant), ``TFT_SERVE_SLOTS``,
+``TFT_SERVE_HBM_FRACTION`` (0.9), ``TFT_SERVE_HBM_LIMIT_BYTES``,
+``TFT_SERVE_ADMISSION_WAIT_S`` (5), ``TFT_SERVE_ADMISSION_POLL_S``
+(0.02), ``TFT_SERVE_SHARED_CACHE`` (1), ``TFT_SERVE_DEADLINE_S``,
+``TFT_SERVE_COMPILE_CACHE`` (512).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Mapping, Optional, Tuple
+
+from ..engine import executor as _executor
+from ..engine import pipeline as _pipeline
+from ..observability import device as _obs_device
+from ..observability import events as _obs
+from ..resilience import (AdmissionDeadline, DeadlineExceeded, OverQuota,
+                          QueueFull, ServeRejected, deadline as _deadline,
+                          env_bool, env_float, env_int, error_kind)
+from ..utils.logging import get_logger
+from ..utils.tracing import counters, gauge, histograms
+from .cache import SharedCompileCache
+
+__all__ = ["TenantQuota", "SubmittedQuery", "QueryScheduler",
+           "default_scheduler", "set_default_scheduler",
+           "shutdown_default_scheduler"]
+
+_log = get_logger("serve.scheduler")
+
+_OUTCOMES = ("submitted", "admitted", "rejected", "over_quota", "shed",
+             "completed", "failed")
+
+
+@dataclasses.dataclass(frozen=True)
+class TenantQuota:
+    """Per-tenant limits; ``None`` fields defer to the ``TFT_SERVE_*``
+    process defaults at registration time.
+
+    ``weight`` shapes the fair share (a weight-2 tenant completes ~2x
+    the queries of a weight-1 tenant under contention); ``max_queue``
+    bounds queued submissions (reject beyond); ``max_inflight`` bounds
+    concurrently running queries; ``rows_per_sec`` is a token bucket
+    over *estimated* rows (burst = one second of budget; a query whose
+    estimate exceeds the burst can never pass and is always rejected
+    over-quota); ``deadline_s`` is the default per-query deadline.
+    """
+
+    weight: float = 1.0
+    max_queue: Optional[int] = None
+    max_inflight: Optional[int] = None
+    rows_per_sec: Optional[float] = None
+    deadline_s: Optional[float] = None
+
+    def __post_init__(self):
+        if self.weight <= 0:
+            raise ValueError(f"weight must be > 0, got {self.weight}")
+        if self.max_queue is not None and self.max_queue < 1:
+            raise ValueError(
+                f"max_queue must be >= 1, got {self.max_queue}")
+        if self.max_inflight is not None and self.max_inflight < 1:
+            # 0 would accept submissions that no worker may ever pick:
+            # an unclassified forever-hang, the exact thing this layer
+            # exists to prevent (pause a tenant by closing its client
+            # path or rejecting at submit, not by wedging its queue)
+            raise ValueError(
+                f"max_inflight must be >= 1, got {self.max_inflight}")
+        if self.rows_per_sec is not None and self.rows_per_sec <= 0:
+            raise ValueError(
+                f"rows_per_sec must be > 0 (omit it for unlimited), "
+                f"got {self.rows_per_sec}")
+        if self.deadline_s is not None and self.deadline_s <= 0:
+            raise ValueError(
+                f"deadline_s must be > 0, got {self.deadline_s}")
+
+
+class _TokenBucket:
+    """Rows/sec budget: refills continuously, burst = 1s of rate."""
+
+    __slots__ = ("rate", "burst", "tokens", "_t")
+
+    def __init__(self, rate: float):
+        self.rate = float(rate)
+        self.burst = float(rate)
+        self.tokens = self.burst
+        self._t = time.monotonic()
+
+    def try_take(self, n: float) -> bool:
+        now = time.monotonic()
+        self.tokens = min(self.burst, self.tokens +
+                          (now - self._t) * self.rate)
+        self._t = now
+        if n <= self.tokens:
+            self.tokens -= n
+            return True
+        return False
+
+
+class SubmittedQuery:
+    """A query accepted onto a tenant queue: a future over its forcing.
+
+    ``result(timeout)`` blocks until the scheduler completes the query,
+    returning the forced frame — or raising the classified error
+    (``DeadlineExceeded``, ``AdmissionDeadline``, or whatever the
+    execution raised). ``state`` is one of ``queued`` / ``running`` /
+    ``done`` / ``failed`` / ``shed`` (admission) / ``rejected``
+    (never ran: scheduler shut down).
+    """
+
+    __slots__ = ("query_id", "tenant", "est_rows", "est_bytes",
+                 "deadline_at", "submitted_at", "started_at",
+                 "finished_at", "state", "_thunk", "_event", "_result",
+                 "_error")
+
+    def __init__(self, query_id: str, tenant: str, thunk: Callable[[], Any],
+                 est_rows: Optional[float], est_bytes: Optional[int],
+                 deadline_at: Optional[float]):
+        self.query_id = query_id
+        self.tenant = tenant
+        self.est_rows = est_rows
+        self.est_bytes = est_bytes
+        self.deadline_at = deadline_at  # monotonic, or None
+        self.submitted_at = time.monotonic()
+        self.started_at: Optional[float] = None
+        self.finished_at: Optional[float] = None
+        self.state = "queued"
+        self._thunk = thunk
+        self._event = threading.Event()
+        self._result: Any = None
+        self._error: Optional[BaseException] = None
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    @property
+    def error(self) -> Optional[BaseException]:
+        return self._error
+
+    def result(self, timeout: Optional[float] = None):
+        if not self._event.wait(timeout):
+            raise TimeoutError(
+                f"query {self.query_id} not finished within {timeout}s "
+                f"(state={self.state})")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+    def _complete(self, result: Any = None,
+                  error: Optional[BaseException] = None) -> None:
+        self.finished_at = time.monotonic()
+        self._result = result
+        self._error = error
+        if error is None:
+            self.state = "done"
+        elif isinstance(error, AdmissionDeadline):
+            self.state = "shed"
+        elif isinstance(error, ServeRejected):
+            self.state = "rejected"
+        else:
+            self.state = "failed"
+        self._event.set()
+
+    def __repr__(self):
+        return (f"SubmittedQuery({self.query_id}, tenant={self.tenant!r}, "
+                f"state={self.state})")
+
+
+class _Tenant:
+    __slots__ = ("name", "weight", "max_queue", "max_inflight", "bucket",
+                 "deadline_s", "queue", "inflight", "vpass", "counts")
+
+    def __init__(self, name: str, quota: TenantQuota):
+        self.name = name
+        self.weight = quota.weight
+        self.max_queue = (quota.max_queue if quota.max_queue is not None
+                          else env_int("TFT_SERVE_QUEUE_DEPTH", 64))
+        self.max_inflight = (quota.max_inflight
+                             if quota.max_inflight is not None
+                             else env_int("TFT_SERVE_INFLIGHT", 2))
+        self.bucket = (_TokenBucket(quota.rows_per_sec)
+                       if quota.rows_per_sec is not None else None)
+        self.deadline_s = (quota.deadline_s if quota.deadline_s is not None
+                           else env_float("TFT_SERVE_DEADLINE_S", None))
+        self.queue: "deque[SubmittedQuery]" = deque()
+        self.inflight = 0
+        self.vpass = 0.0
+        self.counts: Dict[str, int] = {k: 0 for k in _OUTCOMES}
+
+
+def _estimate(frame) -> Tuple[Optional[float], Optional[int]]:
+    """Best-effort (rows, bytes) of a frame: exact when already forced
+    (cached blocks), None otherwise — admission and quotas only enforce
+    what they can measure."""
+    blocks = getattr(frame, "_cache", None)
+    if not blocks:
+        return None, None
+    rows = 0
+    nbytes = 0
+    for b in blocks:
+        r, nb = _obs.block_meta(b)
+        rows += int(r or 0)
+        nbytes += int(nb or 0)
+    return float(rows), nbytes
+
+
+# live schedulers, newest last (serve_report() and the metrics provider
+# read the most recent; entries remove themselves on close)
+_live_lock = threading.Lock()
+_live: List["QueryScheduler"] = []
+
+
+def live_scheduler() -> Optional["QueryScheduler"]:
+    with _live_lock:
+        return _live[-1] if _live else None
+
+
+class QueryScheduler:
+    """See the module docstring. Use as a context manager or call
+    :meth:`close` — the scheduler installs process-wide hooks (slot
+    pool, computation interner, metrics provider) that must be
+    uninstalled."""
+
+    def __init__(self, quotas: Optional[Mapping[str, TenantQuota]] = None,
+                 workers: Optional[int] = None,
+                 slots: Optional[int] = None,
+                 admission: bool = True,
+                 shared_cache: Optional[bool] = None,
+                 name: str = "serve"):
+        self.name = name
+        self._cond = threading.Condition()
+        self._tenants: Dict[str, _Tenant] = {}
+        self._vtime = 0.0
+        self._qid = itertools.count(1)
+        self._open = True
+        self._admission = admission
+        self.workers = (workers if workers is not None
+                        else env_int("TFT_SERVE_WORKERS", 2))
+        if self.workers < 0:
+            raise ValueError(f"workers must be >= 0, got {self.workers}")
+        n_slots = (slots if slots is not None
+                   else env_int("TFT_SERVE_SLOTS",
+                                max(1, self.workers)
+                                * _pipeline.pipeline_depth()))
+        self.slot_pool = _pipeline.SlotPool(max(1, n_slots))
+        use_cache = (shared_cache if shared_cache is not None
+                     else env_bool("TFT_SERVE_SHARED_CACHE", True))
+        self.compile_cache = SharedCompileCache() if use_cache else None
+        for tname, quota in (quotas or {}).items():
+            self._tenants[tname] = _Tenant(tname, quota)
+        self._threads: List[threading.Thread] = []
+        self._install()
+        for i in range(self.workers):
+            t = threading.Thread(target=self._worker,
+                                 name=f"tft-{name}-worker-{i}",
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+        _log.info("QueryScheduler %r: %d worker(s), %d pipeline slot(s), "
+                  "shared compile cache %s", name, self.workers,
+                  self.slot_pool.slots,
+                  "on" if self.compile_cache else "off")
+
+    # -- lifecycle ---------------------------------------------------------
+    def _install(self) -> None:
+        self._prev_pool = _pipeline.install_slot_pool(self.slot_pool)
+        # pin the exact bound method installed: close() restores the
+        # previous hook only if it still owns the slot (overlapping
+        # schedulers closed out of LIFO order must not resurrect a dead
+        # scheduler's pool/interner over a live one's)
+        self._interner_fn = None
+        self._prev_interner = None
+        if self.compile_cache is not None:
+            self._interner_fn = self.compile_cache.intern
+            self._prev_interner = _executor.set_computation_interner(
+                self._interner_fn)
+        from . import stats as _stats
+        _stats.register_scheduler_metrics(self)
+        with _live_lock:
+            _live.append(self)
+
+    def __enter__(self) -> "QueryScheduler":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False
+
+    def close(self, timeout: float = 30.0) -> None:
+        """Stop accepting, fail still-queued queries with a classified
+        rejection, wait for running queries, uninstall the hooks.
+        Idempotent."""
+        with self._cond:
+            if not self._open:
+                return
+            self._open = False
+            orphans: List[SubmittedQuery] = []
+            for t in self._tenants.values():
+                while t.queue:
+                    q = t.queue.popleft()
+                    t.counts["rejected"] += 1
+                    counters.inc("serve.rejected")
+                    orphans.append(q)
+            self._cond.notify_all()
+        for q in orphans:
+            q._complete(error=ServeRejected(
+                f"scheduler {self.name!r} shut down before query "
+                f"{q.query_id} ran"))
+        for t in self._threads:
+            t.join(timeout=timeout)
+        # hook teardown, out-of-order safe: restore the previous hook
+        # only while still the installed owner; otherwise unlink this
+        # scheduler from the restore chain (any live scheduler whose
+        # "previous" is ours must now skip to OUR previous), so a dead
+        # scheduler's pool/interner can never be resurrected later
+        with _live_lock:
+            others = [s for s in _live if s is not self]
+        for s in others:
+            if s._prev_pool is self.slot_pool:
+                s._prev_pool = self._prev_pool
+            if self._interner_fn is not None and \
+                    s._prev_interner is self._interner_fn:
+                s._prev_interner = self._prev_interner
+        if _pipeline.current_slot_pool() is self.slot_pool:
+            _pipeline.install_slot_pool(self._prev_pool)
+        else:
+            _log.warning(
+                "scheduler %r closed out of order: a newer scheduler "
+                "owns the engine hooks; unlinked this one from its "
+                "restore chain", self.name)
+        if self._interner_fn is not None and \
+                _executor.current_computation_interner() \
+                is self._interner_fn:
+            _executor.set_computation_interner(self._prev_interner)
+        from . import stats as _stats
+        _stats.unregister_scheduler_metrics(self)
+        with _live_lock:
+            if self in _live:
+                _live.remove(self)
+        _log.info("QueryScheduler %r closed", self.name)
+
+    # -- tenants -----------------------------------------------------------
+    def register_tenant(self, name: str,
+                        quota: Optional[TenantQuota] = None) -> None:
+        """Register (or re-quota) a tenant explicitly; submitting to an
+        unknown tenant auto-registers it with default quotas.
+        Re-quotaing an ACTIVE tenant keeps its queue, in-flight
+        accounting, fairness pass, and stats — only the limits change."""
+        with self._cond:
+            fresh = _Tenant(name, quota or TenantQuota())
+            t = self._tenants.get(name)
+            if t is None:
+                self._tenants[name] = fresh
+            else:
+                t.weight = fresh.weight
+                t.max_queue = fresh.max_queue
+                t.max_inflight = fresh.max_inflight
+                t.deadline_s = fresh.deadline_s
+                # an idempotent re-quota must not refill the rows/sec
+                # budget: keep the live bucket at an unchanged rate,
+                # and carry spent tokens into a changed one
+                if fresh.bucket is None:
+                    t.bucket = None
+                elif t.bucket is None or \
+                        t.bucket.rate != fresh.bucket.rate:
+                    if t.bucket is not None:
+                        t.bucket.try_take(0.0)  # apply the lazy refill
+                        fresh.bucket.tokens = min(t.bucket.tokens,
+                                                  fresh.bucket.burst)
+                    t.bucket = fresh.bucket
+            self._cond.notify_all()  # eligibility may have widened
+
+    def _tenant(self, name: str) -> _Tenant:
+        t = self._tenants.get(name)
+        if t is None:
+            t = self._tenants[name] = _Tenant(name, TenantQuota())
+        return t
+
+    def tenants(self) -> List[str]:
+        with self._cond:
+            return sorted(self._tenants)
+
+    # -- submission --------------------------------------------------------
+    def submit(self, frame, fetches=None, *, tenant: str = "default",
+               deadline: Optional[float] = None,
+               est_rows: Optional[float] = None,
+               est_bytes: Optional[int] = None) -> SubmittedQuery:
+        """Queue one query: force ``frame`` (after applying ``fetches``
+        via ``map_blocks`` when given) under the tenant's quotas.
+
+        Raises :class:`~..resilience.QueueFull` (bounded queue) or
+        :class:`~..resilience.OverQuota` (rows/sec budget) — both
+        classified, both *before* any work happens. Returns a
+        :class:`SubmittedQuery` future otherwise.
+        """
+        if fetches is None:
+            def thunk(frame=frame):
+                frame.blocks()
+                return frame
+        else:
+            def thunk(frame=frame, fetches=fetches):
+                out = frame.map_blocks(fetches)
+                out.blocks()
+                return out
+        if est_rows is None or est_bytes is None:
+            rows_guess, bytes_guess = _estimate(frame)
+            est_rows = est_rows if est_rows is not None else rows_guess
+            est_bytes = est_bytes if est_bytes is not None else bytes_guess
+        with self._cond:
+            if not self._open:
+                raise RuntimeError(
+                    f"scheduler {self.name!r} is closed")
+            t = self._tenant(tenant)
+            if len(t.queue) >= t.max_queue:
+                t.counts["rejected"] += 1
+                counters.inc("serve.rejected")
+                raise QueueFull(
+                    f"tenant {tenant!r} queue is full "
+                    f"({t.max_queue} queued); retry later (classified "
+                    f"'rejected', transient)")
+            if t.bucket is not None and est_rows:
+                if not t.bucket.try_take(est_rows):
+                    t.counts["over_quota"] += 1
+                    counters.inc("serve.over_quota")
+                    raise OverQuota(
+                        f"tenant {tenant!r} rows/sec budget exhausted "
+                        f"({t.bucket.rate:g} rows/s, query estimated "
+                        f"{est_rows:g} rows); retry later (classified "
+                        f"'over_quota', transient)")
+            dl = deadline if deadline is not None else t.deadline_s
+            q = SubmittedQuery(
+                f"{self.name}-q{next(self._qid)}", tenant, thunk,
+                est_rows, est_bytes,
+                time.monotonic() + dl if dl is not None else None)
+            was_empty = not t.queue
+            t.queue.append(q)
+            if was_empty:
+                # re-activation: an idle tenant must not cash in the
+                # passes it never used (stride scheduling)
+                t.vpass = max(t.vpass, self._vtime)
+            t.counts["submitted"] += 1
+            counters.inc("serve.submitted")
+            gauge("serve.queue_depth", self._queued_locked())
+            self._cond.notify()
+        return q
+
+    # -- selection ---------------------------------------------------------
+    def _queued_locked(self) -> int:
+        return sum(len(t.queue) for t in self._tenants.values())
+
+    def _inflight_locked(self) -> int:
+        return sum(t.inflight for t in self._tenants.values())
+
+    def _pick_locked(self) -> Optional[_Tenant]:
+        best = None
+        for t in self._tenants.values():
+            if not t.queue or t.inflight >= t.max_inflight:
+                continue
+            if best is None or t.vpass < best.vpass:
+                best = t
+        return best
+
+    def _next(self, block: bool) -> Optional[SubmittedQuery]:
+        with self._cond:
+            while True:
+                if not self._open:
+                    return None
+                t = self._pick_locked()
+                if t is not None:
+                    q = t.queue.popleft()
+                    self._vtime = t.vpass
+                    t.vpass += 1.0 / t.weight
+                    t.inflight += 1
+                    gauge("serve.queue_depth", self._queued_locked())
+                    gauge("serve.inflight", self._inflight_locked())
+                    return q
+                if not block:
+                    return None
+                self._cond.wait(timeout=0.1)
+
+    # -- execution ---------------------------------------------------------
+    def _worker(self) -> None:
+        while True:
+            q = self._next(block=True)
+            if q is None:
+                return
+            self._execute(q)
+
+    def step(self) -> bool:
+        """Manually execute ONE scheduling decision (pick the fairest
+        eligible query and run it to completion on the calling thread).
+        Returns False when nothing is eligible. The deterministic drive
+        for ``workers=0`` schedulers (tests, benchmarks, embedding)."""
+        q = self._next(block=False)
+        if q is None:
+            return False
+        self._execute(q)
+        return True
+
+    def _execute(self, q: SubmittedQuery) -> None:
+        t = self._tenants[q.tenant]
+        q.started_at = time.monotonic()
+        q.state = "running"
+        queue_wait = q.started_at - q.submitted_at
+        try:
+            # shed what already missed its deadline while queued: running
+            # it would spend capacity on a result nobody can use
+            if q.deadline_at is not None and \
+                    time.monotonic() >= q.deadline_at:
+                raise DeadlineExceeded(
+                    f"query {q.query_id} (tenant {q.tenant!r}) spent "
+                    f"{queue_wait:.3f}s queued and missed its deadline "
+                    f"before starting")
+            self._admit(q)
+            with self._cond:
+                t.counts["admitted"] += 1
+            counters.inc("serve.admitted")
+            remaining = None
+            if q.deadline_at is not None:
+                remaining = max(q.deadline_at - time.monotonic(), 1e-3)
+            with _obs.query_trace("serve", tenant=q.tenant,
+                                  query=q.query_id) as tr:
+                if tr is not None:
+                    tr.add("sched_start", name=q.query_id,
+                           tenant=q.tenant, queue_wait_s=queue_wait)
+                with _deadline(remaining):
+                    result = q._thunk()
+        except BaseException as e:
+            if isinstance(e, (KeyboardInterrupt, SystemExit)):
+                self._finish(q, t, error=e)
+                raise
+            self._finish(q, t, error=e)
+            return
+        self._finish(q, t, result=result)
+
+    def _admit(self, q: SubmittedQuery) -> None:
+        """HBM admission: wait (bounded) for headroom, else shed."""
+        if not self._admission or not q.est_bytes:
+            return
+        budget = env_float("TFT_SERVE_ADMISSION_WAIT_S", 5.0)
+        poll = env_float("TFT_SERVE_ADMISSION_POLL_S", 0.02)
+        give_up_at = time.monotonic() + max(budget, 0.0)
+        if q.deadline_at is not None:
+            give_up_at = min(give_up_at, q.deadline_at)
+        waited = False
+        while True:
+            headroom = self._hbm_headroom()
+            if headroom is None or q.est_bytes <= headroom:
+                if waited:
+                    counters.inc("serve.admission_waits")
+                return
+            if time.monotonic() >= give_up_at:
+                raise AdmissionDeadline(
+                    f"query {q.query_id} (tenant {q.tenant!r}) shed: "
+                    f"estimated footprint {q.est_bytes} B exceeds HBM "
+                    f"headroom {headroom} B and admission could not "
+                    f"clear within its budget (classified "
+                    f"'deadline_admission')")
+            if not waited:
+                waited = True
+                _obs.add_event("sched_admission_wait", name=q.query_id,
+                               tenant=q.tenant, est_bytes=q.est_bytes)
+            time.sleep(max(poll, 0.001))
+
+    def _hbm_headroom(self) -> Optional[int]:
+        """Bytes below the high-water mark, or None when unenforceable
+        (no memory stats / no limit — e.g. the CPU backend)."""
+        wm = _obs_device.watermark()
+        if wm is None:
+            return None
+        limit = env_int("TFT_SERVE_HBM_LIMIT_BYTES", 0) \
+            or wm.get("limit_bytes") or 0
+        if limit <= 0:
+            return None
+        frac = env_float("TFT_SERVE_HBM_FRACTION", 0.9)
+        return int(limit * frac) - int(wm["live_bytes"])
+
+    def _finish(self, q: SubmittedQuery, t: _Tenant,
+                result: Any = None,
+                error: Optional[BaseException] = None) -> None:
+        q._complete(result=result, error=error)
+        dur = q.finished_at - q.submitted_at  # end-to-end serving latency
+        if error is None:
+            outcome = "ok"
+            key = "completed"
+        else:
+            outcome = error_kind(error)
+            if isinstance(error, AdmissionDeadline):
+                key = "shed"
+            elif isinstance(error, ServeRejected):
+                key = "rejected"
+            else:
+                key = "failed"
+        histograms.observe("query_latency_seconds", dur, op="serve",
+                           tenant=t.name, outcome=outcome)
+        counters.inc(f"serve.{key}")
+        with self._cond:
+            t.inflight -= 1
+            t.counts[key] += 1
+            gauge("serve.inflight", self._inflight_locked())
+            self._cond.notify_all()
+
+    # -- introspection -----------------------------------------------------
+    def snapshot(self) -> Dict[str, Dict[str, Any]]:
+        """Per-tenant live state + outcome totals (one consistent read)."""
+        with self._cond:
+            out: Dict[str, Dict[str, Any]] = {}
+            for name, t in sorted(self._tenants.items()):
+                out[name] = {"weight": t.weight,
+                             "queued": len(t.queue),
+                             "inflight": t.inflight,
+                             "max_queue": t.max_queue,
+                             "max_inflight": t.max_inflight,
+                             **t.counts}
+            return out
+
+    def __repr__(self):
+        state = "open" if self._open else "closed"
+        return (f"QueryScheduler({self.name!r}, {state}, "
+                f"workers={self.workers}, tenants={len(self._tenants)})")
+
+
+# ---------------------------------------------------------------------------
+# process-default scheduler (the `tft.submit()` backend)
+# ---------------------------------------------------------------------------
+
+_default_lock = threading.Lock()
+_default: Optional[QueryScheduler] = None
+
+
+def default_scheduler() -> QueryScheduler:
+    """The lazily-created process default (env-configured); created on
+    first :func:`~..api.submit`."""
+    global _default
+    if _default is None or not _default._open:
+        with _default_lock:
+            if _default is None or not _default._open:
+                _default = QueryScheduler(name="serve")
+    return _default
+
+
+def set_default_scheduler(s: Optional[QueryScheduler]
+                          ) -> Optional[QueryScheduler]:
+    """Swap the process default (does NOT close the old one); returns
+    the previous."""
+    global _default
+    with _default_lock:
+        prev, _default = _default, s
+    return prev
+
+
+def shutdown_default_scheduler() -> None:
+    global _default
+    with _default_lock:
+        s, _default = _default, None
+    if s is not None:
+        s.close()

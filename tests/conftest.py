@@ -48,3 +48,6 @@ def pytest_configure(config):
         "markers", "observability: query-trace/metrics/explain suite "
                    "(run-tests.sh --observability runs this lane "
                    "standalone)")
+    config.addinivalue_line(
+        "markers", "serve: multi-tenant scheduler/admission/quota suite "
+                   "(run-tests.sh --serve runs this lane standalone)")

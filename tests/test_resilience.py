@@ -366,7 +366,10 @@ class TestClusterResilience:
         t0 = time.monotonic()
         with pytest.raises(rz.ClusterInitError):
             cluster.initialize("127.0.0.1:1", 2, 1, timeout=3)
-        assert time.monotonic() - t0 < 3.0
+        # the deadline bounds when the loop STOPS retrying; the attempt
+        # in flight at expiry still finishes (one socket connect, ~ms) —
+        # allow it a margin so a loaded machine can't flake the bound
+        assert time.monotonic() - t0 < 3.5
         assert counters.get("cluster_init.failures") == 1
 
     def test_unreachable_coordinator_degrades_without_require(

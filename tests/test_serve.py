@@ -1,0 +1,675 @@
+"""Multi-tenant serving suite (tier-1; marker ``serve``).
+
+Proves the serving-layer contract end-to-end on CPU:
+
+- the acceptance workload — a mixed 3-tenant (small/medium/large) mix
+  submitted concurrently through ``serve.QueryScheduler`` completes with
+  zero lost or duplicated results vs serial execution, per-tenant
+  fairness within 2x of the configured weights, classified rejections
+  for full queues and exhausted quotas (no hangs, no OOMs), and >= 1
+  cross-tenant shared-compile-cache hit for identical signatures;
+- weighted-fair (stride) selection order, deadline sheds, HBM admission
+  control against fake devices (wait-then-admit and wait-then-shed);
+- the shared compile cache's structural signatures (identical programs
+  merge, different programs never do);
+- pipeline slot leasing (bounded cross-query in-flight blocks, no lease
+  leaks on errors);
+- the engine compile-cache's cross-thread safety (8 threads hammering
+  one executor / the fetches cache compile exactly once per signature);
+- concurrent traced queries: distinct correlation ids, no track
+  collisions, per-tenant latency series;
+- the metrics endpoint: live ``tft_serve_*`` gauges and the
+  ``charset=utf-8`` content type.
+"""
+
+import threading
+import time
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+import tensorframes_tpu as tft
+from tensorframes_tpu import observability as obs
+from tensorframes_tpu import serve
+from tensorframes_tpu.computation import Computation, TensorSpec
+from tensorframes_tpu.dtypes import double
+from tensorframes_tpu.engine import ops as engine_ops
+from tensorframes_tpu.engine import pipeline as engine_pipeline
+from tensorframes_tpu.engine.executor import BlockExecutor
+from tensorframes_tpu.observability import device as obs_device
+from tensorframes_tpu.observability import events as obs_events
+from tensorframes_tpu.resilience import (AdmissionDeadline,
+                                         DeadlineExceeded, OverQuota,
+                                         QueueFull, ServeRejected,
+                                         error_kind, is_permanent,
+                                         is_transient)
+from tensorframes_tpu.serve import (QueryScheduler, SharedCompileCache,
+                                    TenantQuota, computation_signature)
+from tensorframes_tpu.shape import Shape, Unknown
+from tensorframes_tpu.utils import tracing
+
+pytestmark = pytest.mark.serve
+
+
+@pytest.fixture(autouse=True)
+def _clean_serve():
+    tracing.disable()
+    tracing.timings.reset()
+    tracing.counters.reset()
+    tracing.histograms.reset()
+    obs.clear_ring()
+    obs_events._reset_last_query()
+    obs_device._reset()
+    yield
+    serve.shutdown_default_scheduler()
+    tracing.disable()
+    tracing.timings.reset()
+    tracing.counters.reset()
+    tracing.histograms.reset()
+    obs.clear_ring()
+    obs_events._reset_last_query()
+    obs_device._reset()
+    assert engine_pipeline.current_slot_pool() is None
+
+
+def _frame(n, offset=0.0, parts=2):
+    return tft.frame({"x": np.arange(float(n)) + offset},
+                     num_partitions=parts)
+
+
+def _z(forced):
+    return np.concatenate([np.asarray(b.columns["z"])
+                           for b in forced.blocks()])
+
+
+# ---------------------------------------------------------------------------
+# acceptance: the mixed 3-tenant workload
+# ---------------------------------------------------------------------------
+
+class TestMixedWorkloadAcceptance:
+    def test_three_tenant_mix_correct_fair_and_shared(self):
+        """ISSUE 6 acceptance: concurrent submission, zero lost or
+        duplicated results vs serial, classified rejections, >= 1
+        cross-tenant compile-cache hit."""
+        sizes = {"small": 40, "medium": 400, "large": 4000}
+        per_tenant = 6
+        expected = {}
+        for tenant, n in sizes.items():
+            for k in range(per_tenant):
+                expected[(tenant, k)] = np.arange(float(n)) + k + 3.0
+
+        quotas = {t: TenantQuota(weight=2.0 if t == "large" else 1.0,
+                                 max_inflight=2)
+                  for t in sizes}
+        results = {}
+        with QueryScheduler(quotas=quotas, workers=3,
+                            name="accept") as sched:
+            futs = {}
+
+            def submit_all(tenant):
+                n = sizes[tenant]
+                for k in range(per_tenant):
+                    # a FRESH lambda per query: structurally identical,
+                    # distinct objects — the shared cache's job
+                    futs[(tenant, k)] = sched.submit(
+                        _frame(n, offset=k),
+                        lambda x: {"z": x + 3.0}, tenant=tenant)
+
+            threads = [threading.Thread(target=submit_all, args=(t,))
+                       for t in sizes]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join()
+            for key, fut in futs.items():
+                results[key] = _z(fut.result(timeout=120))
+
+            # zero lost, zero duplicated, bit-correct vs serial
+            assert set(results) == set(expected)
+            for key in expected:
+                np.testing.assert_allclose(results[key], expected[key])
+
+            # >= 1 cross-tenant shared-compile hit (18 structurally
+            # identical programs -> 1 canonical computation)
+            cc = sched.compile_cache.stats()
+            assert cc["hits"] >= 1
+            assert cc["misses"] <= 2  # identical signature family
+
+            snap = sched.snapshot()
+            for tenant in sizes:
+                s = snap[tenant]
+                assert s["completed"] == per_tenant
+                assert s["failed"] == s["rejected"] == s["shed"] == 0
+            report = serve.serve_report(sched)
+            assert "shared compile cache" in report
+
+    def test_rejections_are_classified_not_hangs(self):
+        with QueryScheduler(workers=0, name="cls") as sched:
+            sched.register_tenant("t", TenantQuota(max_queue=1))
+            sched.submit(_frame(8), tenant="t")
+            with pytest.raises(QueueFull) as ei:
+                sched.submit(_frame(8), tenant="t")
+            assert error_kind(ei.value) == "rejected"
+            assert is_transient(ei.value)  # retry later is legitimate
+
+            sched.register_tenant("q", TenantQuota(rows_per_sec=10.0))
+            df = _frame(1000)
+            df.cache()  # cached -> rows are estimable
+            with pytest.raises(OverQuota) as ei:
+                sched.submit(df, tenant="q")
+            assert error_kind(ei.value) == "over_quota"
+            assert is_transient(ei.value)
+
+
+# ---------------------------------------------------------------------------
+# weighted fairness
+# ---------------------------------------------------------------------------
+
+class TestFairness:
+    def test_stride_selection_tracks_weights_within_2x(self):
+        quotas = {"a": TenantQuota(weight=1.0),
+                  "b": TenantQuota(weight=1.0),
+                  "c": TenantQuota(weight=2.0)}
+        completion = []
+        with QueryScheduler(quotas=quotas, workers=0,
+                            name="fair") as sched:
+            futs = []
+            for tenant in ("a", "b", "c"):
+                for k in range(8):
+                    futs.append((tenant, sched.submit(
+                        _frame(16, offset=k), lambda x: {"z": x + 1.0},
+                        tenant=tenant)))
+            fut_by_id = {f.query_id: t for t, f in futs}
+            done_before = set()
+            # drive deterministically, one scheduling decision at a time
+            while sched.step():
+                done_now = {f.query_id for _, f in futs if f.done()}
+                for qid in done_now - done_before:
+                    completion.append(fut_by_id[qid])
+                done_before = done_now
+            snap = sched.snapshot()
+            assert all(snap[t]["completed"] == 8 for t in quotas)
+        # in the first 8 completions, shares must be within 2x of the
+        # weight ratio (weights 1:1:2 -> ideal 2:2:4)
+        head = completion[:8]
+        counts = {t: head.count(t) for t in ("a", "b", "c")}
+        total_w = 4.0
+        for t, w in (("a", 1.0), ("b", 1.0), ("c", 2.0)):
+            ideal = 8 * w / total_w
+            assert counts[t] <= 2 * ideal + 1e-9, (counts, t)
+            assert counts[t] >= ideal / 2 - 1e-9, (counts, t)
+
+    def test_idle_tenant_does_not_bank_credit(self):
+        quotas = {"busy": TenantQuota(weight=1.0),
+                  "idle": TenantQuota(weight=1.0)}
+        with QueryScheduler(quotas=quotas, workers=0,
+                            name="bank") as sched:
+            for k in range(6):
+                sched.submit(_frame(8, offset=k), tenant="busy")
+            for _ in range(6):
+                sched.step()
+            # idle arrives late: it must not get 6 consecutive turns
+            futs = []
+            for k in range(3):
+                futs.append(sched.submit(_frame(8, offset=k),
+                                         tenant="idle"))
+                futs.append(sched.submit(_frame(8, offset=k),
+                                         tenant="busy"))
+            first_two = []
+            for _ in range(2):
+                assert sched.step()
+                snap = sched.snapshot()
+                first_two.append((snap["idle"]["completed"],
+                                  snap["busy"]["completed"]))
+            # after two steps, both tenants progressed (no banked burst)
+            idle_done = first_two[-1][0]
+            assert 1 <= idle_done <= 2
+
+
+# ---------------------------------------------------------------------------
+# deadlines and admission control
+# ---------------------------------------------------------------------------
+
+class _FakeDevice:
+    def __init__(self, live, peak, limit):
+        self.stats = {"bytes_in_use": live, "peak_bytes_in_use": peak,
+                      "bytes_limit": limit}
+
+    def memory_stats(self):
+        return self.stats
+
+
+class TestDeadlinesAndAdmission:
+    def test_queued_past_deadline_is_shed_classified(self):
+        with QueryScheduler(workers=0, name="dl") as sched:
+            fut = sched.submit(_frame(8), tenant="t", deadline=0.01)
+            time.sleep(0.05)
+            assert sched.step()
+            with pytest.raises(DeadlineExceeded):
+                fut.result(timeout=1)
+            assert fut.state == "failed"
+            snap = sched.snapshot()
+            assert snap["t"]["failed"] == 1
+
+    def test_admission_sheds_when_no_headroom(self, monkeypatch):
+        monkeypatch.setattr(obs_device, "_local_devices",
+                            lambda: [_FakeDevice(950, 950, 1000)])
+        obs_device._reset()
+        monkeypatch.setenv("TFT_SERVE_ADMISSION_WAIT_S", "0.05")
+        monkeypatch.setenv("TFT_SERVE_ADMISSION_POLL_S", "0.01")
+        with QueryScheduler(workers=0, name="adm") as sched:
+            fut = sched.submit(_frame(8), tenant="t", est_bytes=500)
+            assert sched.step()
+            with pytest.raises(AdmissionDeadline) as ei:
+                fut.result(timeout=1)
+            assert error_kind(ei.value) == "deadline_admission"
+            assert not is_transient(ei.value)
+            assert is_permanent(ei.value)
+            assert fut.state == "shed"
+            assert sched.snapshot()["t"]["shed"] == 1
+
+    def test_admission_waits_for_headroom_then_runs(self, monkeypatch):
+        dev = _FakeDevice(950, 950, 1000)
+        calls = []
+
+        def devices():
+            calls.append(1)
+            if len(calls) >= 3:  # pressure clears on the third poll
+                dev.stats["bytes_in_use"] = 100
+            return [dev]
+
+        monkeypatch.setattr(obs_device, "_local_devices", devices)
+        obs_device._reset()
+        monkeypatch.setenv("TFT_SERVE_ADMISSION_WAIT_S", "5")
+        monkeypatch.setenv("TFT_SERVE_ADMISSION_POLL_S", "0.01")
+        with QueryScheduler(workers=0, name="admw") as sched:
+            fut = sched.submit(_frame(8), lambda x: {"z": x + 1.0},
+                               tenant="t", est_bytes=500)
+            assert sched.step()
+            out = fut.result(timeout=5)
+            np.testing.assert_allclose(_z(out), np.arange(8.0) + 1.0)
+            assert len(calls) >= 3
+            assert tracing.counters.get("serve.admission_waits") == 1
+
+    def test_cpu_backend_admits_freely(self):
+        # no memory stats (the real CPU backend): admission must pass
+        with QueryScheduler(workers=0, name="cpu") as sched:
+            fut = sched.submit(_frame(8), tenant="t",
+                               est_bytes=10 ** 15)
+            assert sched.step()
+            fut.result(timeout=5)
+            assert fut.state == "done"
+
+
+# ---------------------------------------------------------------------------
+# shared compile cache
+# ---------------------------------------------------------------------------
+
+class TestSharedCompileCache:
+    def _comp(self, fn):
+        return Computation.trace(
+            fn, [TensorSpec("x", double, Shape(Unknown))])
+
+    def test_identical_programs_intern_to_one(self):
+        cache = SharedCompileCache(capacity=8)
+        c1 = self._comp(lambda x: {"z": x + 3.0})
+        c2 = self._comp(lambda x: {"z": x + 3.0})
+        assert computation_signature(c1) == computation_signature(c2)
+        assert cache.intern(c1) is c1
+        assert cache.intern(c2) is c1
+        st = cache.stats()
+        assert st["hits"] == 1 and st["misses"] == 1
+
+    def test_different_programs_never_merge(self):
+        cache = SharedCompileCache(capacity=8)
+        add = self._comp(lambda x: {"z": x + 3.0})
+        mul = self._comp(lambda x: {"z": x * 3.0})
+        assert computation_signature(add) != computation_signature(mul)
+        assert cache.intern(add) is add
+        assert cache.intern(mul) is mul
+
+    def test_captured_array_constants_distinguish(self):
+        a = np.arange(4.0)
+        b = np.arange(4.0) + 1.0
+        ca = self._comp(lambda x: {"z": x[:4] + a})
+        cb = self._comp(lambda x: {"z": x[:4] + b})
+        sa, sb = computation_signature(ca), computation_signature(cb)
+        if sa is not None and sb is not None:
+            assert sa != sb
+
+    def test_executor_hook_skips_recompiles(self):
+        ex = BlockExecutor()
+        x = np.arange(32.0)
+        with QueryScheduler(workers=0, name="cc") as sched:
+            c1 = self._comp(lambda x: {"z": x + 7.0})
+            c2 = self._comp(lambda x: {"z": x + 7.0})
+            ex.run(c1, {"x": x})
+            ex.run(c2, {"x": x})  # interned -> same weak-keyed jit entry
+            assert ex.compile_count == 1
+            assert sched.compile_cache.stats()["hits"] >= 1
+        # hook uninstalled on close: a fresh equivalent compiles anew
+        c3 = self._comp(lambda x: {"z": x + 7.0})
+        ex.run(c3, {"x": x})
+        assert ex.compile_count == 2
+
+    def test_lru_bound(self):
+        cache = SharedCompileCache(capacity=2)
+        comps = [self._comp(lambda x, k=float(k): {"z": x + k})
+                 for k in range(4)]
+        for c in comps:
+            cache.intern(c)
+        assert len(cache) == 2
+
+
+# ---------------------------------------------------------------------------
+# pipeline slot leasing
+# ---------------------------------------------------------------------------
+
+class TestSlotLeasing:
+    def test_bounded_cross_stream_in_flight(self, monkeypatch):
+        monkeypatch.setenv("TFT_PIPELINE_DEPTH", "3")
+        pool = engine_pipeline.SlotPool(1)
+        prev = engine_pipeline.install_slot_pool(pool)
+        try:
+            df = _frame(64, parts=8)
+            out = df.map_blocks(lambda x: {"z": x * 2.0}, trim=True)
+            z = np.concatenate([np.asarray(b.columns["z"])
+                                for b in out.blocks()])
+            np.testing.assert_allclose(z, np.arange(64.0) * 2.0)
+            # one slot + depth 3 over 8 blocks MUST have waited
+            assert tracing.counters.get("pipeline.slot_waits") >= 1
+            # all leases returned
+            assert pool._sem.acquire(blocking=False)
+            pool.release()
+        finally:
+            engine_pipeline.install_slot_pool(prev)
+
+    def test_no_lease_leak_on_error(self, monkeypatch):
+        from tensorframes_tpu.resilience import faults
+
+        monkeypatch.setenv("TFT_PIPELINE_DEPTH", "2")
+        pool = engine_pipeline.SlotPool(2)
+        prev = engine_pipeline.install_slot_pool(pool)
+        try:
+            df = _frame(16, parts=4)
+            out = df.map_blocks(lambda x: {"z": x + 1.0}, trim=True)
+            # every dispatch fails permanently: the drain raises with
+            # blocks still in the window — their leases must come back
+            with faults.inject("dispatch", fail_n=100, transient=False):
+                with pytest.raises(Exception):
+                    out.blocks()
+            # both slots must be free again after the failed stream
+            assert pool._sem.acquire(blocking=False)
+            assert pool._sem.acquire(blocking=False)
+            pool.release()
+            pool.release()
+        finally:
+            engine_pipeline.install_slot_pool(prev)
+
+    def test_concurrent_streams_share_the_pool(self, monkeypatch):
+        monkeypatch.setenv("TFT_PIPELINE_DEPTH", "3")
+        pool = engine_pipeline.SlotPool(3)
+        prev = engine_pipeline.install_slot_pool(pool)
+        try:
+            def force(i):
+                df = _frame(96, offset=i, parts=6)
+                out = df.map_blocks(lambda x: {"z": x + 1.0}, trim=True)
+                return np.concatenate(
+                    [np.asarray(b.columns["z"]) for b in out.blocks()])
+
+            with ThreadPoolExecutor(max_workers=4) as tp:
+                outs = list(tp.map(force, range(4)))
+            for i, z in enumerate(outs):
+                np.testing.assert_allclose(z, np.arange(96.0) + i + 1.0)
+            for _ in range(3):
+                assert pool._sem.acquire(blocking=False)
+        finally:
+            engine_pipeline.install_slot_pool(prev)
+
+
+# ---------------------------------------------------------------------------
+# engine compile-cache thread safety (satellite fix)
+# ---------------------------------------------------------------------------
+
+class TestEngineCacheConcurrency:
+    def test_block_executor_8_threads_one_compile_per_signature(self):
+        ex = BlockExecutor()
+        comp = Computation.trace(
+            lambda x: {"z": x * 2.0 + 1.0},
+            [TensorSpec("x", double, Shape(Unknown))])
+        shapes = [16, 32, 64]
+        errors = []
+
+        def hammer(seed):
+            rng = np.random.default_rng(seed)
+            for _ in range(12):
+                n = shapes[int(rng.integers(len(shapes)))]
+                x = np.arange(float(n))
+                out = ex.run(comp, {"x": x})
+                if not np.allclose(out["z"], x * 2.0 + 1.0):
+                    errors.append((seed, n))
+
+        threads = [threading.Thread(target=hammer, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        # one compile per distinct signature, regardless of racing
+        assert ex.compile_count == len(shapes)
+
+    def test_fetches_cache_converges_on_one_computation(self):
+        fetch = lambda x: {"z": x + 5.0}  # noqa: E731 - shared object
+        df = _frame(8)
+        schema = df.schema
+        seen = set()
+        lock = threading.Lock()
+
+        def build():
+            comp = engine_ops.cached_map_computation(
+                fetch, schema, block_level=True)
+            with lock:
+                seen.add(id(comp))
+
+        threads = [threading.Thread(target=build) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(seen) == 1  # all 8 threads share ONE Computation
+
+    def test_concurrent_forcings_through_shared_executor(self):
+        # the serving layer's real access pattern: many frames forced in
+        # parallel through the process-default executors
+        def make_fetch(i):
+            return lambda x: {"z": x - float(i)}
+
+        def work(i):
+            df = _frame(64, offset=i, parts=4)
+            out = df.map_blocks(make_fetch(i), trim=True)
+            z = np.concatenate([np.asarray(b.columns["z"])
+                                for b in out.blocks()])
+            np.testing.assert_allclose(z, np.arange(64.0))
+
+        with ThreadPoolExecutor(max_workers=8) as tp:
+            list(tp.map(work, range(16)))
+
+
+# ---------------------------------------------------------------------------
+# concurrent traced queries under the scheduler (satellite)
+# ---------------------------------------------------------------------------
+
+class TestConcurrentTracedQueries:
+    def test_distinct_ids_no_track_collisions_fair_completion(
+            self, monkeypatch):
+        monkeypatch.setenv("TFT_PIPELINE_DEPTH", "3")
+        tracing.enable()
+        tenants = ["t0", "t1", "t2"]
+        per = 4
+        quotas = {t: TenantQuota(weight=1.0) for t in tenants}
+        with QueryScheduler(quotas=quotas, workers=3,
+                            name="traced") as sched:
+            futs = {}
+            for t in tenants:
+                for k in range(per):
+                    futs[(t, k)] = sched.submit(
+                        _frame(60, offset=k, parts=5),
+                        lambda x: {"z": x + 2.0}, tenant=t)
+            for (t, k), fut in futs.items():
+                z = _z(fut.result(timeout=120))
+                np.testing.assert_allclose(z,
+                                           np.arange(60.0) + k + 2.0)
+            snap = sched.snapshot()
+            # fair completion: equal weights -> equal shares (exactly,
+            # since every query completed)
+            done = [snap[t]["completed"] for t in tenants]
+            assert done == [per] * len(tenants)
+
+        # distinct correlation ids: one per serving query
+        events = obs.recent_events()
+        serve_starts = [e for e in events if e["type"] == "sched_start"]
+        qids = {e["query_id"] for e in serve_starts}
+        assert len(serve_starts) == len(tenants) * per
+        assert len(qids) == len(tenants) * per  # no id reuse
+        # no track collisions: per query, block events stay on the slot
+        # tracks (1..depth) or device tracks; track 0 is the query span
+        by_query = {}
+        for e in events:
+            if e["type"] in ("block_submit", "block_drain", "block_run"):
+                by_query.setdefault(e["query_id"], set()).add(e["track"])
+        for qid, tracks in by_query.items():
+            assert all(
+                1 <= tr <= 3 or tr >= obs.DEVICE_TRACK_BASE
+                for tr in tracks), (qid, tracks)
+        # per-tenant latency series exist for the p99 surface
+        fams = {k[1] for k in tracing.histograms.snapshot()
+                if k[0] == "query_latency_seconds"}
+        labelled = {dict(lab).get("tenant") for lab in fams}
+        assert set(tenants) <= labelled
+
+
+# ---------------------------------------------------------------------------
+# metrics endpoint (satellite)
+# ---------------------------------------------------------------------------
+
+class TestServeMetrics:
+    def test_live_gauges_and_charset(self):
+        with QueryScheduler(workers=0, name="met") as sched:
+            sched.register_tenant("alpha", TenantQuota(max_queue=4))
+            sched.submit(_frame(8), tenant="alpha")
+            text = obs.metrics_text()
+            assert 'tft_serve_queue_depth{tenant="alpha"} 1' in text
+            assert 'tft_serve_inflight{tenant="alpha"} 0' in text
+            assert ('tft_serve_queries_total{tenant="alpha",'
+                    'outcome="submitted"} 1') in text
+            port = obs.serve_metrics(0)
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/metrics",
+                        timeout=5) as resp:
+                    ctype = resp.headers.get("Content-Type", "")
+                    body = resp.read().decode("utf-8")
+                assert "charset=utf-8" in ctype
+                assert "tft_serve_queue_depth" in body
+            finally:
+                obs.stop_metrics()
+            # draining the queue zeroes the live gauge
+            assert sched.step()
+            text = obs.metrics_text()
+            assert 'tft_serve_queue_depth{tenant="alpha"} 0' in text
+        # provider unregistered with the scheduler
+        assert "tft_serve_queue_depth" not in obs.metrics_text()
+
+    def test_provider_failure_never_breaks_the_endpoint(self):
+        from tensorframes_tpu.observability import metrics as obs_metrics
+
+        obs_metrics.register_metrics_provider(
+            "boom", lambda: (_ for _ in ()).throw(RuntimeError("x")))
+        try:
+            text = obs.metrics_text()
+            assert "tft_counter_total" in text  # still renders
+        finally:
+            obs_metrics.unregister_metrics_provider("boom")
+
+
+# ---------------------------------------------------------------------------
+# API entry points and lifecycle
+# ---------------------------------------------------------------------------
+
+class TestApiAndLifecycle:
+    def test_tft_submit_and_frame_submit(self):
+        df = _frame(16)
+        fut = tft.submit(df, lambda x: {"z": x + 9.0}, tenant="api")
+        np.testing.assert_allclose(_z(fut.result(timeout=60)),
+                                   np.arange(16.0) + 9.0)
+        fut2 = _frame(8).submit(tenant="api")
+        forced = fut2.result(timeout=60)
+        assert forced.count() == 8
+        assert "api" in serve.serve_report()
+        serve.shutdown_default_scheduler()
+
+    def test_close_fails_queued_queries_classified(self):
+        sched = QueryScheduler(workers=0, name="close")
+        fut = sched.submit(_frame(8), tenant="t")
+        sched.close()
+        with pytest.raises(ServeRejected):
+            fut.result(timeout=1)
+        # the three stats surfaces agree: state, per-tenant counts, and
+        # the flat counter all say "rejected"
+        assert fut.state == "rejected"
+        assert sched.snapshot()["t"]["rejected"] == 1
+        assert tracing.counters.get("serve.rejected") == 1
+        with pytest.raises(RuntimeError):
+            sched.submit(_frame(8), tenant="t")
+        sched.close()  # idempotent
+
+    def test_requota_active_tenant_keeps_queue_and_inflight(self):
+        with QueryScheduler(workers=0, name="requota") as sched:
+            sched.register_tenant("t", TenantQuota(max_queue=1))
+            fut = sched.submit(_frame(8), lambda x: {"z": x + 1.0},
+                               tenant="t")
+            # re-quota while a query is queued: the queue must survive
+            sched.register_tenant("t", TenantQuota(max_queue=8,
+                                                   weight=3.0))
+            assert sched.snapshot()["t"]["queued"] == 1
+            for _ in range(3):  # widened cap admits more
+                sched.submit(_frame(4), tenant="t")
+            assert sched.step()
+            np.testing.assert_allclose(_z(fut.result(timeout=30)),
+                                       np.arange(8.0) + 1.0)
+            while sched.step():
+                pass
+            snap = sched.snapshot()
+            assert snap["t"]["completed"] == 4
+            assert snap["t"]["inflight"] == 0  # accounting intact
+
+    def test_scheduler_restores_previous_hooks(self):
+        pool = engine_pipeline.SlotPool(7)
+        prev = engine_pipeline.install_slot_pool(pool)
+        try:
+            with QueryScheduler(workers=0, name="nest"):
+                assert engine_pipeline.current_slot_pool() is not pool
+            assert engine_pipeline.current_slot_pool() is pool
+        finally:
+            engine_pipeline.install_slot_pool(prev)
+
+    def test_out_of_order_close_keeps_live_scheduler_hooks(self):
+        from tensorframes_tpu.engine import executor as engine_executor
+
+        a = QueryScheduler(workers=0, name="older")
+        b = QueryScheduler(workers=0, name="newer")
+        try:
+            # closing the OLDER scheduler first must not strip the live
+            # newer one of its slot pool or interner, nor resurrect the
+            # older one's on b.close()
+            a.close()
+            assert engine_pipeline.current_slot_pool() is b.slot_pool
+            assert engine_executor.current_computation_interner() \
+                is b._interner_fn
+        finally:
+            b.close()
+        assert engine_pipeline.current_slot_pool() is None
+        assert engine_executor.current_computation_interner() is None

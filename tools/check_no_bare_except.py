@@ -7,11 +7,14 @@
    classifier. ``except Exception`` (or a narrower type) is always
    available instead.
 
-2. No ``except Exception: pass`` under ``tensorframes_tpu/observability/``:
-   the observability layer is the last place a failure may vanish
-   silently — an event sink or metrics endpoint that swallows an error
-   without at least logging it hides exactly the evidence it exists to
-   surface. Handle it or log it (``_log.debug`` is enough).
+2. No ``except Exception: pass`` under ``tensorframes_tpu/observability/``
+   or ``tensorframes_tpu/serve/``: the observability layer is the last
+   place a failure may vanish silently — an event sink or metrics
+   endpoint that swallows an error without at least logging it hides
+   exactly the evidence it exists to surface — and the serving layer's
+   whole contract is CLASSIFIED failure (a scheduler that silently eats
+   an error turns a rejection into a hang). Handle it or log it
+   (``_log.debug`` is enough).
 
 AST-based, so strings and comments never false-positive.
 """
@@ -21,7 +24,8 @@ import pathlib
 import sys
 
 ROOT = pathlib.Path(__file__).resolve().parent.parent / "tensorframes_tpu"
-OBS_ROOT = ROOT / "observability"
+# packages where `except Exception: pass` (silent swallow) is also banned
+STRICT_ROOTS = (ROOT / "observability", ROOT / "serve")
 
 
 def _is_exception_name(node) -> bool:
@@ -50,7 +54,7 @@ def main() -> int:
         except SyntaxError as e:
             bad.append(f"{path}:{e.lineno}: syntax error: {e.msg}")
             continue
-        in_obs = OBS_ROOT in path.parents
+        in_strict = any(r in path.parents for r in STRICT_ROOTS)
         for node in ast.walk(tree):
             if not isinstance(node, ast.ExceptHandler):
                 continue
@@ -59,11 +63,12 @@ def main() -> int:
                     f"{path}:{node.lineno}: bare 'except:' — catch "
                     f"'Exception' (or narrower) so the resilience "
                     f"classifier can see what failed")
-            elif in_obs and _swallows_silently(node):
+            elif in_strict and _swallows_silently(node):
                 bad.append(
                     f"{path}:{node.lineno}: 'except Exception: pass' — "
-                    f"the observability layer must not swallow errors "
-                    f"silently; log the failure (or catch narrower)")
+                    f"the observability/serving layers must not swallow "
+                    f"errors silently; log the failure (or catch "
+                    f"narrower)")
     for line in bad:
         print(line, file=sys.stderr)
     if bad:
