@@ -44,6 +44,15 @@ if [ "${1:-}" = "--serve" ]; then
   exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m serve "$@"
 fi
 
+# --stream: run only the streaming lane (tests/test_stream.py: block
+# sources, finite equivalence, windows/watermarks, poisoned-batch
+# isolation, bounded state) — fast, CPU-only, no native build needed
+if [ "${1:-}" = "--stream" ]; then
+  shift
+  echo "== stream lane (pytest -m stream, CPU) =="
+  exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m stream "$@"
+fi
+
 echo "== building native runtime (libtfruntime.so) =="
 make -C native
 

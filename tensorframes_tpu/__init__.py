@@ -44,6 +44,7 @@ from .api import (
 from . import builder
 from . import io
 from . import serve
+from . import stream
 from .serve import serve_report
 
 __all__ = [
@@ -81,5 +82,6 @@ __all__ = [
     "serve",
     "submit",
     "serve_report",
+    "stream",
     "__version__",
 ]

@@ -391,12 +391,26 @@ def map_rows(fetches: Fetches, df: TensorFrame,
     in_names = comp.input_names
     fetch_names = comp.output_names
 
-    vcomp = Computation(
-        lambda d: jax.vmap(comp.fn)(d),
-        [TensorSpec(s.name, s.dtype, s.shape.prepend(Unknown))
-         for s in comp.inputs],
-        [TensorSpec(s.name, s.dtype, s.shape.prepend(Unknown))
-         for s in comp.outputs])
+    # the vmapped twin is cached ON the computation: a fresh Computation
+    # per call would defeat every per-Computation jit cache downstream —
+    # repeated map_rows over the same comp (the streaming layer maps one
+    # comp across every batch) must re-dispatch one compiled program, not
+    # re-trace per call. Benign race: two threads building it construct
+    # equal twins and the setdefault-style getattr keeps one winner.
+    vcomp = getattr(comp, "_tft_vmapped", None)
+    if vcomp is None:
+        vcomp = Computation(
+            lambda d: jax.vmap(comp.fn)(d),
+            [TensorSpec(s.name, s.dtype, s.shape.prepend(Unknown))
+             for s in comp.inputs],
+            [TensorSpec(s.name, s.dtype, s.shape.prepend(Unknown))
+             for s in comp.outputs])
+        with _comp_cache_lock:
+            prior = getattr(comp, "_tft_vmapped", None)
+            if prior is None:
+                comp._tft_vmapped = vcomp
+            else:
+                vcomp = prior
 
     def attach_outputs(b: Block, out: Dict[str, np.ndarray]) -> Block:
         cols = dict(b.columns)

@@ -105,7 +105,9 @@ def _column_to_numpy(col, name: str):
 
 def read_parquet(path: str, columns: Optional[Sequence[str]] = None,
                  num_partitions: Optional[int] = None,
-                 pad_ragged=False) -> TensorFrame:
+                 pad_ragged=False,
+                 row_group_offset: int = 0,
+                 row_group_limit: Optional[int] = None) -> TensorFrame:
     """Read a parquet file into a TensorFrame, row groups → partitions.
 
     ``num_partitions=None`` keeps the file's row-group structure (the
@@ -116,6 +118,15 @@ def read_parquet(path: str, columns: Optional[Sequence[str]] = None,
     ragged column at load (``pad_column`` semantics: dense ``[rows, L]``
     plus ``_mask``/``_len`` columns); a sequence of names pads just
     those.
+
+    ``row_group_offset`` skips the first N row groups — only groups at
+    index >= offset are read (one footer read, no data touched for the
+    skipped groups); ``row_group_limit`` caps how many groups are read
+    from there. The incremental-read primitives behind
+    ``stream.ParquetTailSource``: a tail re-poll reads only what was
+    appended, and a limit of 1 pinpoints an unreadable group. An offset
+    at/past the end returns an EMPTY frame whose columns are still
+    typed from the parquet schema.
     """
     import pyarrow as pa
     import pyarrow.parquet as pq
@@ -125,11 +136,20 @@ def read_parquet(path: str, columns: Optional[Sequence[str]] = None,
             f"read_parquet needs pyarrow >= 11 (found {pa.__version__}): "
             f"it relies on ParquetFile context management and "
             f"Schema.empty_table")
+    if row_group_offset < 0:
+        raise ValueError(
+            f"row_group_offset must be >= 0, got {row_group_offset}")
+    if row_group_limit is not None and row_group_limit < 1:
+        raise ValueError(
+            f"row_group_limit must be >= 1, got {row_group_limit}")
     with pq.ParquetFile(path) as pf:
         names = list(columns) if columns is not None else [
             c for c in pf.schema_arrow.names]
         blocks: List[dict] = []
-        for rg in range(pf.num_row_groups):
+        end_group = pf.num_row_groups
+        if row_group_limit is not None:
+            end_group = min(end_group, row_group_offset + row_group_limit)
+        for rg in range(row_group_offset, end_group):
             tbl = pf.read_row_group(rg, columns=names)
             blocks.append({n: _column_to_numpy(tbl.column(n), n)
                            for n in names})
@@ -235,38 +255,45 @@ def read_parquet(path: str, columns: Optional[Sequence[str]] = None,
     return first
 
 
+def _frame_block_to_table(b, schema):
+    """One frame Block -> a pyarrow Table (shared by :func:`write_parquet`
+    and the streaming ``ParquetSink`` appender)."""
+    import pyarrow as pa
+
+    arrays = {}
+    for name in schema.names:
+        if b.is_ragged(name):
+            # ragged 1-d cells -> a variable-length list column
+            cells = b.columns[name]
+            if any(np.asarray(c).ndim != 1 for c in cells):
+                raise ValueError(
+                    f"column {name!r}: only 1-d ragged cells map "
+                    f"to parquet lists")
+            arrays[name] = pa.array(
+                [np.asarray(c).tolist() for c in cells])
+            continue
+        a = b.dense(name)
+        if a.ndim == 1:
+            arrays[name] = pa.array(a.tolist() if a.dtype == object
+                                    else a)
+        elif a.ndim == 2:
+            arrays[name] = pa.FixedSizeListArray.from_arrays(
+                pa.array(a.reshape(-1)), a.shape[1])
+        else:
+            raise ValueError(
+                f"column {name!r}: rank-{a.ndim} cells do not map "
+                f"to parquet; flatten first")
+    return pa.table(arrays)
+
+
 def write_parquet(df: TensorFrame, path: str) -> None:
     """Write a TensorFrame to parquet, partitions → row groups."""
-    import pyarrow as pa
     import pyarrow.parquet as pq
 
     writer = None
     try:
         for b in df.blocks():
-            arrays = {}
-            for name in df.schema.names:
-                if b.is_ragged(name):
-                    # ragged 1-d cells -> a variable-length list column
-                    cells = b.columns[name]
-                    if any(np.asarray(c).ndim != 1 for c in cells):
-                        raise ValueError(
-                            f"column {name!r}: only 1-d ragged cells map "
-                            f"to parquet lists")
-                    arrays[name] = pa.array(
-                        [np.asarray(c).tolist() for c in cells])
-                    continue
-                a = b.dense(name)
-                if a.ndim == 1:
-                    arrays[name] = pa.array(a.tolist() if a.dtype == object
-                                            else a)
-                elif a.ndim == 2:
-                    arrays[name] = pa.FixedSizeListArray.from_arrays(
-                        pa.array(a.reshape(-1)), a.shape[1])
-                else:
-                    raise ValueError(
-                        f"column {name!r}: rank-{a.ndim} cells do not map "
-                        f"to parquet; flatten first")
-            tbl = pa.table(arrays)
+            tbl = _frame_block_to_table(b, df.schema)
             if writer is None:
                 writer = pq.ParquetWriter(path, tbl.schema)
             writer.write_table(tbl)

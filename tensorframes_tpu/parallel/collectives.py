@@ -38,17 +38,19 @@ class Combiner(NamedTuple):
 
 
 def _neutral_min(dt):
-    dt = np.dtype(dt)
-    if np.issubdtype(dt, np.floating):
+    dtn = np.dtype(dt)
+    # ml_dtypes floats (bfloat16) register with kind 'V': they are
+    # floating for neutral-element purposes, but issubdtype says no
+    if np.issubdtype(dtn, np.floating) or dtn.kind == "V":
         return np.array(np.inf, dt)
-    return np.array(np.iinfo(dt).max, dt)
+    return np.array(np.iinfo(dtn).max, dt)
 
 
 def _neutral_max(dt):
-    dt = np.dtype(dt)
-    if np.issubdtype(dt, np.floating):
+    dtn = np.dtype(dt)
+    if np.issubdtype(dtn, np.floating) or dtn.kind == "V":
         return np.array(-np.inf, dt)
-    return np.array(np.iinfo(dt).min, dt)
+    return np.array(np.iinfo(dtn).min, dt)
 
 
 COMBINERS: Dict[str, Combiner] = {

@@ -29,6 +29,7 @@ oom          ``engine.executor.BlockExecutor`` dispatch, OOM-shaped
 drain        ``engine.executor.PendingBlock.drain`` pipelined readback
 pjrt_execute ``native_pjrt.PjrtBlockExecutor`` native-core dispatch
 dmap         ``parallel.distributed.dmap_blocks`` mesh dispatch
+batch        ``stream.runtime.StreamHandle`` per-batch processing
 ========== ===========================================================
 
 Counting is deterministic (a lock-guarded integer per site, decremented

@@ -1,0 +1,176 @@
+"""StreamingFrame: the relational surface over a block source.
+
+A :class:`StreamingFrame` is a :class:`~.source.BlockSource` plus a
+chain of per-batch transformations. The row-local relational ops —
+``map_blocks`` / ``map_rows`` / ``filter_rows`` / ``select`` — are the
+SAME ops the finite engine runs (``engine.ops``), applied batch by
+batch, with two streaming-specific guarantees:
+
+- **definition-time resolution**: fetches are adapted to a canonical
+  :class:`~..computation.Computation` ONCE, when the op is chained
+  (through ``engine.ops.cached_map_computation``, the same cache the
+  batch path and the serving layer's interner use) — so every batch
+  re-dispatches the same compiled program instead of re-tracing.
+  Schema validation happens here too: a bad fetch fails when the stream
+  is DEFINED, not on batch 1.
+- **finite equivalence**: because each batch runs through the unchanged
+  engine ops, streaming a finite frame through any chain of these ops
+  produces bit-identical results (ordering included) to the batch
+  ``TensorFrame`` path — the contract ``tests/test_stream.py`` asserts
+  op by op.
+
+``group_by(...)`` hands off to the incremental keyed-aggregation layer
+(:mod:`.aggregate`); ``start()`` builds the pump
+(:class:`~.runtime.StreamHandle`) that actually drives batches.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence, Tuple
+
+from ..engine import ops as _ops
+from ..frame import TensorFrame
+from ..schema import Schema
+
+__all__ = ["StreamingFrame", "GroupedStream"]
+
+
+class StreamingFrame:
+    """A lazily-described stream of blocks with per-batch relational ops.
+
+    Construct from a source (``stream.from_source(src)`` or directly);
+    chain ops like a ``TensorFrame``; then ``start()`` to pump batches.
+    Transformations share the upstream source object — one stream
+    definition is driven by one handle at a time.
+    """
+
+    def __init__(self, source, schema: Optional[Schema] = None,
+                 transforms: Tuple[Callable[[TensorFrame], TensorFrame],
+                                   ...] = (),
+                 plan: Optional[str] = None):
+        self.source = source
+        self._schema = schema if schema is not None else source.schema
+        self._transforms = tuple(transforms)
+        self._plan = plan or f"stream({type(source).__name__})"
+
+    # -- properties --------------------------------------------------------
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    @property
+    def columns(self) -> List[str]:
+        return self._schema.names
+
+    def __repr__(self):
+        return (f"StreamingFrame[{', '.join(self._schema.names)}] "
+                f"(plan={self._plan})")
+
+    # -- batch application (used by the runtime pump) ----------------------
+    def _apply(self, df: TensorFrame) -> TensorFrame:
+        for t in self._transforms:
+            df = t(df)
+        return df
+
+    def _chain(self, fn: Callable[[TensorFrame], TensorFrame],
+               out_schema: Schema, label: str) -> "StreamingFrame":
+        return StreamingFrame(self.source, out_schema,
+                              self._transforms + (fn,),
+                              plan=f"{label}({self._plan})")
+
+    # -- relational ops (per-batch; engine.ops semantics) ------------------
+    def select(self, names: Sequence[str]) -> "StreamingFrame":
+        # materialize FIRST: a one-shot iterable consumed by the schema
+        # check would leave every batch selecting zero columns
+        names = list(names)
+        out_schema = self._schema.select(names)
+        return self._chain(lambda df: df.select(names), out_schema,
+                           f"select{tuple(names)}")
+
+    def map_blocks(self, fetches, trim: bool = False,
+                   executor=None) -> "StreamingFrame":
+        """Per-batch ``map_blocks`` (lazy-op semantics, forced by the
+        pump). The fetches resolve to ONE canonical Computation here, so
+        batches share its compile cache."""
+        comp = _ops.cached_map_computation(fetches, self._schema,
+                                           block_level=True)
+        out_schema = _ops._validate_map(comp, self._schema,
+                                        block_level=True, trim=trim)
+        return self._chain(
+            lambda df: _ops.map_blocks(comp, df, trim=trim,
+                                       executor=executor),
+            out_schema, "map_blocks")
+
+    def map_rows(self, fetches, executor=None) -> "StreamingFrame":
+        comp = _ops.cached_map_computation(fetches, self._schema,
+                                           block_level=False)
+        out_schema = _ops._validate_map(comp, self._schema,
+                                        block_level=False, trim=False)
+        return self._chain(
+            lambda df: _ops.map_rows(comp, df, executor=executor),
+            out_schema, "map_rows")
+
+    def filter_rows(self, predicate, executor=None) -> "StreamingFrame":
+        comp = _ops._filter_computation(predicate, self._schema)
+        return self._chain(
+            lambda df: _ops.filter_rows(comp, df, executor=executor),
+            self._schema, "filter_rows")
+
+    # TensorFrame spells it `filter`; keep the alias for symmetry
+    filter = filter_rows
+
+    # -- aggregation handoff -----------------------------------------------
+    def group_by(self, *keys: str) -> "GroupedStream":
+        for k in keys:
+            f = self._schema.get(k)
+            if f is None:
+                raise KeyError(
+                    f"No column {k!r}; columns: {self._schema.names}")
+            if f.sql_rank != 0:
+                raise ValueError(
+                    f"group_by key {k!r} must be a scalar column")
+        if not keys:
+            raise ValueError("group_by needs at least one key column")
+        return GroupedStream(self, list(keys))
+
+    # -- execution ---------------------------------------------------------
+    def start(self, sink=None, on_update=None, name: Optional[str] = None,
+              max_buffered: Optional[int] = None):
+        """Build a :class:`~.runtime.StreamHandle` pumping this stream's
+        batches: each batch's resulting frame is buffered for
+        ``collect_updates()`` and delivered to ``sink`` / ``on_update``.
+        See ``docs/streaming.md``."""
+        from .runtime import StreamHandle
+        return StreamHandle(self, sink=sink, on_update=on_update,
+                            name=name, max_buffered=max_buffered)
+
+
+class GroupedStream:
+    """``StreamingFrame.group_by(...)`` result — consumed by
+    :meth:`aggregate` (the incremental keyed-aggregation layer)."""
+
+    def __init__(self, frame: StreamingFrame, keys: List[str]):
+        self.frame = frame
+        self.keys = keys
+
+    def aggregate(self, fetches, window=None, time_col: Optional[str] = None,
+                  watermark_delay: float = 0.0,
+                  max_state_rows: Optional[int] = None):
+        """Incremental keyed aggregation over the stream: ``fetches`` is
+        a ``{column: combiner-name}`` mapping (sum/min/max/prod — the
+        monoid set ``aggregate`` and ``daggregate`` serve), combined
+        per batch in one segment-reduce dispatch per column against
+        bounded device-resident state. ``window``
+        (:func:`~.aggregate.tumbling` / :func:`~.aggregate.sliding`)
+        plus ``time_col`` enable windowing; ``watermark_delay`` is the
+        allowed event-time lateness before a window emits and evicts.
+        Returns a :class:`~.aggregate.StreamingAggregation`; call
+        ``.start()`` on it. See ``docs/streaming.md``."""
+        from .aggregate import StreamingAggregation
+        return StreamingAggregation(
+            self.frame, self.keys, fetches, window=window,
+            time_col=time_col, watermark_delay=watermark_delay,
+            max_state_rows=max_state_rows)
+
+    def __repr__(self):
+        return f"GroupedStream(keys={self.keys}, frame={self.frame!r})"

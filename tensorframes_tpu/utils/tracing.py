@@ -98,11 +98,6 @@ class _GaugeStat:
                 "last": self.last}
 
 
-# gauges whose pre-0.2 snapshot entries used the span keys (mean_s/...):
-# readable under BOTH key sets for one release, then the aliases go away
-_GAUGE_LEGACY_ALIASES = ("pipeline.occupancy",)
-
-
 class Timings:
     """Thread-safe per-stage timing registry (+ gauge samples)."""
 
@@ -130,18 +125,12 @@ class Timings:
             return {k: v.as_dict() for k, v in self._stats.items()}
 
     def gauges_snapshot(self) -> Dict[str, Dict[str, float]]:
+        # gauge entries carry ONLY the unit-less stat keys
+        # (mean/min/max/last). The pre-0.2 duration-suffixed aliases
+        # (`mean_s`/...) that `pipeline.occupancy` kept for one release
+        # are gone as scheduled.
         with self._lock:
-            out = {}
-            for k, v in self._gauges.items():
-                d = v.as_dict()
-                if k in _GAUGE_LEGACY_ALIASES:
-                    # deprecated (one release): the old duration-suffixed
-                    # keys these gauges were first published under
-                    d["mean_s"] = d["mean"]
-                    d["min_s"] = d["min"]
-                    d["max_s"] = d["max"]
-                out[k] = d
-            return out
+            return {k: v.as_dict() for k, v in self._gauges.items()}
 
     def snapshot(self) -> Dict[str, Dict[str, float]]:
         """Spans and gauges in one dict; span entries use ``*_s`` keys,

@@ -51,3 +51,11 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "serve: multi-tenant scheduler/admission/quota suite "
                    "(run-tests.sh --serve runs this lane standalone)")
+    config.addinivalue_line(
+        "markers", "stream: streaming sources/windows/watermarks suite "
+                   "(run-tests.sh --stream runs this lane standalone)")
+    config.addinivalue_line(
+        "markers", "timing: wall-clock-sensitive deadline assertions — "
+                   "margins are widened for loaded machines; deselect "
+                   "with -m 'not timing' when a box is badly "
+                   "oversubscribed")

@@ -466,15 +466,17 @@ class TestStatsSatellites:
                      "last": 2.0}
         assert "mean_s" not in g  # no vestigial seconds suffix
 
-    def test_occupancy_legacy_alias_kept_one_release(self):
+    def test_occupancy_legacy_aliases_removed(self):
+        # the pre-0.2 duration-suffixed aliases were kept for exactly
+        # one release (PR 3); they are gone now, as promised
         tracing.enable()
         tracing.gauge("pipeline.occupancy", 2.0)
         tracing.gauge("pipeline.occupancy", 4.0)
         occ = tracing.timings.snapshot()["pipeline.occupancy"]
         assert occ["mean"] == 3.0 and occ["last"] == 4.0
-        # deprecated aliases (pre-0.2 key names) still readable
-        assert occ["mean_s"] == occ["mean"]
-        assert occ["max_s"] == occ["max"]
+        assert "mean_s" not in occ
+        assert "min_s" not in occ
+        assert "max_s" not in occ
 
     def test_report_merges_counters_and_gauges(self):
         tracing.enable()

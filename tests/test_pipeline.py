@@ -353,4 +353,4 @@ class TestPipelinedExecutor:
         snap = tracing.timings.snapshot()
         occ = snap.get("pipeline.occupancy")
         assert occ is not None and occ["count"] == 6
-        assert occ["max_s"] <= 3  # never exceeds the window
+        assert occ["max"] <= 3  # never exceeds the window

@@ -96,6 +96,7 @@ class TestRetryPolicy:
         assert p.deadline == 12.5
 
 
+@pytest.mark.timing
 class TestDeadline:
     def test_expiry_raises_within_budget(self):
         t0 = time.monotonic()
@@ -105,7 +106,10 @@ class TestDeadline:
                     rz.RetryPolicy(max_attempts=100,
                                    base_delay=0.02).call(
                         lambda: faults.check("unit"), op="unit")
-        assert time.monotonic() - t0 < 1.0
+        # generous margin over the 0.05s budget: the bound proves the
+        # loop STOPPED, not that the box was idle — concurrent suite
+        # load must not flake it (marker `timing`)
+        assert time.monotonic() - t0 < 3.0
 
     def test_nested_deadlines_only_shrink(self):
         with rz.deadline(10.0):
@@ -356,6 +360,7 @@ class TestClusterResilience:
         assert counters.get("retry.cluster_init.retries") == 2
         assert counters.get("cluster_init.degraded") == 0
 
+    @pytest.mark.timing
     def test_require_cluster_fails_fast_on_unreachable_coordinator(
             self, monkeypatch):
         """Acceptance: TFT_REQUIRE_CLUSTER=1 + unreachable coordinator →
@@ -368,8 +373,10 @@ class TestClusterResilience:
             cluster.initialize("127.0.0.1:1", 2, 1, timeout=3)
         # the deadline bounds when the loop STOPS retrying; the attempt
         # in flight at expiry still finishes (one socket connect, ~ms) —
-        # allow it a margin so a loaded machine can't flake the bound
-        assert time.monotonic() - t0 < 3.5
+        # a wide margin so a loaded machine can't flake the bound
+        # (marker `timing`): the assertion distinguishes "stopped after
+        # its 3s deadline" from "hung", nothing finer
+        assert time.monotonic() - t0 < 5.0
         assert counters.get("cluster_init.failures") == 1
 
     def test_unreachable_coordinator_degrades_without_require(

@@ -241,14 +241,19 @@ class _FakeDevice:
         return self.stats
 
 
+@pytest.mark.timing
 class TestDeadlinesAndAdmission:
+    # wall-clock-sensitive deadline/admission assertions (marker
+    # `timing`): the sleeps and result() timeouts carry wide margins so
+    # concurrent suite load cannot flake them — each assertion proves a
+    # deadline FIRED or an admission CLEARED, never how fast
     def test_queued_past_deadline_is_shed_classified(self):
         with QueryScheduler(workers=0, name="dl") as sched:
             fut = sched.submit(_frame(8), tenant="t", deadline=0.01)
             time.sleep(0.05)
             assert sched.step()
             with pytest.raises(DeadlineExceeded):
-                fut.result(timeout=1)
+                fut.result(timeout=5)
             assert fut.state == "failed"
             snap = sched.snapshot()
             assert snap["t"]["failed"] == 1
@@ -263,7 +268,7 @@ class TestDeadlinesAndAdmission:
             fut = sched.submit(_frame(8), tenant="t", est_bytes=500)
             assert sched.step()
             with pytest.raises(AdmissionDeadline) as ei:
-                fut.result(timeout=1)
+                fut.result(timeout=5)
             assert error_kind(ei.value) == "deadline_admission"
             assert not is_transient(ei.value)
             assert is_permanent(ei.value)

@@ -1,0 +1,693 @@
+"""Streaming execution suite (tier-1; marker ``stream``).
+
+Proves the streaming subsystem's contract end-to-end on CPU:
+
+- block sources: schema inference + checking, bounded-queue
+  backpressure, parquet tailing that re-reads nothing
+  (``io.read_parquet(row_group_offset=)``);
+- **finite equivalence**: streaming a finite parquet through every
+  supported relational op matches the batch ``TensorFrame`` path
+  bit-identically, ordering included;
+- windows & watermarks: tumbling/sliding emission timing, exact
+  contents, late-batch drop-and-count, finalize flush, update mode;
+- the ≥100-batch keyed-aggregation demo: device-resident state stays
+  bounded (rows/bytes plateau) and per-batch work is cache-hit after
+  warmup (no engine compile-cache misses, no merge-program builds past
+  the first batches);
+- per-batch failure isolation via the ``batch`` fault site: transient
+  faults retry, poisoned batches skip-and-count, the stream survives;
+- sinks (collect/callback/parquet appender) and the ``tft_stream_*``
+  metrics; slot-pool sharing with the serving layer's global bound.
+"""
+
+import queue as queue_mod
+import time
+
+import numpy as np
+import pytest
+
+import tensorframes_tpu as tft
+from tensorframes_tpu import io as tio
+from tensorframes_tpu import stream
+from tensorframes_tpu.engine import pipeline as engine_pipeline
+from tensorframes_tpu.observability import metrics as obs_metrics
+from tensorframes_tpu.resilience import faults
+from tensorframes_tpu.schema import Schema
+from tensorframes_tpu.utils import tracing
+
+pytestmark = pytest.mark.stream
+
+
+@pytest.fixture(autouse=True)
+def _fast_and_clean(monkeypatch):
+    monkeypatch.setenv("TFT_RETRY_BASE_DELAY", "0.001")
+    monkeypatch.setenv("TFT_RETRY_MAX_DELAY", "0.01")
+    tracing.disable()
+    tracing.counters.reset()
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _batches(n, rows=4, keys=2, t0=0.0, dt=1.0):
+    """n batches of `rows` rows: int64 key cycling [0, keys), double
+    value, double event time (one timestamp per batch)."""
+    for i in range(n):
+        yield {"k": (np.arange(rows) % keys).astype(np.int64),
+               "v": np.arange(rows, dtype=np.float64) + i,
+               "ts": np.full(rows, t0 + i * dt)}
+
+
+def _rows(frames):
+    return [r for f in frames for r in f.collect()]
+
+
+# ---------------------------------------------------------------------------
+# sources
+# ---------------------------------------------------------------------------
+
+class TestSources:
+    def test_generator_infers_schema_and_ends(self):
+        src = stream.GeneratorSource(_batches(2))
+        assert src.schema.names == ["k", "v", "ts"]
+        assert src.poll() is not None and src.poll() is not None
+        assert src.poll() is None
+        assert src.done()
+
+    def test_schema_drift_is_named(self):
+        def gen():
+            yield {"x": np.arange(3.0)}
+            yield {"y": np.arange(3.0)}          # renamed column
+
+        src = stream.GeneratorSource(gen())
+        assert src.poll() is not None
+        with pytest.raises(stream.SchemaMismatch, match="missing"):
+            src.poll()
+
+    def test_dtype_drift_is_named(self):
+        def gen():
+            yield {"x": np.arange(3.0)}
+            yield {"x": np.arange(3, dtype=np.float32)}
+
+        src = stream.GeneratorSource(gen())
+        assert src.poll() is not None
+        with pytest.raises(stream.SchemaMismatch, match="float32"):
+            src.poll()
+
+    def test_queue_backpressure_and_close(self):
+        src = stream.QueueSource(Schema.of(x="double"), maxsize=1)
+        src.put({"x": np.arange(2.0)})
+        with pytest.raises(queue_mod.Full):     # the bound pushes back
+            src.put({"x": np.arange(2.0)}, timeout=0.01)
+        src.close()
+        with pytest.raises(RuntimeError):
+            src.put({"x": np.arange(2.0)})
+        assert not src.done()                   # still one block queued
+        assert src.poll() is not None
+        assert src.done()
+
+    def test_queue_checks_at_producer(self):
+        src = stream.QueueSource(Schema.of(x="double"), maxsize=4)
+        with pytest.raises(stream.SchemaMismatch):
+            src.put({"x": np.arange(3, dtype=np.int32)})
+
+    def test_parquet_tail_reads_only_new_row_groups(self, tmp_path):
+        path = str(tmp_path / "t.parquet")
+        df = tft.frame({"x": np.arange(8.0)}, num_partitions=2)
+        tio.write_parquet(df, path)
+        src = stream.ParquetTailSource(path)
+        got = [src.poll(), src.poll()]
+        assert [b.num_rows for b in got] == [4, 4]
+        assert src.poll() is None and not src.done()  # follow mode
+        # the writer replaces the file with a longer one (the parquet
+        # append idiom); only the NEW groups come back
+        df2 = tft.frame({"x": np.arange(16.0)}, num_partitions=4)
+        tio.write_parquet(df2, path)
+        b3 = src.poll()
+        np.testing.assert_array_equal(b3.columns["x"], np.arange(8.0, 12.0))
+        assert src.poll().num_rows == 4 and src.poll() is None
+
+    def test_read_parquet_row_group_offset(self, tmp_path):
+        path = str(tmp_path / "o.parquet")
+        tio.write_parquet(
+            tft.frame({"x": np.arange(9.0),
+                       "s": np.array(["a"] * 9, object)},
+                      num_partitions=3), path)
+        part = tio.read_parquet(path, row_group_offset=1)
+        assert part.num_partitions == 2
+        np.testing.assert_array_equal(
+            np.concatenate([b.columns["x"] for b in part.blocks()]),
+            np.arange(3.0, 9.0))
+        # past-the-end: empty but TYPED from the parquet footer
+        empty = tio.read_parquet(path, row_group_offset=17)
+        assert empty.count() == 0
+        assert empty.schema["x"].dtype.name == "double"
+        assert empty.schema["s"].dtype.name == "string"
+        with pytest.raises(ValueError, match="row_group_offset"):
+            tio.read_parquet(path, row_group_offset=-1)
+
+
+# ---------------------------------------------------------------------------
+# finite-source equivalence (acceptance: bit-identical, ordering included)
+# ---------------------------------------------------------------------------
+
+class TestFiniteEquivalence:
+    @pytest.fixture
+    def pq_file(self, tmp_path):
+        path = str(tmp_path / "f.parquet")
+        rng = np.random.default_rng(7)
+        df = tft.frame(
+            {"x": rng.normal(size=20),
+             "k": (np.arange(20) % 4).astype(np.int64)},
+            num_partitions=5)
+        tio.write_parquet(df, path)
+        return path
+
+    def _stream_rows(self, sf):
+        h = sf.start()
+        h.run()
+        frames = h.collect_updates()
+        return _rows(frames)
+
+    @staticmethod
+    def _assert_identical(got, want):
+        assert len(got) == len(want)
+        for g, w in zip(got, want):
+            assert g.fields == w.fields
+            for a, b in zip(g, w):
+                assert np.asarray(a).dtype == np.asarray(b).dtype
+                np.testing.assert_array_equal(a, b)
+
+    def test_map_blocks(self, pq_file):
+        fetch = lambda x: {"y": x * 2.0 + 1.0}  # noqa: E731
+        got = self._stream_rows(
+            stream.from_source(
+                stream.ParquetTailSource(pq_file, follow=False))
+            .map_blocks(fetch))
+        want = tio.read_parquet(pq_file).map_blocks(fetch).collect()
+        self._assert_identical(got, want)
+
+    def test_map_blocks_trim(self, pq_file):
+        fetch = lambda x: {"y": x - 3.0}  # noqa: E731
+        got = self._stream_rows(
+            stream.from_source(
+                stream.ParquetTailSource(pq_file, follow=False))
+            .map_blocks(fetch, trim=True))
+        want = tio.read_parquet(pq_file).map_blocks(
+            fetch, trim=True).collect()
+        self._assert_identical(got, want)
+
+    def test_map_rows(self, pq_file):
+        fetch = lambda x: {"y": x * x}  # noqa: E731
+        got = self._stream_rows(
+            stream.from_source(
+                stream.ParquetTailSource(pq_file, follow=False))
+            .map_rows(fetch))
+        want = tft.map_rows(fetch,
+                            tio.read_parquet(pq_file)).collect()
+        self._assert_identical(got, want)
+
+    def test_filter_and_select(self, pq_file):
+        pred = lambda k: k != 2  # noqa: E731
+        got = self._stream_rows(
+            stream.from_source(
+                stream.ParquetTailSource(pq_file, follow=False))
+            .filter_rows(pred).select(["x"]))
+        want = tio.read_parquet(pq_file).filter(pred) \
+            .select(["x"]).collect()
+        self._assert_identical(got, want)
+
+    def test_chained_ops(self, pq_file):
+        def chain_stream(sf):
+            return (sf.map_blocks(lambda x: {"y": x + 1.0})
+                    .filter_rows(lambda k: k != 0)
+                    .map_rows(lambda y: {"z": y * y})
+                    .select(["k", "z"]))
+
+        got = self._stream_rows(chain_stream(
+            stream.from_source(
+                stream.ParquetTailSource(pq_file, follow=False))))
+        df = tio.read_parquet(pq_file)
+        df = df.map_blocks(lambda x: {"y": x + 1.0})
+        df = df.filter(lambda k: k != 0)
+        df = tft.map_rows(lambda y: {"z": y * y}, df)
+        want = df.select(["k", "z"]).collect()
+        self._assert_identical(got, want)
+
+    def test_definition_time_validation(self):
+        sf = stream.from_source(
+            stream.GeneratorSource(_batches(1)))
+        with pytest.raises(Exception, match="no matching column"):
+            sf.map_blocks(lambda nope: {"y": nope})
+        with pytest.raises(KeyError):
+            sf.select(["missing"])
+
+
+# ---------------------------------------------------------------------------
+# windows, watermarks, late data
+# ---------------------------------------------------------------------------
+
+class TestWindowsAndWatermarks:
+    def _agg(self, gen, window, delay=0.0, **kw):
+        return (stream.from_source(stream.GeneratorSource(gen))
+                .group_by("k")
+                .aggregate({"v": "sum"}, window=window, time_col="ts",
+                           watermark_delay=delay, **kw))
+
+    def test_tumbling_emits_exactly_at_watermark(self):
+        h = self._agg(_batches(12), stream.tumbling(4.0), delay=1.0) \
+            .start()
+        emitted_at = {}
+        n = 0
+        while not h.done():
+            if h.step():
+                n += 1
+            # drain after EVERY step: the finalize flush arrives on the
+            # exhausting step, which returns False
+            for f in h.collect_updates():
+                s = f.collect()[0]["window_start"]
+                emitted_at[float(s)] = n
+        # watermark = max_ts - 1; window [0,4) closes when wm >= 4,
+        # i.e. after the batch at ts=5 (the 6th batch)
+        assert emitted_at[0.0] == 6
+        assert emitted_at[4.0] == 10
+        assert emitted_at[8.0] == 12  # flushed by finalize
+
+    def test_window_contents_match_batch_aggregate(self):
+        h = self._agg(_batches(12), stream.tumbling(4.0), delay=1.0) \
+            .start()
+        h.run()
+        frames = h.collect_updates()
+        by_window = {float(f.collect()[0]["window_start"]): f
+                     for f in frames}
+        # reference: the finite monoid aggregate over the same rows
+        all_rows = {"k": [], "v": [], "ts": []}
+        for b in _batches(12):
+            for c in all_rows:
+                all_rows[c].append(b[c])
+        full = tft.frame({c: np.concatenate(v)
+                          for c, v in all_rows.items()})
+        for start in (0.0, 4.0, 8.0):
+            wdf = full.filter(
+                lambda ts: (ts >= start) & (ts < start + 4.0))
+            want = tft.aggregate({"v": "sum"},
+                                 wdf.select(["k", "v"]).group_by("k"))
+            got = by_window[start]
+            np.testing.assert_array_equal(
+                got.blocks()[0].columns["k"],
+                want.blocks()[0].columns["k"])
+            np.testing.assert_allclose(
+                got.blocks()[0].columns["v"],
+                want.blocks()[0].columns["v"])
+
+    def test_late_batch_is_dropped_and_counted(self):
+        def gen():
+            yield from _batches(8)               # ts 0..7
+            # a straggler for the long-closed first window
+            yield {"k": np.array([0], np.int64),
+                   "v": np.array([100.0]), "ts": np.array([0.5])}
+
+        h = self._agg(gen(), stream.tumbling(2.0), delay=1.0).start()
+        h.run()
+        frames = h.collect_updates()
+        # the late 100.0 must not appear in ANY window
+        assert all(r["v"] < 100.0 for r in _rows(frames))
+        assert h.metrics()["late_rows"] == 1
+        assert tracing.counters.get("stream.late_rows") == 1
+
+    def test_sliding_rows_land_in_every_overlapping_window(self):
+        def gen():
+            yield {"k": np.array([0], np.int64),
+                   "v": np.array([1.0]), "ts": np.array([5.0])}
+            yield {"k": np.array([0], np.int64),
+                   "v": np.array([0.0]), "ts": np.array([30.0])}
+
+        h = self._agg(gen(), stream.sliding(4.0, 2.0)).start()
+        h.run()
+        out = {float(r["window_start"]): r["v"]
+               for r in _rows(h.collect_updates())}
+        # ts=5 belongs to [4,8) and [2,6); ts=30 to [28,32) and [30,34)
+        assert out[4.0] == 1.0 and out[2.0] == 1.0
+        assert 0.0 not in out or out[0.0] == 0.0
+
+    def test_update_mode_running_totals(self):
+        src = stream.GeneratorSource(_batches(3, rows=2, keys=2))
+        h = (stream.from_source(src).group_by("k")
+             .aggregate({"v": "sum"}).start())
+        h.run()
+        frames = h.collect_updates()
+        # per-batch deltas plus the finalize snapshot; the last frame is
+        # the full running total: k=0 gets v[0]=i, k=1 gets v[1]=i+1
+        final = {r["k"]: r["v"] for r in frames[-1].collect()}
+        assert final == {0: 0.0 + 1 + 2, 1: 1.0 + 2 + 3}
+
+    def test_windowed_needs_time_col_and_update_rejects_cap(self):
+        g = stream.from_source(
+            stream.GeneratorSource(_batches(1))).group_by("k")
+        with pytest.raises(ValueError, match="time_col"):
+            g.aggregate({"v": "sum"}, window=stream.tumbling(4.0))
+        with pytest.raises(ValueError, match="max_state_rows"):
+            g.aggregate({"v": "sum"}, max_state_rows=10)
+        with pytest.raises(ValueError, match="Unknown combiner"):
+            g.aggregate({"v": "median"}, window=stream.tumbling(4.0),
+                        time_col="ts")
+
+
+# ---------------------------------------------------------------------------
+# bounded state + cache-hit steady state (the >=100-batch acceptance demo)
+# ---------------------------------------------------------------------------
+
+class TestBoundedStateDemo:
+    def test_100_plus_batches_bounded_state_and_no_recompiles(self):
+        n_batches, keys = 120, 8
+        agg = (stream.from_source(
+                   stream.GeneratorSource(
+                       _batches(n_batches, rows=16, keys=keys)))
+               .map_blocks(lambda v: {"v2": v * 2.0})
+               .select(["k", "v2", "ts"])
+               .group_by("k")
+               .aggregate({"v2": "sum"}, window=stream.tumbling(8.0),
+                          time_col="ts", watermark_delay=4.0))
+        h = agg.start(name="demo")
+        peak_rows = peak_bytes = 0
+        warmup_mark = None
+        processed = 0
+        while not h.done():
+            if not h.step():
+                continue
+            processed += 1
+            m = h.metrics()
+            peak_rows = max(peak_rows, m["state_rows"])
+            peak_bytes = max(peak_bytes, m["state_bytes"])
+            if processed == 20:  # steady state reached
+                warmup_mark = (
+                    tracing.counters.get("compile_cache.misses"),
+                    tracing.counters.get("stream.merge_compiles"))
+        assert processed == n_batches
+        assert h.metrics()["batches_skipped"] == 0
+        # bounded device-resident state: watermark delay 4 keeps at most
+        # ceil((8+4)/8)+1 = 3 windows open, `keys` rows each — the
+        # plateau the acceptance criterion asks for
+        assert 0 < peak_rows <= 3 * keys
+        assert peak_bytes > 0
+        # steady state is pure cache hits: no engine compile-cache
+        # misses and no merge-program builds after warmup
+        assert (tracing.counters.get("compile_cache.misses"),
+                tracing.counters.get("stream.merge_compiles")) \
+            == warmup_mark
+        # and the emitted totals are complete: every batch contributes
+        # sum(2*(i + [0..15])) to its window; check the grand total
+        frames = h.collect_updates()
+        got_total = sum(float(np.sum(f.blocks()[0].columns["v2"]))
+                        for f in frames)
+        want_total = sum(2.0 * (16 * i + np.arange(16.0).sum())
+                         for i in range(n_batches))
+        assert got_total == pytest.approx(want_total)
+        assert h.metrics()["windows_emitted"] == n_batches / 8
+
+    def test_max_state_rows_force_evicts_oldest(self):
+        # watermark never advances enough to emit (huge delay): the cap
+        # is the only thing bounding state
+        agg = (stream.from_source(
+                   stream.GeneratorSource(
+                       _batches(30, rows=8, keys=4)))
+               .group_by("k")
+               .aggregate({"v": "sum"}, window=stream.tumbling(2.0),
+                          time_col="ts", watermark_delay=1000.0,
+                          max_state_rows=12))
+        h = agg.start()
+        while not h.done():
+            if h.step():
+                assert h.metrics()["state_rows"] <= 12
+        assert h.metrics()["state_evictions"] > 0
+        assert tracing.counters.get("stream.state_evictions") > 0
+
+
+# ---------------------------------------------------------------------------
+# per-batch failure isolation (acceptance: `batch` fault site)
+# ---------------------------------------------------------------------------
+
+class TestFailureIsolation:
+    def test_poisoned_batch_skipped_stream_survives(self):
+        sf = stream.from_source(
+            stream.GeneratorSource(_batches(5))) \
+            .map_blocks(lambda v: {"y": v + 1.0})
+        h = sf.start(name="poison")
+        # arm AFTER batch 0: deterministic — exactly batch 1 poisons
+        assert h.step()
+        with faults.inject("batch", fail_n=1, transient=False):
+            h.run()
+        m = h.metrics()
+        assert m["batches_skipped"] == 1
+        assert m["batches"] == 4
+        assert tracing.counters.get("stream.batches_skipped") == 1
+        assert h.done()
+        # exactly the poisoned batch's rows are missing
+        got = _rows(h.collect_updates())
+        assert len(got) == 4 * 4
+        batches_seen = sorted({float(r["ts"]) for r in got})
+        assert batches_seen == [0.0, 2.0, 3.0, 4.0]
+
+    def test_transient_batch_fault_retries_not_skips(self):
+        sf = stream.from_source(stream.GeneratorSource(_batches(3)))
+        h = sf.start(name="flaky")
+        with faults.inject("batch", fail_n=1):   # transient (default)
+            h.run()
+        assert h.metrics()["batches_skipped"] == 0
+        assert h.metrics()["batches"] == 3
+        assert tracing.counters.get("retry.stream.batch.retries") == 1
+
+    def test_transient_fault_never_double_counts_aggregation(self):
+        # the retry policy wraps only the forcing — ingest commits once
+        # — so a retried batch must not fold twice into window state
+        agg = (stream.from_source(
+                   stream.GeneratorSource(_batches(8, rows=4, keys=2)))
+               .group_by("k")
+               .aggregate({"v": "sum"}, window=stream.tumbling(4.0),
+                          time_col="ts", watermark_delay=0.0))
+        h = agg.start()
+        assert h.step()                       # batch 0 clean
+        with faults.inject("batch", fail_n=1):  # transient: retried
+            h.run()
+        assert h.metrics()["batches_skipped"] == 0
+        frames = h.collect_updates()
+        total = sum(float(np.sum(f.blocks()[0].columns["v"]))
+                    for f in frames)
+        want = sum(4 * i + np.arange(4.0).sum() for i in range(8))
+        assert total == pytest.approx(want)
+
+    def test_failed_ingest_leaves_state_untouched(self, monkeypatch):
+        # ingest is all-or-nothing: poison the MERGE step of batch 2 and
+        # the whole batch must skip with window state exactly as it was
+        from tensorframes_tpu.stream import aggregate as agg_mod
+
+        a = (stream.from_source(
+                 stream.GeneratorSource(_batches(3, rows=4, keys=2)))
+             .group_by("k")
+             .aggregate({"v": "sum"}, window=stream.tumbling(100.0),
+                        time_col="ts"))
+        h = a.start()
+        assert h.step()
+        before = (a.state_rows, {k: dict(w.values)
+                                 for k, w in a._windows.items()})
+
+        real = agg_mod._merge_program
+
+        def poisoned(*args, **kw):
+            raise ValueError("deterministic merge poison")
+
+        monkeypatch.setattr(agg_mod, "_merge_program", poisoned)
+        assert h.step()                       # consumed, but skipped
+        assert h.metrics()["batches_skipped"] == 1
+        assert a.state_rows == before[0]
+        monkeypatch.setattr(agg_mod, "_merge_program", real)
+        assert h.step()                       # stream continues cleanly
+        assert h.metrics()["batches"] == 2
+
+    def test_corrupt_tail_row_group_cannot_livelock(self, tmp_path,
+                                                    monkeypatch):
+        path = str(tmp_path / "c.parquet")
+        tio.write_parquet(
+            tft.frame({"x": np.arange(8.0)}, num_partitions=2), path)
+        src = stream.ParquetTailSource(path, skip_unreadable_after_s=0.0)
+        real = tio.read_parquet
+
+        def corrupt(p, *a, **kw):
+            raise ValueError("corrupt row group data")
+
+        monkeypatch.setattr(tio, "read_parquet", corrupt)
+        # three consecutive failures at the same offset (past the
+        # wall-clock floor, zeroed for the test), then the source steps
+        # past the unreadable group — forward progress, not a spin
+        for _ in range(3):
+            with pytest.raises(ValueError):
+                src.poll()
+        monkeypatch.setattr(tio, "read_parquet", real)
+        b = src.poll()                        # group 0 was skipped
+        np.testing.assert_array_equal(b.columns["x"],
+                                      np.arange(4.0, 8.0))
+
+    def test_corrupt_group_does_not_discard_readable_neighbors(
+            self, tmp_path, monkeypatch):
+        # groups 0..2; group 1 is "corrupt". The degraded single-group
+        # reads must deliver groups 0 and 2 and skip ONLY group 1.
+        path = str(tmp_path / "mid.parquet")
+        tio.write_parquet(
+            tft.frame({"x": np.arange(12.0)}, num_partitions=3), path)
+        src = stream.ParquetTailSource(path, skip_unreadable_after_s=0.0)
+        real = tio.read_parquet
+
+        def selective(p, *a, row_group_offset=0, row_group_limit=None,
+                      **kw):
+            end = (row_group_offset + row_group_limit
+                   if row_group_limit is not None else 3)
+            if row_group_offset <= 1 < end:
+                raise ValueError("corrupt row group 1")
+            return real(p, *a, row_group_offset=row_group_offset,
+                        row_group_limit=row_group_limit, **kw)
+
+        monkeypatch.setattr(tio, "read_parquet", selective)
+        got = []
+        for _ in range(10):
+            try:
+                b = src.poll()
+            except ValueError:
+                continue
+            if b is not None:
+                got.append(b)
+            if len(got) == 2:
+                break
+        assert [list(b.columns["x"]) for b in got] == \
+            [list(np.arange(4.0)), list(np.arange(8.0, 12.0))]
+
+    def test_read_parquet_row_group_limit(self, tmp_path):
+        path = str(tmp_path / "lim.parquet")
+        tio.write_parquet(
+            tft.frame({"x": np.arange(12.0)}, num_partitions=3), path)
+        mid = tio.read_parquet(path, row_group_offset=1,
+                               row_group_limit=1)
+        assert mid.num_partitions == 1
+        np.testing.assert_array_equal(mid.blocks()[0].columns["x"],
+                                      np.arange(4.0, 8.0))
+        with pytest.raises(ValueError, match="row_group_limit"):
+            tio.read_parquet(path, row_group_limit=0)
+
+    def test_background_pump_records_fail_fast_error(self, monkeypatch):
+        monkeypatch.setenv("TFT_STREAM_FAIL_FAST", "1")
+        h = stream.from_source(
+            stream.GeneratorSource(_batches(2))).start(name="ff")
+        with faults.inject("batch", fail_n=1, transient=False):
+            h.start_background(poll_interval=0.005)
+            deadline = time.monotonic() + 10
+            while h.error is None and time.monotonic() < deadline:
+                time.sleep(0.01)
+        assert isinstance(h.error, faults.InjectedFault)
+        h.stop()
+
+    def test_fail_fast_env_raises(self, monkeypatch):
+        monkeypatch.setenv("TFT_STREAM_FAIL_FAST", "1")
+        sf = stream.from_source(stream.GeneratorSource(_batches(2)))
+        h = sf.start()
+        with faults.inject("batch", fail_n=1, transient=False):
+            with pytest.raises(faults.InjectedFault):
+                h.run()
+
+    def test_source_schema_drift_skips_and_continues(self):
+        def gen():
+            yield {"x": np.arange(3.0)}
+            yield {"x": np.arange(3, dtype=np.int32)}   # drift
+            yield {"x": np.arange(3.0) + 10}
+
+        h = stream.from_source(stream.GeneratorSource(gen())).start()
+        h.run()
+        m = h.metrics()
+        assert m["batches"] == 2 and m["batches_skipped"] == 1
+        got = _rows(h.collect_updates())
+        assert [r["x"] for r in got] == [0.0, 1.0, 2.0, 10.0, 11.0, 12.0]
+
+
+# ---------------------------------------------------------------------------
+# sinks + metrics + slot-pool composition
+# ---------------------------------------------------------------------------
+
+class TestSinksAndMetrics:
+    def test_callback_and_collect(self):
+        seen = []
+        h = stream.from_source(
+            stream.GeneratorSource(_batches(3))).start(
+                on_update=seen.append)
+        h.run()
+        assert len(seen) == 3
+        assert len(h.collect_updates()) == 3
+        assert h.collect_updates() == []         # drained
+
+    def test_callback_error_counted_not_fatal(self):
+        def bad(frame):
+            raise RuntimeError("sink down")
+
+        h = stream.from_source(
+            stream.GeneratorSource(_batches(3))).start(on_update=bad)
+        h.run()
+        assert h.metrics()["batches"] == 3
+        assert tracing.counters.get("stream.sink_errors") == 3
+
+    def test_parquet_sink_appends_and_reads_back(self, tmp_path):
+        path = str(tmp_path / "out.parquet")
+        sink = stream.ParquetSink(path)
+        h = (stream.from_source(stream.GeneratorSource(_batches(6)))
+             .group_by("k")
+             .aggregate({"v": "sum"}, window=stream.tumbling(2.0),
+                        time_col="ts")
+             .start(sink=sink))
+        h.run()                                  # finalize closes sink
+        back = tio.read_parquet(path)
+        assert back.schema.names == ["window_start", "k", "v"]
+        assert back.count() == 6                 # 3 windows x 2 keys
+        assert back.num_partitions == 3          # one row group per emit
+
+    def test_metrics_text_and_dict(self):
+        h = (stream.from_source(stream.GeneratorSource(
+                 _batches(4, rows=6, keys=3)))
+             .group_by("k")
+             .aggregate({"v": "sum"}, window=stream.tumbling(2.0),
+                        time_col="ts", watermark_delay=1.0)
+             .start(name="mx"))
+        h.run(max_batches=3)
+        text = obs_metrics.metrics_text()
+        assert 'tft_stream_batches_total{stream="mx"} 3' in text
+        assert 'tft_stream_state_rows{stream="mx"}' in text
+        assert 'tft_stream_watermark{stream="mx"}' in text
+        m = h.metrics()
+        assert m["rows"] == 18 and m["watermark"] == 1.0
+        assert m["state_rows"] > 0 and m["state_bytes"] > 0
+        assert m["batch_lag_s"] is not None
+
+    def test_stream_leases_serving_slot_pool(self):
+        pool = engine_pipeline.SlotPool(2)
+        prev = engine_pipeline.install_slot_pool(pool)
+        try:
+            h = stream.from_source(
+                stream.GeneratorSource(_batches(4))).start()
+            h.run()
+            assert h.metrics()["batches"] == 4
+        finally:
+            engine_pipeline.install_slot_pool(prev)
+        # every lease was returned: both slots acquirable again
+        assert pool.try_acquire() and pool.try_acquire()
+        pool.release()
+        pool.release()
+
+    def test_queue_source_end_to_end_background(self):
+        src = stream.QueueSource(Schema.of(x="double"), maxsize=8)
+        h = stream.from_source(src) \
+            .map_blocks(lambda x: {"y": x + 1.0}) \
+            .start(name="bg").start_background(poll_interval=0.005)
+        for i in range(5):
+            src.put({"x": np.arange(3.0) + i})
+        src.close()
+        deadline = time.monotonic() + 10
+        while not h.done() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert h.done()
+        assert h.metrics()["batches"] == 5
+        h.stop()
