@@ -53,6 +53,24 @@ if [ "${1:-}" = "--stream" ]; then
   exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m stream "$@"
 fi
 
+# --elastic: run only the elastic-mesh lane (tests/test_elastic.py:
+# device-loss recovery, skew-adaptive repartitioning, hot-key salting)
+# — fast, CPU-only, no native build needed
+if [ "${1:-}" = "--elastic" ]; then
+  shift
+  echo "== elastic lane (pytest -m elastic, CPU) =="
+  exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m elastic "$@"
+fi
+
+# --timing: run only the wall-clock-sensitive deadline tests, serially
+# (they flake under concurrent suite load; TFT_TIMING_MARGIN widens
+# their assertion bounds further on badly oversubscribed boxes)
+if [ "${1:-}" = "--timing" ]; then
+  shift
+  echo "== timing lane (pytest -m timing, CPU, serial) =="
+  exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m timing "$@"
+fi
+
 echo "== building native runtime (libtfruntime.so) =="
 make -C native
 
@@ -68,8 +86,19 @@ else
   echo "== tensorflow C++ libs not present; skipping libtfrpjrt.so =="
 fi
 
-echo "== running test suite =="
-python -m pytest tests/ -q "$@"
+echo "== running test suite (timing-marked deadline tests deferred) =="
+python -m pytest tests/ -q -m 'not timing' "$@"
+
+# deadline tests run SERIALLY after the main suite: their wall-clock
+# assertions flake when they share the box with the concurrent suite.
+# Exit code 5 = nothing collected (passthrough args like -k can
+# deselect every timing test) — that is not a failure of the run.
+echo "== timing lane (deadline tests, serial) =="
+timing_rc=0
+python -m pytest tests/ -q -m timing "$@" || timing_rc=$?
+if [ "$timing_rc" -ne 0 ] && [ "$timing_rc" -ne 5 ]; then
+  exit "$timing_rc"
+fi
 
 if [ "$HAVE_TF" = 1 ]; then
   echo "== op suite again through the native PJRT core (TFT_EXECUTOR=pjrt) =="

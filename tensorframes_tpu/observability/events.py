@@ -263,6 +263,7 @@ class QueryTrace:
             "compile_hits": 0, "compile_misses": 0,
             "compile_seconds": 0.0, "dispatches": 0,
             "mesh_dispatches": 0, "collectives": 0,
+            "mesh_shrinks": 0, "rebalances": 0,
             "events": 0, "dropped": self.dropped,
             "occupancy_mean": None, "slots": 0,
             "mesh": None, "hbm": None,
@@ -308,6 +309,10 @@ class QueryTrace:
                 s["mesh_dispatches"] += 1
             elif ev.etype == "collective":
                 s["collectives"] += 1
+            elif ev.etype == "mesh_shrink":
+                s["mesh_shrinks"] += 1
+            elif ev.etype == "rebalance":
+                s["rebalances"] += 1
             elif ev.etype == "shard":
                 d = a.get("device")
                 if d is not None:

@@ -106,7 +106,24 @@ def render(trace: "_events.QueryTrace") -> str:
                 f"  WARNING  : device time imbalance — the slowest "
                 f"device ran {ratio:.2f}x the median (threshold "
                 f"{_skew_threshold():g}; straggling shard or skewed "
-                f"rows, see the per-device table above)")
+                f"rows, see the per-device table above; persistent "
+                f"skew triggers re-partitioning, docs/resilience.md)")
+    if s["mesh_shrinks"]:
+        for ev in list(trace.events):
+            if ev.etype == "mesh_shrink":
+                a = ev.args or {}
+                lines.append(
+                    f"  elastic  : device {a.get('device')} lost — mesh "
+                    f"shrunk {a.get('devices_before')} -> "
+                    f"{a.get('devices_after')} device(s), "
+                    f"{a.get('reshard_rows')} row(s) re-sharded")
+    if s["rebalances"]:
+        for ev in list(trace.events):
+            if ev.etype == "rebalance":
+                a = ev.args or {}
+                lines.append(
+                    f"  rebalance: skew {a.get('ratio')} — per-shard "
+                    f"rows {a.get('before')} -> {a.get('after')}")
     if s["hbm"] is not None:
         h = s["hbm"]
         lines.append(f"  memory   : peak HBM {_fmt_bytes(h['peak'])} "

@@ -41,6 +41,7 @@ from ..resilience import default_policy as _default_policy, faults as _faults
 from ..schema import Schema
 from .collectives import COMBINERS
 from .mesh import DeviceMesh
+from . import elastic as _elastic
 from ..observability.events import (DEVICE_TRACK_BASE, current_trace,
                                     traced_query)
 from ..utils.logging import get_logger
@@ -160,7 +161,7 @@ def _trace_shards(trace, op: str, dist=None, mesh=None,
 
 
 def _trace_mesh_done(trace, outs, t0: float, op: str,
-                     native: bool = False) -> None:
+                     native: bool = False, mesh=None) -> None:
     """Per-device readiness timings + the op-level mesh dispatch span.
 
     Readiness is measured by waiting on each device's output shard in
@@ -168,7 +169,9 @@ def _trace_mesh_done(trace, outs, t0: float, op: str,
     device AND every earlier one were ready — the max (the straggler) is
     exact, earlier devices' times are conservative upper bounds. Only
     runs with tracing on; the untraced path keeps jax's async dispatch
-    barrier-free.
+    barrier-free. When ``mesh`` is given, the measured durations also
+    feed the elastic layer's skew tracker (the signal behind
+    skew-adaptive repartitioning, ``parallel/elastic.py``).
     """
     if not native:
         try:
@@ -187,12 +190,16 @@ def _trace_mesh_done(trace, outs, t0: float, op: str,
                 else:  # replicated result: one copy per device
                     ordered = sorted(
                         shards, key=lambda sh: getattr(sh.device, "id", 0))
+                durs = []
                 for i, sh in enumerate(ordered):
                     jax.block_until_ready(sh.data)
                     t = trace.clock()
+                    durs.append(max(t - t0, 0.0))
                     trace.add("shard_compute", name=f"{op} d{i}", ts=t0,
-                              dur=max(t - t0, 0.0), device=i,
+                              dur=durs[-1], device=i,
                               track=DEVICE_TRACK_BASE + i)
+                if mesh is not None and len(durs) >= 2:
+                    _elastic.note_dispatch(mesh, op, durs)
         except Exception as e:
             get_logger("distributed").debug(
                 "per-device readiness trace failed for %s: %s", op, e)
@@ -328,6 +335,12 @@ class DistributedFrame:
                  f"  validity: "
                  + ("prefix" if self.shard_valid is None
                     else f"per-shard {list(map(int, self.shard_valid))}")]
+        rb = getattr(self, "_rebalance", None)
+        if rb:
+            lines.append(
+                f"  rebalance: skew {rb['ratio']:.2f} during {rb['op']}; "
+                f"per-shard rows {rb['before']} -> {rb['after']} "
+                f"(proportional to observed device throughput)")
         for f in self.schema:
             col = self.columns[f.name]
             if isinstance(col, np.ndarray):
@@ -464,7 +477,18 @@ def dmap_blocks(fetches, dist: DistributedFrame, trim: bool = False,
     result (every emitted row is real). Default ``None`` infers from the
     row count (equal to ``padded_rows`` -> aligned); pass the flag
     explicitly when the sizes could coincide.
+
+    Like every mesh op, the dispatch runs through the elastic boundary
+    (``parallel/elastic.py``): a classified device loss shrinks the mesh,
+    re-shards, and re-runs; persistent skew re-partitions first.
     """
+    return _elastic.elastic_call(
+        "dmap_blocks", dist,
+        lambda d: _dmap_blocks(fetches, d, trim, row_aligned))
+
+
+def _dmap_blocks(fetches, dist: DistributedFrame, trim: bool,
+                 row_aligned: Optional[bool]) -> DistributedFrame:
     schema = dist.schema
     if row_aligned is False and not trim:
         raise ValueError(
@@ -518,7 +542,7 @@ def dmap_blocks(fetches, dist: DistributedFrame, trim: bool = False,
     out = policy.call(_dispatch, op="dmap_blocks.dispatch")
     if trace is not None:
         _trace_mesh_done(trace, [out[s.name] for s in comp.outputs], t0,
-                         "dmap_blocks")
+                         "dmap_blocks", mesh=mesh)
     leads = {out[s.name].shape[0] for s in comp.outputs}
     if len(leads) > 1:
         raise ValueError(
@@ -565,6 +589,11 @@ def dfilter(predicate, dist: DistributedFrame) -> DistributedFrame:
     contract: named args select columns, one rank-1 boolean/integer
     fetch.
     """
+    return _elastic.elastic_call("dfilter", dist,
+                                 lambda d: _dfilter(predicate, d))
+
+
+def _dfilter(predicate, dist: DistributedFrame) -> DistributedFrame:
     schema = dist.schema
     comp = _ops._filter_computation(predicate, schema)
     bad = [n for n in comp.input_names
@@ -648,7 +677,7 @@ def dfilter(predicate, dist: DistributedFrame) -> DistributedFrame:
         with span("dfilter.dispatch"):
             outs = fn(cnt_dev, *arrays)
         if trace is not None:
-            _trace_mesh_done(trace, list(outs), t0, "dfilter")
+            _trace_mesh_done(trace, list(outs), t0, "dfilter", mesh=mesh)
     new_cols: Dict[str, jax.Array] = dict(zip(tensor_names, outs))
     counts = _read_global(outs[len(tensor_names)]).astype(np.int64)
     if host_names:
@@ -703,6 +732,12 @@ def dsort(keys, dist: DistributedFrame, descending: bool = False
     if isinstance(keys, str):
         keys = [keys]
     keys = list(keys)
+    return _elastic.elastic_call("dsort", dist,
+                                 lambda d: _dsort(keys, d, descending))
+
+
+def _dsort(keys, dist: DistributedFrame, descending: bool
+           ) -> DistributedFrame:
     schema = dist.schema
     for k in keys:
         f = schema.get(k)
@@ -837,7 +872,7 @@ def _dsort_local(dist, keys, descending, tensor_names, arrays, valid_dev,
     with span("dsort.dispatch"):
         outs = fn(valid_dev, *arrays)
     if trace is not None:
-        _trace_mesh_done(trace, list(outs), t0, "dsort")
+        _trace_mesh_done(trace, list(outs), t0, "dsort", mesh=mesh)
     return outs
 
 
@@ -1048,7 +1083,7 @@ def _dsort_columnsort(dist, keys, descending, tensor_names, arrays,
     with span("dsort.columnsort_dispatch"):
         outs = fn(valid_dev, *arrays)
     if trace is not None:
-        _trace_mesh_done(trace, list(outs), t0, "dsort")
+        _trace_mesh_done(trace, list(outs), t0, "dsort", mesh=mesh)
     return outs
 
 
@@ -1068,8 +1103,11 @@ def dreduce_blocks(fetches, dist: DistributedFrame):
     """
     if isinstance(fetches, Mapping) and all(
             isinstance(v, str) for v in fetches.values()):
-        return _collective_reduce(fetches, dist)
-    return _generic_reduce(fetches, dist)
+        return _elastic.elastic_call(
+            "dreduce_blocks", dist,
+            lambda d: _collective_reduce(fetches, d))
+    return _elastic.elastic_call(
+        "dreduce_blocks", dist, lambda d: _generic_reduce(fetches, d))
 
 
 # Compiled collective-reduce programs, keyed by everything that shapes the
@@ -1190,7 +1228,8 @@ def _collective_reduce(col_combiners: Mapping[str, str],
         with span("dreduce_blocks.collective_dispatch"):
             outs = fn(nv_dev, *arrays)
         if trace is not None:
-            _trace_mesh_done(trace, list(outs), t0, "dreduce_blocks")
+            _trace_mesh_done(trace, list(outs), t0, "dreduce_blocks",
+                             mesh=mesh)
     result = {}
     for name, a in zip(names, outs):
         v = np.asarray(a)
@@ -1492,12 +1531,25 @@ def daggregate(fetches, dist: DistributedFrame, keys,
     never visit the host) hold on the default jax dispatch, not on the
     native route. Latency-sensitive iterative workloads should keep the
     jax path for this op.
+
+    Skew: on the monoid host-key jax path, a key group holding more
+    than ``TFT_HOT_KEY_FRACTION`` of the rows is **salted** across the
+    data shards (``parallel/elastic.py``) — per-salt partials fold back
+    on the host, so results keep the same groups and order (float sums
+    may reassociate, like any resharding).
     """
     if isinstance(keys, str):
         keys = [keys]
     keys = list(keys)
     if not keys:
         raise ValueError("daggregate needs at least one key column")
+    return _elastic.elastic_call(
+        "daggregate", dist,
+        lambda d: _daggregate(fetches, d, keys, max_groups))
+
+
+def _daggregate(fetches, dist: DistributedFrame, keys,
+                max_groups: Optional[int]) -> TensorFrame:
     schema = dist.schema
     for k in keys:
         if k not in schema:
@@ -1524,6 +1576,26 @@ def daggregate(fetches, dist: DistributedFrame, keys,
     ids_dev, uniques, uniq_dev, count_dev, num_groups = _cached_group_ids(
         dist, keys, max_groups)
 
+    # hot-key salting (host-key jax path only): split any group holding
+    # more than the threshold fraction of rows across the shards'
+    # salt slots; the per-salt partials fold back on the host below.
+    # Cached per (frame, keys, threshold) like the group ids themselves.
+    salt_plan = None
+    if not device_keys and mesh.num_data_shards > 1:
+        frac = _elastic.salt_fraction()
+        if frac is not None:
+            skey = ("salt", tuple(keys), frac)
+            cached = _group_ids_cache_get(dist, skey)
+            if cached is None:
+                cached = (_elastic.plan_key_salt(
+                    dist, ids_dev, num_groups, mesh.num_data_shards),)
+                _group_ids_cache_put(dist, skey, cached)
+            salt_plan = cached[0]
+    if salt_plan is not None:
+        prog_ids, prog_groups = salt_plan[0], salt_plan[1]
+    else:
+        prog_ids, prog_groups = ids_dev, num_groups
+
     fetch_names = sorted(col_combiners)
     arrays = [dist.columns[f] for f in fetch_names]
     in_specs = (P(axis),) + tuple(
@@ -1536,7 +1608,8 @@ def daggregate(fetches, dist: DistributedFrame, keys,
             for f, v in zip(fetch_names, vals_local):
                 cname = col_combiners[f]
                 if cname == "sum":
-                    local = _segsum(v, ids_local, num_groups, impl=seg_impl)
+                    local = _segsum(v, ids_local, prog_groups,
+                                    impl=seg_impl)
                 else:
                     # mask pad/out-of-range rows to the combiner's neutral
                     # and clamp their id to 0 so XLA's segment primitive
@@ -1550,7 +1623,8 @@ def daggregate(fetches, dist: DistributedFrame, keys,
                     seg = {"min": jax.ops.segment_min,
                            "max": jax.ops.segment_max,
                            "prod": jax.ops.segment_prod}[cname]
-                    local = seg(masked, safe_ids, num_segments=num_groups)
+                    local = seg(masked, safe_ids,
+                                num_segments=prog_groups)
                     # a group absent from this shard holds the identity;
                     # for min/max that identity is +-inf, which the
                     # cross-shard collective absorbs (every group exists
@@ -1565,11 +1639,13 @@ def daggregate(fetches, dist: DistributedFrame, keys,
     # the C++ session, DebugRowOps.scala:617-662). The XLA scatter-add
     # segment_sum flavor is forced: the Pallas flavor lowers to Mosaic
     # custom calls the native core's backends cannot compile.
-    pkey = ("daggregate", mesh.mesh, axis, num_groups,
+    pkey = ("daggregate", mesh.mesh, axis, prog_groups,
             tuple((f, col_combiners[f]) for f in fetch_names),
             tuple((a.shape, str(a.dtype)) for a in arrays))
     tables = None
-    nm = _native_mesh(mesh)
+    # salted programs stay on the jax path: the host-side fold below is
+    # the salting's second half, and the native route re-marshals anyway
+    nm = None if salt_plan is not None else _native_mesh(mesh)
     if nm is not None:
         def build_prog():
             return shard_map(make_shard_fn("xla"), mesh=mesh.mesh,
@@ -1605,10 +1681,14 @@ def daggregate(fetches, dist: DistributedFrame, keys,
                 trace.add("collective", name=COMBINERS[col_combiners[f]].ici,
                           ts=t0, column=f, op="daggregate")
         with span("daggregate.dispatch"):
-            tables = fn(ids_dev, *arrays)
+            tables = fn(prog_ids, *arrays)
         if trace is not None:
-            _trace_mesh_done(trace, list(tables), t0, "daggregate")
+            _trace_mesh_done(trace, list(tables), t0, "daggregate",
+                             mesh=mesh)
 
+    if salt_plan is not None:
+        tables = [_elastic.fold_salted(t, salt_plan[2], col_combiners[f])
+                  for f, t in zip(fetch_names, tables)]
     if device_keys:
         cols, num_out = _device_key_columns(dist, keys, uniq_dev,
                                             count_dev, max_groups)
@@ -1778,7 +1858,7 @@ def _segmented_fold(comp, names, mesh: DeviceMesh, arrays, ids_dev,
         outs = fn(ids_dev, *arrays)
     if trace is not None:
         _trace_mesh_done(trace, [outs[f] for f in names], t0,
-                         "daggregate")
+                         "daggregate", mesh=mesh)
     return outs
 
 
@@ -1971,7 +2051,7 @@ def _generic_reduce(fetches, dist: DistributedFrame) -> Dict[str, np.ndarray]:
             final = fn(*arrays)
         if trace is not None:
             _trace_mesh_done(trace, [final[f] for f in names], t0,
-                             "dreduce_blocks")
+                             "dreduce_blocks", mesh=mesh)
     out = {}
     for f in fetch_names:
         v = np.asarray(final[f])

@@ -1,0 +1,527 @@
+"""Elastic meshes: device-loss tolerance and skew-adaptive repartitioning.
+
+The reference survived executor loss for free — Spark's lineage re-ran
+the lost partitions on the survivors. The TPU-native port had nothing:
+one dead chip in the mesh killed every query on it, even though the
+resilience layer already classified the error and the mesh observability
+already measured per-device stragglers without acting on either signal.
+This module closes both loops at the one place every mesh op passes
+through — the dispatch boundary of ``dmap_blocks`` / ``dfilter`` /
+``dsort`` / ``dreduce_blocks`` / ``daggregate``:
+
+- **Device-loss tolerance** (:func:`elastic_call`): a failure classified
+  ``device_lost`` (:func:`~..resilience.is_device_lost` — real
+  ``DEVICE_LOST`` statuses, or the deterministic ``device`` fault site)
+  rebuilds a shrunken :class:`~.mesh.DeviceMesh` over the surviving
+  devices, re-shards the frame (host round-trip; the rows that lived on
+  the lost device are the ones that genuinely have to move, counted in
+  ``mesh.reshard_rows``), and re-runs the op — the query completes with
+  correct results instead of raising. DrJAX's sharded-MapReduce framing
+  (PAPERS.md) is the reference point: the op is a mesh-shape-polymorphic
+  program, so re-expressing it over S-1 devices is a re-shard plus a
+  re-dispatch, not a rewrite. Only data-only meshes (every non-data axis
+  of size 1) can shrink rectangularly; anything else re-raises.
+
+  NOTE on lineage: the re-shard reads the frame's device-resident
+  blocks back through the host. Under fault injection (and host-backed
+  CPU meshes) every shard is still readable; on real hardware the lost
+  device's shard may not be, in which case the re-shard itself raises
+  and the caller must rebuild from its host-side source — re-computing
+  lost shards from true lineage is the documented follow-on.
+
+- **Skew-adaptive repartitioning** (:func:`note_dispatch` →
+  ``_maybe_rebalance``): the mesh observability layer's per-device
+  readiness timings (recorded while tracing is on) feed a per-mesh
+  tracker; when the straggler ratio (max/median device time) stays above
+  ``TFT_SKEW_WARN`` for ``TFT_SKEW_REBALANCE_AFTER`` consecutive
+  dispatches, the next op on that mesh re-partitions the frame's rows
+  proportionally to observed per-device throughput (slow devices get
+  fewer valid rows; the padded layout stays equal-shard, per-shard
+  validity carries the imbalance). Before/after balance is recorded on
+  the frame (rendered by ``DistributedFrame.explain()``) and as a
+  ``rebalance`` trace event.
+
+- **Hot-key salting** (:func:`plan_key_salt` / :func:`fold_salted`):
+  ``daggregate``'s monoid host-key path splits any key holding more than
+  ``TFT_HOT_KEY_FRACTION`` of the rows across ``num_data_shards`` salt
+  slots and folds the per-salt partials back on the host — bounding the
+  largest segment a single scatter lane ever sees.
+
+Counters (always on): ``mesh.devices_lost``, ``mesh.shrinks``,
+``mesh.reshard_rows``, ``mesh.rebalances``, ``mesh.salted_keys`` — also
+exported as ``tft_mesh_*`` series on the metrics endpoint. Trace events
+(when a query trace is active): ``mesh_shrink`` (one per lost device,
+carrying its id), ``rebalance``, ``key_salt``.
+
+Zero-cost-when-healthy: with no fault armed and no skew pending,
+:func:`elastic_call` adds one env read, one fault-site check, and one
+dict probe per op (bench-enforced <2%, ``bench.py``
+``elastic_degraded_mesh``). ``TFT_ELASTIC=0`` disables recovery (a
+device loss raises, the pre-elastic behavior); :func:`bypass` strips the
+layer entirely for benchmark baselines.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import re
+import statistics
+import threading
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from ..observability import events as _obs
+from ..observability import metrics as _metrics
+from ..resilience import faults as _faults
+from ..resilience.classify import is_device_lost
+from ..resilience.policy import env_bool, env_float, env_int
+from ..utils.logging import get_logger
+from ..utils.tracing import counters, gauge
+from .mesh import DeviceMesh
+
+__all__ = ["elastic_call", "enabled", "bypass", "lost_device_ids",
+           "shrink_mesh", "reshard", "note_dispatch", "salt_fraction",
+           "plan_key_salt", "fold_salted"]
+
+_log = get_logger("parallel.elastic")
+
+_bypassed = False
+
+
+def enabled() -> bool:
+    """Device-loss recovery armed? (``TFT_ELASTIC``, default on.)"""
+    return not _bypassed and env_bool("TFT_ELASTIC", True)
+
+
+@contextlib.contextmanager
+def bypass():
+    """Strip the elastic layer entirely (no fault-site check, no skew
+    tracker, no recovery) — the benchmark baseline for measuring what
+    the enabled-but-idle layer costs on a healthy mesh."""
+    global _bypassed
+    was = _bypassed
+    _bypassed = True
+    try:
+        yield
+    finally:
+        _bypassed = was
+
+
+# ---------------------------------------------------------------------------
+# the dispatch boundary
+# ---------------------------------------------------------------------------
+
+def elastic_call(op: str, dist, run: Callable):
+    """Run ``run(dist)`` with skew-adaptive repartitioning and
+    device-loss recovery.
+
+    ``run`` must be re-runnable against a replacement frame: it receives
+    the (possibly re-sharded) :class:`~.distributed.DistributedFrame`
+    and performs the whole op, including its own transient-retry policy.
+    On a ``device_lost`` failure the mesh shrinks by the lost device(s)
+    and ``run`` re-runs on the re-sharded frame; up to S-1 successive
+    losses are survivable, a loss on a 1-shard mesh re-raises.
+    """
+    if _bypassed:
+        return run(dist)
+    dist = _maybe_rebalance(op, dist)
+    rebalance = getattr(dist, "_rebalance", None)
+    result = None
+    last: Optional[BaseException] = None
+    for _ in range(max(dist.mesh.num_data_shards, 1)):
+        try:
+            _faults.check("device")
+            result = run(dist)
+            break
+        except Exception as e:  # noqa: BLE001 - reclassified below
+            if not is_device_lost(e) or not enabled():
+                raise
+            if dist.mesh.num_data_shards <= 1:
+                _log.error(
+                    "%s: device lost on a single-shard mesh — nothing "
+                    "to shrink to; re-raising", op)
+                raise
+            last = e
+            dist = _recover(e, dist, op)
+            # recovery re-sharded with an even prefix layout: any
+            # rebalanced per-shard placement from this call is gone,
+            # and reporting it on the result would be a lie
+            rebalance = None
+    else:
+        raise last if last is not None else RuntimeError(
+            f"{op}: elastic recovery exhausted")  # pragma: no cover
+    if rebalance is not None and hasattr(result, "mesh") \
+            and hasattr(result, "schema"):
+        # surface the rebalance on the frame the CALLER holds (the op's
+        # output derives from the rebalanced input): explain() renders it
+        result._rebalance = rebalance
+    return result
+
+
+def lost_device_ids(exc: BaseException, mesh: DeviceMesh) -> List[int]:
+    """Which flat device indices of ``mesh`` died, best-effort.
+
+    The error message is the primary evidence (``device <i>`` — PJRT
+    status texts and the injected ``device`` fault both name the index);
+    failing that, each device is probed with a tiny transfer and the
+    unresponsive ones are reported. When neither identifies a device
+    (e.g. an anonymous ``DEVICE_LOST`` on a healthy-looking host-backed
+    mesh), device 0 is dropped — documented, deterministic, and safe:
+    dropping a healthy device only shrinks capacity.
+    """
+    n = mesh.num_devices
+    ids = sorted({int(m) for m in
+                  re.findall(r"device[\s_#]*(\d+)", str(exc),
+                             re.IGNORECASE)
+                  if 0 <= int(m) < n})
+    if ids and len(ids) < n:
+        return ids
+    lost = []
+    for i, d in enumerate(mesh.mesh.devices.flat):
+        try:
+            jax.block_until_ready(jax.device_put(np.zeros(1, np.int8), d))
+        except Exception as probe_err:  # noqa: BLE001 - probing for death
+            _log.warning("device %d failed its liveness probe: %s",
+                         i, probe_err)
+            lost.append(i)
+    if lost and len(lost) < n:
+        return lost
+    _log.warning("could not identify the lost device from %r; dropping "
+                 "device 0 (set TFT_FAULT_DEVICE / name the device in "
+                 "the error to steer this)", str(exc)[:200])
+    return [0]
+
+
+def shrink_mesh(mesh: DeviceMesh, lost: Sequence[int]) -> DeviceMesh:
+    """A new data mesh over ``mesh``'s devices minus ``lost`` (flat
+    indices). Only data-only meshes (every non-data axis of size 1) can
+    shrink rectangularly; others raise."""
+    if mesh.num_devices != mesh.num_data_shards:
+        raise ValueError(
+            f"elastic shrink needs a data-only mesh (non-data axes all "
+            f"size 1); {mesh!r} has {mesh.num_devices} devices over "
+            f"{mesh.num_data_shards} data shards")
+    gone = set(lost)
+    survivors = [d for i, d in enumerate(mesh.mesh.devices.flat)
+                 if i not in gone]
+    if not survivors:
+        raise ValueError(f"all {mesh.num_devices} devices of {mesh!r} "
+                         f"reported lost; nothing to shrink to")
+    # the survivors go on the DATA axis, wherever it sits — every other
+    # axis is size 1 (the data-only guard above)
+    data_pos = mesh.axis_names.index(mesh.data_axis)
+    shape = tuple(len(survivors) if i == data_pos else 1
+                  for i in range(len(mesh.axis_names)))
+    arr = np.array(survivors).reshape(shape)
+    return DeviceMesh(Mesh(arr, mesh.axis_names), data_axis=mesh.data_axis)
+
+
+def reshard(dist, mesh: DeviceMesh,
+            shard_rows: Optional[np.ndarray] = None):
+    """Rebuild ``dist``'s columns over ``mesh`` through the host.
+
+    ``shard_rows`` (len ``mesh.num_data_shards``) places each shard's
+    valid-row count explicitly (the skew-rebalance layout; per-shard
+    validity carries the imbalance); ``None`` lays the valid rows out as
+    an even prefix (the ``distribute()`` layout). Global row order is
+    preserved either way, so row-local results collect bit-identically.
+    """
+    from .distributed import DistributedFrame  # import cycle: lazy
+
+    S = mesh.num_data_shards
+    n = dist.num_rows
+    mask = dist.valid_row_mask()
+    if shard_rows is None:
+        padded = ((n + S - 1) // S) * S if n else S
+        shard_valid_out = None
+        offsets = None
+    else:
+        shard_rows = np.asarray(shard_rows, np.int64)
+        if shard_rows.shape != (S,) or int(shard_rows.sum()) != n:
+            raise ValueError(
+                f"shard_rows {shard_rows} does not distribute {n} rows "
+                f"over {S} shards")
+        rows_per = max(1, int(shard_rows.max()))
+        padded = rows_per * S
+        shard_valid_out = shard_rows
+        offsets = np.concatenate([[0], np.cumsum(shard_rows)[:-1]])
+
+    def place(valid: np.ndarray, fill) -> np.ndarray:
+        out = np.full((padded,) + valid.shape[1:], fill, valid.dtype)
+        if shard_rows is None:
+            out[:n] = valid
+        else:
+            for i in range(S):
+                k = int(shard_rows[i])
+                out[i * rows_per: i * rows_per + k] = \
+                    valid[offsets[i]: offsets[i] + k]
+        return out
+
+    cols: Dict[str, object] = {}
+    for f in dist.schema:
+        a = dist.host_read_padded(f.name)
+        a = a[mask] if dist.shard_valid is not None else a[:n]
+        if not f.dtype.tensor:
+            cols[f.name] = place(a, None)
+            continue
+        out = place(a, 0)
+        cols[f.name] = jax.device_put(out, mesh.row_sharding(out.ndim))
+    return DistributedFrame(mesh, dist.schema, cols, n,
+                            shard_valid=shard_valid_out)
+
+
+def _recover(exc: BaseException, dist, op: str):
+    """Shrink + re-shard after a classified device loss; returns the
+    replacement frame (same rows, smaller mesh)."""
+    mesh = dist.mesh
+    lost = lost_device_ids(exc, mesh)
+    new_mesh = shrink_mesh(mesh, lost)  # raises for non-data meshes
+    # 1-axis data mesh: flat device index == data shard index, so the
+    # lost shards' valid rows are exactly the data that must round-trip
+    per_shard = dist.per_shard_valid()
+    moved = int(sum(per_shard[i] for i in lost
+                    if i < mesh.num_data_shards))
+    new_dist = reshard(dist, new_mesh)
+    counters.inc("mesh.devices_lost", len(lost))
+    counters.inc("mesh.shrinks")
+    counters.inc("mesh.reshard_rows", moved)
+    gauge("mesh.active_devices", new_mesh.num_devices)
+    for d in lost:
+        _obs.add_event("mesh_shrink", name=op, device=int(d),
+                       devices_before=mesh.num_devices,
+                       devices_after=new_mesh.num_devices,
+                       reshard_rows=moved)
+    _log.warning(
+        "%s: device loss (%s); lost device(s) %s — mesh shrunk "
+        "%d -> %d shards, %d row(s) re-sharded through the host; "
+        "re-running the op on the surviving devices",
+        op, type(exc).__name__, lost, mesh.num_data_shards,
+        new_mesh.num_data_shards, moved)
+    return new_dist
+
+
+# ---------------------------------------------------------------------------
+# skew-adaptive repartitioning
+# ---------------------------------------------------------------------------
+
+_tracker_lock = threading.Lock()
+# mesh identity (flat device-id tuple) -> {"hits": consecutive
+# above-threshold dispatches, "times": last per-device durations}
+_tracker: Dict[tuple, dict] = {}
+
+
+def _mesh_key(mesh: DeviceMesh) -> tuple:
+    return tuple(int(getattr(d, "id", i))
+                 for i, d in enumerate(mesh.mesh.devices.flat))
+
+
+def _rebalance_after() -> int:
+    """Consecutive skewed dispatches before acting (0 disables)."""
+    return env_int("TFT_SKEW_REBALANCE_AFTER", 3)
+
+
+def note_dispatch(mesh: DeviceMesh, op: str,
+                  times: Sequence[float]) -> None:
+    """Feed one traced dispatch's per-device readiness durations to the
+    skew tracker (called from the d-ops' trace instrumentation — per-
+    device timings only exist while tracing is on, exactly like the
+    skew report they power).
+
+    ``times`` are the CUMULATIVE ordered-wait readiness durations the
+    trace records (duration until device i AND every earlier one were
+    ready). Detection uses their max/median ratio — exactly the skew
+    report's straggler signal, with the same inherent blind spot (a
+    shard-0 straggler inflates every cumulative time equally and is
+    invisible; only late-shard stragglers cross the threshold). The
+    REBALANCE weights, however, must not be: ``1/cumulative`` is
+    monotone toward shard 0 by construction, so per-device cost is
+    estimated from the marginal increments (the extra wait each shard
+    added beyond its predecessor), floored at 10% of the largest
+    increment — a shard that added no wait is "fast", but never more
+    than 10x faster than the straggler.
+    """
+    n = _rebalance_after()
+    if n <= 0 or len(times) < 2:
+        return
+    med = statistics.median(times)
+    ratio = (max(times) / med) if med > 0 else 0.0
+    from ..observability.report import _skew_threshold
+    key = _mesh_key(mesh)
+    with _tracker_lock:
+        if ratio > _skew_threshold():
+            incs = [float(times[0])] + [
+                max(float(t) - float(p), 0.0)
+                for p, t in zip(times, times[1:])]
+            floor = 0.1 * max(incs)
+            st = _tracker.setdefault(key, {"hits": 0, "times": None})
+            st["hits"] += 1
+            st["times"] = [max(i, floor) for i in incs]
+            st["ratio"] = ratio
+        else:  # a balanced dispatch resets the streak; dropping the
+            # entry keeps the tracker EMPTY on healthy meshes, which is
+            # what keeps _maybe_rebalance's fast path one dict probe
+            _tracker.pop(key, None)
+
+
+def _maybe_rebalance(op: str, dist):
+    """Re-partition ``dist`` proportionally to observed per-device
+    throughput once the tracker says the skew is persistent."""
+    if not _tracker:
+        # fast path (bench-enforced): no skew recorded on ANY mesh —
+        # one dict truthiness check, no lock, no env read, no mesh key
+        return dist
+    n = _rebalance_after()
+    if n <= 0:
+        return dist
+    key = _mesh_key(dist.mesh)
+    with _tracker_lock:
+        st = _tracker.get(key)
+        if st is None or st["hits"] < n or st["times"] is None:
+            return dist
+        times = st["times"]
+        ratio = st.get("ratio", 0.0)
+        _tracker.pop(key)  # act once per streak
+    S = dist.mesh.num_data_shards
+    if len(times) != S or dist.num_rows < S:
+        return dist
+    try:
+        before = dist.per_shard_valid()
+    except ValueError:
+        return dist  # non-tiling global-result frames keep their layout
+    # rows proportional to throughput (1/time), exact total via largest
+    # remainders
+    speed = np.array([1.0 / max(t, 1e-9) for t in times])
+    want = speed / speed.sum() * dist.num_rows
+    after = np.floor(want).astype(np.int64)
+    rem = dist.num_rows - int(after.sum())
+    if rem > 0:
+        order = np.argsort(-(want - after))
+        after[order[:rem]] += 1
+    if np.array_equal(before, after):
+        return dist
+    new_dist = reshard(dist, dist.mesh, shard_rows=after)
+    counters.inc("mesh.rebalances")
+    _obs.add_event("rebalance", name=op, ratio=round(ratio, 3),
+                   before=[int(v) for v in before],
+                   after=[int(v) for v in after])
+    new_dist._rebalance = {"op": op, "ratio": ratio,
+                           "before": [int(v) for v in before],
+                           "after": [int(v) for v in after]}
+    _log.info(
+        "%s: straggler ratio %.2f persisted %d dispatch(es); rows "
+        "re-partitioned by observed throughput %s -> %s", op, ratio, n,
+        [int(v) for v in before], [int(v) for v in after])
+    return new_dist
+
+
+# ---------------------------------------------------------------------------
+# hot-key salting (daggregate's monoid host-key path)
+# ---------------------------------------------------------------------------
+
+def salt_fraction() -> Optional[float]:
+    """The hot-key frequency threshold, or None when salting is off
+    (``TFT_SALT_HOT_KEYS``, default on; ``TFT_HOT_KEY_FRACTION``,
+    default 0.5 — a key is hot above HALF the rows)."""
+    if not env_bool("TFT_SALT_HOT_KEYS", True):
+        return None
+    frac = env_float("TFT_HOT_KEY_FRACTION", 0.5)
+    if frac is None or not 0.0 < frac < 1.0:
+        return None
+    return frac
+
+
+def plan_key_salt(dist, ids_dev, num_groups: int, n_shards: int
+                  ) -> Optional[Tuple[object, int, Tuple[np.ndarray, int]]]:
+    """Salt hot groups across ``n_shards`` slots.
+
+    Returns ``(salted_ids_dev, effective_groups, (hot, K))`` — or None
+    when no group crosses the threshold (or the frame is too small for
+    salting to matter). Row ``r`` of a hot group lands in salt slot
+    ``r % K``; slot 0 keeps the original group id, slots 1..K-1 map to
+    appended table rows that :func:`fold_salted` folds back, so cold
+    groups and output order are untouched.
+    """
+    frac = salt_fraction()
+    if frac is None or n_shards <= 1 or num_groups <= 0:
+        return None
+    n = dist.num_rows
+    if n < 4 * n_shards:
+        return None
+    ids_host = np.asarray(ids_dev)
+    valid = ids_host >= 0
+    counts = np.bincount(ids_host[valid], minlength=num_groups)
+    hot = np.flatnonzero(counts > frac * n)
+    if hot.size == 0:
+        return None
+    K = n_shards
+    G = num_groups
+    hot_rank = np.full(G, -1, np.int64)
+    hot_rank[hot] = np.arange(hot.size)
+    j = np.arange(ids_host.shape[0]) % K  # even spread within each shard
+    salted = ids_host.astype(np.int64, copy=True)
+    m = valid & (hot_rank[np.clip(ids_host, 0, G - 1)] >= 0) & (j > 0)
+    salted[m] = G + hot_rank[ids_host[m]] * (K - 1) + (j[m] - 1)
+    salted = salted.astype(np.int32)
+    eff = G + int(hot.size) * (K - 1)
+    ids2 = jax.make_array_from_callback(
+        (salted.shape[0],), dist.mesh.row_sharding(1),
+        lambda idx: salted[idx])
+    counters.inc("mesh.salted_keys", int(hot.size))
+    _obs.add_event("key_salt", name="daggregate", count=int(hot.size),
+                   salt=K, groups=[int(g) for g in hot[:16]])
+    _log.info("daggregate: %d hot key group(s) (> %.0f%% of %d rows) "
+              "salted across %d slots", hot.size, frac * 100, n, K)
+    return ids2, eff, (hot, K)
+
+
+_SALT_FOLD = {"sum": np.add, "min": np.minimum, "max": np.maximum,
+              "prod": np.multiply}
+
+
+def fold_salted(table, salt_map: Tuple[np.ndarray, int],
+                cname: str) -> np.ndarray:
+    """Fold a ``[effective_groups, ...]`` salted partial table back to
+    ``[num_groups, ...]`` with the combiner's numpy twin."""
+    hot, K = salt_map
+    t = np.asarray(table)
+    G = t.shape[0] - hot.size * (K - 1)
+    base = t[:G].copy()
+    if hot.size:
+        extras = t[G:].reshape((hot.size, K - 1) + t.shape[1:])
+        stack = np.concatenate([base[hot][:, None], extras], axis=1)
+        base[hot] = _SALT_FOLD[cname].reduce(stack, axis=1)
+    return base
+
+
+# ---------------------------------------------------------------------------
+# metrics
+# ---------------------------------------------------------------------------
+
+_MESH_FAMILIES = (
+    ("mesh.devices_lost", "tft_mesh_devices_lost_total",
+     "Mesh devices lost and recovered from (elastic shrink)."),
+    ("mesh.shrinks", "tft_mesh_shrinks_total",
+     "Mesh shrink events (one per loss incident, any device count)."),
+    ("mesh.reshard_rows", "tft_mesh_reshard_rows_total",
+     "Rows re-sharded through the host by elastic recovery."),
+    ("mesh.rebalances", "tft_mesh_rebalances_total",
+     "Skew-adaptive repartitions applied."),
+    ("mesh.salted_keys", "tft_mesh_salted_keys_total",
+     "Hot key groups salted across shards by daggregate."),
+)
+
+
+def _render_metrics() -> List[str]:
+    snap = counters.snapshot()
+    lines: List[str] = []
+    for key, fam, help_text in _MESH_FAMILIES:
+        lines.append(f"# HELP {fam} {help_text}")
+        lines.append(f"# TYPE {fam} counter")
+        lines.append(f"{fam} {snap.get(key, 0)}")
+    return lines
+
+
+_metrics.register_metrics_provider("mesh", _render_metrics)

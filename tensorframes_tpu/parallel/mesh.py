@@ -66,12 +66,30 @@ def local_mesh(num_devices: Optional[int] = None,
     """
     devices = jax.devices()
     if num_devices is not None:
+        if num_devices < 1:
+            raise ValueError(
+                f"num_devices must be >= 1, got {num_devices}")
+        if num_devices > len(devices):
+            raise ValueError(
+                f"local_mesh(num_devices={num_devices}) asked for more "
+                f"devices than the {len(devices)} visible; lower "
+                f"num_devices (or add devices, e.g. "
+                f"--xla_force_host_platform_device_count on CPU)")
         devices = devices[:num_devices]
     n = len(devices)
     if shape is None:
         shape = (n,) + (1,) * (len(axis_names) - 1)
     if int(np.prod(shape)) != n:
-        raise ValueError(f"Mesh shape {shape} does not cover {n} devices")
+        # validate against what the CALLER asked for: naming only the
+        # visible device count when num_devices was given is misleading
+        if num_devices is not None:
+            raise ValueError(
+                f"Mesh shape {shape} covers {int(np.prod(shape))} "
+                f"device(s) but num_devices={num_devices} was requested "
+                f"— make the shape's product equal num_devices")
+        raise ValueError(
+            f"Mesh shape {shape} covers {int(np.prod(shape))} device(s) "
+            f"but {n} are visible")
     arr = np.array(devices).reshape(shape)
     return DeviceMesh(Mesh(arr, tuple(axis_names)),
                       data_axis=axis_names[0])

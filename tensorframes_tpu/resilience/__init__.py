@@ -34,9 +34,9 @@ degradation matrix — what falls back versus what fails fast — is
 documented in ``docs/resilience.md``.
 """
 
-from .classify import (AdmissionDeadline, OverQuota, QueueFull,
-                       ServeRejected, error_kind, is_oom, is_permanent,
-                       is_transient)
+from .classify import (AdmissionDeadline, DeviceLost, OverQuota,
+                       QueueFull, ServeRejected, error_kind,
+                       is_device_lost, is_oom, is_permanent, is_transient)
 from .faults import InjectedFault, inject
 from .policy import (DEFAULT_POLICY, ClusterInitError, DeadlineExceeded,
                      RetryPolicy, check_deadline, deadline, default_policy,
@@ -47,8 +47,10 @@ __all__ = [
     "RetryPolicy", "DeadlineExceeded", "ClusterInitError",
     "DEFAULT_POLICY", "default_policy", "deadline", "remaining_time",
     "check_deadline",
-    "is_transient", "is_oom", "is_permanent", "error_kind",
+    "is_transient", "is_oom", "is_permanent", "is_device_lost",
+    "error_kind",
     "ServeRejected", "QueueFull", "OverQuota", "AdmissionDeadline",
+    "DeviceLost",
     "env_bool", "env_float", "env_int",
     "faults", "inject", "InjectedFault",
 ]

@@ -9,6 +9,10 @@ Three buckets, three responses:
   same program would fail identically, but HALVING the rows and running
   the two halves usually succeeds for row-local computations
   (``engine/executor.py``'s split-block re-dispatch).
+- **device_lost** — a mesh device died (``DEVICE_LOST`` statuses, the
+  ``device`` fault site): neither retrying nor splitting helps; the
+  elastic layer (``parallel.elastic``) rebuilds a shrunken mesh over
+  the surviving devices, re-shards, and re-runs the op.
 - **permanent** — everything else (shape errors, type errors, compile
   diagnostics): fail fast, loudly, once.
 
@@ -22,9 +26,23 @@ from __future__ import annotations
 
 import os
 
-__all__ = ["is_transient", "is_oom", "is_permanent", "error_kind",
+__all__ = ["is_transient", "is_oom", "is_permanent", "is_device_lost",
+           "error_kind",
            "ServeRejected", "QueueFull", "OverQuota", "AdmissionDeadline",
-           "TRANSIENT_MARKERS", "OOM_MARKERS"]
+           "DeviceLost",
+           "TRANSIENT_MARKERS", "OOM_MARKERS", "DEVICE_LOST_MARKERS"]
+
+
+class DeviceLost(RuntimeError):
+    """A device of the mesh is gone (chip failure, host eviction, a
+    lost ICI neighbor). Retrying the identical program would dispatch to
+    the same dead device and fail identically, so this is NOT transient;
+    the recovery is structural — ``parallel.elastic`` rebuilds a
+    shrunken mesh over the survivors, re-shards the frame, and re-runs
+    the op. Classified ``device_lost``.
+    """
+
+    kind = "device_lost"
 
 
 class ServeRejected(RuntimeError):
@@ -89,6 +107,17 @@ OOM_MARKERS = (
     "OOM",
 )
 
+# Status words that indicate a DEVICE died, not the program or the
+# network: the PJRT/runtime phrasing a lost chip surfaces under.
+# Checked BEFORE the transient markers — "UNAVAILABLE: device lost"
+# must shrink the mesh, not spin the retry loop against a dead chip.
+DEVICE_LOST_MARKERS = (
+    "DEVICE_LOST",
+    "device lost",
+    "device is lost",
+    "lost device",
+)
+
 
 def _extra_transient_markers() -> tuple:
     """Operator-extensible marker list: ``TFT_TRANSIENT_ERRORS`` is a
@@ -107,6 +136,15 @@ def is_oom(exc: BaseException) -> bool:
     return any(m in msg for m in OOM_MARKERS)
 
 
+def is_device_lost(exc: BaseException) -> bool:
+    """True when a mesh device is gone — NOT retried as-is; the elastic
+    layer (``parallel.elastic``) shrinks the mesh and re-runs."""
+    if isinstance(exc, DeviceLost):
+        return True
+    msg = str(exc)
+    return any(m in msg for m in DEVICE_LOST_MARKERS)
+
+
 def is_transient(exc: BaseException) -> bool:
     """True when retrying the same operation may legitimately succeed."""
     from .faults import InjectedFault
@@ -115,6 +153,8 @@ def is_transient(exc: BaseException) -> bool:
         return exc.transient
     if isinstance(exc, ServeRejected):
         return exc.retryable  # queue drains / bucket refills; sheds don't
+    if is_device_lost(exc):
+        return False  # same program, same dead device: shrink, don't retry
     if is_oom(exc):
         return False  # same program, same memory: split, don't retry
     if isinstance(exc, (ConnectionError, TimeoutError)):
@@ -133,11 +173,14 @@ def is_permanent(exc: BaseException) -> bool:
 def error_kind(exc: BaseException) -> str:
     """The classifier's verdict as a stable label: the serving layer's
     own kinds (``rejected`` / ``over_quota`` / ``deadline_admission``)
-    when the exception carries one, else ``oom`` / ``transient`` /
-    ``permanent``. Exported on retry/giveup trace events and in server
-    stats so dashboards never re-derive the classification."""
+    when the exception carries one, else ``device_lost`` / ``oom`` /
+    ``transient`` / ``permanent``. Exported on retry/giveup trace
+    events and in server stats so dashboards never re-derive the
+    classification."""
     if isinstance(exc, ServeRejected):
         return exc.kind
+    if is_device_lost(exc):
+        return "device_lost"
     if is_oom(exc):
         return "oom"
     if is_transient(exc):

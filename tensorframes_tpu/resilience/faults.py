@@ -30,6 +30,8 @@ drain        ``engine.executor.PendingBlock.drain`` pipelined readback
 pjrt_execute ``native_pjrt.PjrtBlockExecutor`` native-core dispatch
 dmap         ``parallel.distributed.dmap_blocks`` mesh dispatch
 batch        ``stream.runtime.StreamHandle`` per-batch processing
+device       ``parallel.elastic.elastic_call`` mesh-op dispatch boundary
+             (device-loss shaped: the elastic layer shrinks the mesh)
 ========== ===========================================================
 
 Counting is deterministic (a lock-guarded integer per site, decremented
@@ -84,6 +86,12 @@ _state = _State()
 _OOM_MESSAGE = ("RESOURCE_EXHAUSTED: injected fault: out of memory "
                 "allocating scratch for block")
 
+# the "device" site must be caught by classify.is_device_lost (mesh
+# shrink), not the retry loop; the device index in the message is what
+# parallel.elastic parses to pick the shard to drop
+_DEVICE_MESSAGE = ("DEVICE_LOST: injected fault: device %d is lost "
+                   "(chip failure simulated)")
+
 
 def _arm_from_env() -> None:
     """Parse ``TFT_FAULTS="site:count,site:count"`` once per process."""
@@ -109,13 +117,21 @@ def arm(site: str, fail_n: int = 1, message: Optional[str] = None,
 
     ``transient`` defaults to True except for the ``oom`` site, whose
     faults must reach the OOM classifier (split-block re-dispatch), not
-    the retry loop.
+    the retry loop, and the ``device`` site, whose faults must reach the
+    device-loss classifier (mesh shrink + re-shard, ``TFT_FAULT_DEVICE``
+    selects the reported device index, default 0).
     """
     if fail_n < 0:
         raise ValueError(f"fail_n must be >= 0, got {fail_n}")
     if site == "oom":
         if message is None:
             message = _OOM_MESSAGE
+        if transient is None:
+            transient = False
+    elif site == "device":
+        if message is None:
+            from .policy import env_int
+            message = _DEVICE_MESSAGE % env_int("TFT_FAULT_DEVICE", 0)
         if transient is None:
             transient = False
     elif transient is None:

@@ -578,7 +578,23 @@ class QueryScheduler:
                     tr.add("sched_start", name=q.query_id,
                            tenant=q.tenant, queue_wait_s=queue_wait)
                 with _deadline(remaining):
-                    result = q._thunk()
+                    try:
+                        result = q._thunk()
+                    except Exception as e:
+                        # a device_lost error means the mesh shrank
+                        # underneath the query (parallel/elastic.py):
+                        # one re-attempt on the surviving devices
+                        # instead of failing the future
+                        if error_kind(e) != "device_lost":
+                            raise
+                        counters.inc("serve.device_lost_retries")
+                        _obs.add_event("device_lost_retry",
+                                       name=q.query_id, tenant=q.tenant)
+                        _log.warning(
+                            "query %s (tenant %r) hit a device loss "
+                            "(%s); retrying once on the shrunken mesh",
+                            q.query_id, q.tenant, e)
+                        result = q._thunk()
         except BaseException as e:
             if isinstance(e, (KeyboardInterrupt, SystemExit)):
                 self._finish(q, t, error=e)

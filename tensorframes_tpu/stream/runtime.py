@@ -289,8 +289,26 @@ class StreamHandle:
                     # escape anywhere must hit the skip path below,
                     # never kill a pump thread
                     pool = self._lease_slot()
-                    df = default_policy().call(attempt,
-                                               op="stream.batch")
+                    try:
+                        df = default_policy().call(attempt,
+                                                   op="stream.batch")
+                    except Exception as e:
+                        # a device_lost error is structural, not
+                        # poisoned data: the elastic layer has shrunk
+                        # the mesh underneath it, so ONE re-attempt runs
+                        # the batch on the surviving devices before the
+                        # skip path gets to count it
+                        if error_kind(e) != "device_lost":
+                            raise
+                        counters.inc("stream.device_lost_retries")
+                        _obs.add_event("device_lost_retry",
+                                       name=self.name, batch=i)
+                        _log.warning(
+                            "stream %s: batch %d hit a device loss "
+                            "(%s); retrying once on the shrunken mesh",
+                            self.name, i, e)
+                        df = default_policy().call(attempt,
+                                                   op="stream.batch")
                     # fold AFTER the retried forcing, exactly once: the
                     # retry policy must never wrap ingest, whose commit
                     # mutates window state (a retried ingest would

@@ -1,0 +1,575 @@
+"""Elastic meshes: device-loss tolerance, lost-shard re-execution, and
+skew-adaptive repartitioning (``tensorframes_tpu/parallel/elastic.py``).
+
+The acceptance spine: with the deterministic ``device`` fault site armed
+on the 8-virtual-device CPU mesh, every mesh op completes with results
+bit-identical to the healthy-mesh run (integer columns pin bit-identity
+— float reductions may reassociate across shard counts, like any
+resharding), ``mesh.devices_lost`` counts the loss, and a ``mesh_shrink``
+event carrying the lost device id lands in the query trace. The skew
+half: synthetic per-device timings fed through the tracker trigger a
+proportional re-partition, and ``daggregate`` salts hot keys.
+"""
+
+import time
+
+import jax
+import numpy as np
+import pytest
+
+import tensorframes_tpu as tft
+from tensorframes_tpu import parallel as par
+from tensorframes_tpu import resilience as rz
+from tensorframes_tpu.observability import events as obs_events
+from tensorframes_tpu.parallel import elastic
+from tensorframes_tpu.resilience import faults
+from tensorframes_tpu.utils import tracing
+from tensorframes_tpu.utils.tracing import counters
+
+pytestmark = pytest.mark.elastic
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    assert len(jax.devices()) == 8, "conftest must provide 8 CPU devices"
+    return par.local_mesh(8)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    counters.reset()
+    faults.reset()
+    elastic._tracker.clear()
+    yield
+    faults.reset()
+    elastic._tracker.clear()
+    tracing.disable()
+
+
+def _int_frame(n=40, keys=5):
+    return tft.frame({"k": np.arange(n) % keys,
+                      "x": np.arange(n)})
+
+
+# ---------------------------------------------------------------------------
+# classification + fault site
+# ---------------------------------------------------------------------------
+
+class TestClassification:
+    def test_device_lost_markers(self):
+        e = RuntimeError("DEVICE_LOST: device 2 halted")
+        assert rz.is_device_lost(e)
+        assert rz.error_kind(e) == "device_lost"
+        assert not rz.is_transient(e)
+
+    def test_device_lost_beats_transient_markers(self):
+        # "UNAVAILABLE: device lost" must shrink the mesh, not spin the
+        # retry loop against a dead chip
+        e = RuntimeError("UNAVAILABLE: device lost during collective")
+        assert rz.error_kind(e) == "device_lost"
+        assert not rz.is_transient(e)
+
+    def test_device_lost_exception_class(self):
+        assert rz.error_kind(rz.DeviceLost("chip 3 gone")) == "device_lost"
+
+    def test_device_fault_site_default_shape(self):
+        faults.arm("device", 1)
+        with pytest.raises(faults.InjectedFault) as ei:
+            faults.check("device")
+        assert rz.error_kind(ei.value) == "device_lost"
+        assert "device 0" in str(ei.value)
+
+    def test_device_fault_site_env_device(self, monkeypatch):
+        monkeypatch.setenv("TFT_FAULT_DEVICE", "5")
+        faults.arm("device", 1)
+        with pytest.raises(faults.InjectedFault) as ei:
+            faults.check("device")
+        assert "device 5" in str(ei.value)
+
+    def test_tft_faults_env_arms_device_site(self, monkeypatch):
+        # the acceptance drive: TFT_FAULTS=device:1 arms the site at
+        # first check with the DEVICE_LOST-shaped default message
+        monkeypatch.setenv("TFT_FAULTS", "device:1")
+        monkeypatch.setattr(faults._state, "_armed_env", False)
+        assert faults.active("device") == 1
+        with pytest.raises(faults.InjectedFault) as ei:
+            faults.check("device")
+        assert rz.error_kind(ei.value) == "device_lost"
+
+    def test_lost_device_ids_parsed_from_message(self, mesh8):
+        e = RuntimeError("DEVICE_LOST: device 6 is lost")
+        assert elastic.lost_device_ids(e, mesh8) == [6]
+
+    def test_lost_device_ids_defaults_to_zero(self, mesh8):
+        # anonymous loss on a healthy host-backed mesh: documented
+        # deterministic fallback
+        assert elastic.lost_device_ids(
+            RuntimeError("DEVICE_LOST"), mesh8) == [0]
+
+
+# ---------------------------------------------------------------------------
+# device-loss recovery (the acceptance criterion)
+# ---------------------------------------------------------------------------
+
+class TestDeviceLossRecovery:
+    def _assert_shrink_trace(self, lost_device=0):
+        t = obs_events.last_query()
+        shr = [ev for ev in t.events if ev.etype == "mesh_shrink"]
+        assert len(shr) == 1
+        assert shr[0].args["device"] == lost_device
+        assert shr[0].args["devices_before"] == 8
+        assert shr[0].args["devices_after"] == 7
+        assert t.summary()["mesh_shrinks"] == 1
+
+    def test_dmap_blocks_bit_identical_after_loss(self, mesh8):
+        dist = par.distribute(_int_frame(), mesh8)
+        healthy = [r["z"] for r in par.dmap_blocks(
+            lambda x: {"z": x * 2}, dist).collect_frame().collect()]
+        tracing.enable()
+        try:
+            with faults.inject("device", 1):
+                out = par.dmap_blocks(lambda x: {"z": x * 2}, dist)
+        finally:
+            tracing.disable()
+        got = [r["z"] for r in out.collect_frame().collect()]
+        assert got == healthy
+        assert out.mesh.num_devices == 7
+        assert counters.get("mesh.devices_lost") == 1
+        assert counters.get("mesh.reshard_rows") > 0
+        self._assert_shrink_trace()
+
+    def test_daggregate_bit_identical_after_loss(self, mesh8):
+        dist = par.distribute(_int_frame(), mesh8)
+        healthy = par.daggregate({"x": "sum"}, dist, "k").collect()
+        tracing.enable()
+        try:
+            with faults.inject("device", 1):
+                out = par.daggregate({"x": "sum"}, dist, "k")
+        finally:
+            tracing.disable()
+        assert out.collect() == healthy
+        assert counters.get("mesh.devices_lost") == 1
+        self._assert_shrink_trace()
+
+    def test_dsort_bit_identical_after_loss(self, mesh8):
+        rng = np.random.default_rng(7)
+        df = tft.frame({"x": rng.permutation(40)})
+        dist = par.distribute(df, mesh8)
+        healthy = [r["x"] for r in par.dsort(
+            "x", dist, descending=True).collect_frame().collect()]
+        tracing.enable()
+        try:
+            with faults.inject("device", 1):
+                out = par.dsort("x", dist, descending=True)
+        finally:
+            tracing.disable()
+        got = [r["x"] for r in out.collect_frame().collect()]
+        assert got == healthy
+        assert counters.get("mesh.devices_lost") == 1
+        self._assert_shrink_trace()
+
+    def test_dfilter_and_dreduce_recover(self, mesh8):
+        dist = par.distribute(_int_frame(), mesh8)
+        with faults.inject("device", 1):
+            flt = par.dfilter(lambda x: x % 2 == 0, dist)
+        assert flt.count() == 20
+        assert [r["x"] for r in flt.collect_frame().collect()] == \
+            list(range(0, 40, 2))
+        with faults.inject("device", 1):
+            red = par.dreduce_blocks({"x": "sum"}, dist)
+        assert int(red["x"]) == sum(range(40))
+        assert counters.get("mesh.devices_lost") == 2
+
+    def test_named_device_is_the_one_dropped(self, mesh8):
+        dist = par.distribute(_int_frame(), mesh8)
+        with faults.inject(
+                "device", 1,
+                message="DEVICE_LOST: injected: device 3 is lost"):
+            out = par.dmap_blocks(lambda x: {"z": x + 1}, dist)
+        ids = [d.id for d in out.mesh.mesh.devices.flat]
+        assert 3 not in ids and len(ids) == 7
+
+    def test_two_successive_losses(self, mesh8):
+        dist = par.distribute(_int_frame(80), mesh8)
+        with faults.inject("device", 2):
+            out = par.dmap_blocks(lambda x: {"z": x * 3}, dist)
+        assert out.mesh.num_devices == 6
+        assert counters.get("mesh.devices_lost") == 2
+        assert counters.get("mesh.shrinks") == 2
+        assert [r["z"] for r in out.collect_frame().collect()] == \
+            [i * 3 for i in range(80)]
+
+    def test_loss_on_filtered_frame_keeps_shard_valid_rows(self, mesh8):
+        # the lost-shard re-shard must honor per-shard validity (the
+        # dfilter layout), not just prefix frames
+        dist = par.distribute(_int_frame(), mesh8)
+        flt = par.dfilter(lambda x: x % 2 == 0, dist)
+        assert flt.shard_valid is not None
+        with faults.inject("device", 1):
+            out = par.dmap_blocks(lambda x: {"z": x + 100}, flt)
+        assert [r["z"] for r in out.collect_frame().collect()] == \
+            [i + 100 for i in range(0, 40, 2)]
+
+    def test_host_string_columns_survive_reshard(self, mesh8):
+        df = tft.frame({"s": np.array(list("abcdefghij"), object),
+                        "x": np.arange(10)})
+        dist = par.distribute(df, mesh8)
+        with faults.inject("device", 1):
+            out = par.dmap_blocks(lambda x: {"z": x * 2}, dist)
+        rows = out.collect_frame().collect()
+        assert [r["s"] for r in rows] == list("abcdefghij")
+        assert [r["z"] for r in rows] == [i * 2 for i in range(10)]
+
+    def test_elastic_disabled_raises(self, mesh8, monkeypatch):
+        monkeypatch.setenv("TFT_ELASTIC", "0")
+        dist = par.distribute(_int_frame(), mesh8)
+        with faults.inject("device", 1):
+            with pytest.raises(faults.InjectedFault):
+                par.dmap_blocks(lambda x: {"z": x}, dist, trim=True)
+        assert counters.get("mesh.devices_lost") == 0
+
+    def test_single_shard_mesh_reraises(self):
+        mesh1 = par.local_mesh(1)
+        dist = par.distribute(tft.frame({"x": np.arange(4)}), mesh1)
+        with faults.inject("device", 1):
+            with pytest.raises(faults.InjectedFault):
+                par.dmap_blocks(lambda x: {"z": x}, dist, trim=True)
+
+    def test_mesh_metrics_series_exported(self, mesh8):
+        from tensorframes_tpu.observability.metrics import metrics_text
+
+        dist = par.distribute(_int_frame(), mesh8)
+        with faults.inject("device", 1):
+            par.dmap_blocks(lambda x: {"z": x + 1}, dist)
+        text = metrics_text()
+        assert "tft_mesh_devices_lost_total 1" in text
+        assert "tft_mesh_shrinks_total 1" in text
+        assert "tft_mesh_reshard_rows_total" in text
+
+    def test_report_renders_shrink(self, mesh8):
+        dist = par.distribute(_int_frame(), mesh8)
+        tracing.enable()
+        try:
+            with faults.inject("device", 1):
+                par.daggregate({"x": "sum"}, dist, "k")
+            rep = tft.last_query_report()
+        finally:
+            tracing.disable()
+        assert "mesh shrunk 8 -> 7" in rep
+
+
+# ---------------------------------------------------------------------------
+# skew-adaptive repartitioning
+# ---------------------------------------------------------------------------
+
+class TestSkewRebalance:
+    SKEWED = [0.001] * 7 + [0.01]
+
+    def test_persistent_skew_repartitions_proportionally(self, mesh8):
+        dist = par.distribute(tft.frame({"x": np.arange(80)}), mesh8)
+        for _ in range(3):
+            elastic.note_dispatch(mesh8, "dmap_blocks", self.SKEWED)
+        out = par.dmap_blocks(lambda x: {"z": x + 1}, dist)
+        rb = getattr(out, "_rebalance", None)
+        assert rb is not None
+        assert counters.get("mesh.rebalances") == 1
+        # the slow device ends up with the fewest rows; totals conserved
+        assert sum(rb["after"]) == 80
+        assert rb["after"][-1] == min(rb["after"])
+        assert rb["after"][-1] < min(rb["before"])
+        # rows and order are untouched by the re-partition
+        assert [r["z"] for r in out.collect_frame().collect()] == \
+            [i + 1 for i in range(80)]
+        assert "rebalance" in out.explain()
+
+    def test_rebalance_acts_once_per_streak(self, mesh8):
+        dist = par.distribute(tft.frame({"x": np.arange(80)}), mesh8)
+        for _ in range(3):
+            elastic.note_dispatch(mesh8, "op", self.SKEWED)
+        par.dmap_blocks(lambda x: {"z": x}, dist, trim=True)
+        out2 = par.dmap_blocks(lambda x: {"z": x}, dist, trim=True)
+        assert getattr(out2, "_rebalance", None) is None
+        assert counters.get("mesh.rebalances") == 1
+
+    def test_balanced_dispatch_resets_streak(self, mesh8):
+        dist = par.distribute(tft.frame({"x": np.arange(80)}), mesh8)
+        for _ in range(2):
+            elastic.note_dispatch(mesh8, "op", self.SKEWED)
+        elastic.note_dispatch(mesh8, "op", [0.001] * 8)  # balanced
+        elastic.note_dispatch(mesh8, "op", self.SKEWED)
+        out = par.dmap_blocks(lambda x: {"z": x}, dist, trim=True)
+        assert getattr(out, "_rebalance", None) is None
+        assert counters.get("mesh.rebalances") == 0
+
+    def test_rebalance_disabled_by_env(self, mesh8, monkeypatch):
+        monkeypatch.setenv("TFT_SKEW_REBALANCE_AFTER", "0")
+        for _ in range(5):
+            elastic.note_dispatch(mesh8, "op", self.SKEWED)
+        dist = par.distribute(tft.frame({"x": np.arange(80)}), mesh8)
+        out = par.dmap_blocks(lambda x: {"z": x}, dist, trim=True)
+        assert getattr(out, "_rebalance", None) is None
+
+    def test_rebalance_event_in_trace(self, mesh8):
+        dist = par.distribute(tft.frame({"x": np.arange(80)}), mesh8)
+        for _ in range(3):
+            elastic.note_dispatch(mesh8, "dmap_blocks", self.SKEWED)
+        tracing.enable()
+        try:
+            par.dmap_blocks(lambda x: {"z": x + 1}, dist)
+        finally:
+            tracing.disable()
+        t = obs_events.last_query()
+        evs = [ev for ev in t.events if ev.etype == "rebalance"]
+        assert len(evs) == 1
+        assert sum(evs[0].args["after"]) == 80
+        assert t.summary()["rebalances"] == 1
+
+
+# ---------------------------------------------------------------------------
+# hot-key salting
+# ---------------------------------------------------------------------------
+
+class TestHotKeySalting:
+    def _hot_frame(self, n=8_000):
+        keys = np.zeros(n, np.int64)
+        keys[: n // 5] = np.arange(n // 5) % 7 + 1  # key 0 holds 80%
+        return tft.frame({"k": keys, "v": np.arange(n)})
+
+    def test_hot_key_salted_and_exact(self, mesh8):
+        df = self._hot_frame()
+        dist = par.distribute(df, mesh8)
+        host = {r["k"]: r["v"] for r in
+                tft.aggregate({"v": "sum"}, df.group_by("k")).collect()}
+        out = {r["k"]: r["v"] for r in
+               par.daggregate({"v": "sum"}, dist, "k").collect()}
+        assert counters.get("mesh.salted_keys") == 1
+        assert out == host  # integer sums: exact under any association
+
+    def test_salting_cached_per_frame(self, mesh8):
+        df = self._hot_frame()
+        dist = par.distribute(df, mesh8)
+        a1 = par.daggregate({"v": "sum"}, dist, "k").collect()
+        a2 = par.daggregate({"v": "sum"}, dist, "k").collect()
+        assert a1 == a2
+        assert counters.get("mesh.salted_keys") == 1  # planned once
+
+    def test_min_max_fold_back_exact(self, mesh8):
+        df = self._hot_frame()
+        dist = par.distribute(df, mesh8)
+        host = {r["k"]: r["v"] for r in
+                tft.aggregate({"v": "min"}, df.group_by("k")).collect()}
+        out = {r["k"]: r["v"] for r in
+               par.daggregate({"v": "min"}, dist, "k").collect()}
+        assert out == host
+
+    def test_no_hot_key_no_salting(self, mesh8):
+        n = 8_000
+        df = tft.frame({"k": np.arange(n) % 16, "v": np.arange(n)})
+        dist = par.distribute(df, mesh8)
+        par.daggregate({"v": "sum"}, dist, "k")
+        assert counters.get("mesh.salted_keys") == 0
+
+    def test_salting_disabled_by_env(self, mesh8, monkeypatch):
+        monkeypatch.setenv("TFT_SALT_HOT_KEYS", "0")
+        df = self._hot_frame()
+        dist = par.distribute(df, mesh8)
+        host = {r["k"]: r["v"] for r in
+                tft.aggregate({"v": "sum"}, df.group_by("k")).collect()}
+        out = {r["k"]: r["v"] for r in
+               par.daggregate({"v": "sum"}, dist, "k").collect()}
+        assert counters.get("mesh.salted_keys") == 0
+        assert out == host
+
+
+# ---------------------------------------------------------------------------
+# local_mesh validation (satellite)
+# ---------------------------------------------------------------------------
+
+class TestLocalMeshValidation:
+    def test_shape_validated_against_num_devices(self):
+        with pytest.raises(ValueError, match=r"num_devices=4"):
+            par.local_mesh(4, shape=(8,))
+
+    def test_more_than_visible_raises_clearly(self):
+        with pytest.raises(ValueError, match=r"num_devices=16.*8 visible"):
+            par.local_mesh(16)
+
+    def test_shape_without_num_devices_names_visible(self):
+        with pytest.raises(ValueError, match=r"3 device\(s\) but 8"):
+            par.local_mesh(shape=(3,))
+
+    def test_valid_combinations_still_work(self):
+        assert par.local_mesh(4, shape=(4,)).num_devices == 4
+        assert par.local_mesh(8).num_devices == 8
+
+
+# ---------------------------------------------------------------------------
+# device loss during streaming and serving (satellite)
+# ---------------------------------------------------------------------------
+
+class TestStreamAndServeRideTheElasticPath:
+    def test_stream_keeps_folding_through_device_loss(self, mesh8):
+        """A background pump keeps folding while a mesh query loses a
+        device: zero rows lost or duplicated on either side."""
+        from tensorframes_tpu import stream as tstream
+
+        n_batches, rows = 12, 64
+
+        def gen():
+            for i in range(n_batches):
+                yield {"k": np.arange(rows) % 4,
+                       "v": np.full(rows, i, np.int64)}
+
+        agg = (tstream.from_source(tstream.GeneratorSource(gen()))
+               .group_by("k").aggregate({"v": "sum"}))
+        handle = agg.start(name="elastic-stream").start_background(
+            poll_interval=0.001)
+        # mid-stream: a distributed query loses a device and recovers
+        dist = par.distribute(_int_frame(80), mesh8)
+        with faults.inject("device", 1):
+            out = par.dmap_blocks(lambda x: {"z": x * 2}, dist)
+        assert out.mesh.num_devices == 7
+        deadline = time.monotonic() + 30
+        while not handle.done() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        handle.stop()
+        m = handle.metrics()
+        assert m["batches"] == n_batches
+        assert m["batches_skipped"] == 0
+        assert m["rows"] == n_batches * rows
+        # exact fold: sum of v per key over every batch, nothing lost
+        # or double-counted across the concurrent recovery (update-mode
+        # deltas are cumulative; the finalize snapshot lands last, so
+        # the last value seen per key is the total)
+        got = {r["k"]: r["v"] for fr in handle.collect_updates()
+               for r in fr.collect()}
+        per_key = sum(range(n_batches)) * (rows // 4)
+        assert got == {k: per_key for k in range(4)}
+        assert counters.get("mesh.devices_lost") == 1
+
+    def test_stream_batch_device_lost_retried_once(self):
+        """A device-lost error escaping into the batch path is retried
+        once (the mesh below has shrunk), not counted as poisoned."""
+        from tensorframes_tpu import stream as tstream
+
+        def gen():
+            for i in range(3):
+                yield {"v": np.arange(4.0) + i}
+
+        sf = tstream.from_source(tstream.GeneratorSource(gen()))
+        handle = sf.start(name="dl-retry")
+        with faults.inject("batch", 1,
+                           message="DEVICE_LOST: device 1 is lost",
+                           transient=False):
+            n = handle.run()
+        assert n == 3
+        m = handle.metrics()
+        assert m["batches"] == 3
+        assert m["batches_skipped"] == 0
+        assert counters.get("stream.device_lost_retries") == 1
+
+    def test_serve_mix_completes_through_device_loss(self, mesh8):
+        """Multi-tenant submit() mix in flight while a mesh query loses
+        a device: every future completes, the mesh query finishes on
+        the shrunken mesh, and results are exact."""
+        from tensorframes_tpu.serve import QueryScheduler, TenantQuota
+
+        quotas = {"a": TenantQuota(weight=1.0),
+                  "b": TenantQuota(weight=2.0)}
+        with QueryScheduler(quotas=quotas, workers=2,
+                            name="elastic-serve") as sched:
+            futs = []
+            for i in range(6):
+                fr = tft.frame({"x": np.arange(32.0) + i})
+                futs.append((i, sched.submit(
+                    fr, lambda x: {"z": x + 1.0},
+                    tenant="a" if i % 2 else "b")))
+            dist = par.distribute(_int_frame(80), mesh8)
+            with faults.inject("device", 1):
+                out = par.dmap_blocks(lambda x: {"z": x * 2}, dist)
+            assert out.mesh.num_devices == 7
+            assert [r["z"] for r in out.collect_frame().collect()] == \
+                [i * 2 for i in range(80)]
+            for i, fut in futs:
+                res = fut.result(timeout=30)
+                got = [r["z"] for b in [res] for r in b.collect()]
+                assert got == list(np.arange(32.0) + i + 1.0)
+        assert counters.get("mesh.devices_lost") == 1
+
+    def test_serve_thunk_device_lost_retried_once(self):
+        """A device-lost error raised by a served query's own forcing is
+        retried once instead of failing the future."""
+        from tensorframes_tpu.serve import QueryScheduler
+
+        with QueryScheduler(workers=0, name="dl-serve") as sched:
+            fr = tft.frame({"x": np.arange(8.0)})
+            fut = sched.submit(fr, lambda x: {"z": x + 1.0}, tenant="t")
+            with faults.inject("dispatch", 1,
+                               message="DEVICE_LOST: device 0 is lost",
+                               transient=False):
+                assert sched.step()
+            res = fut.result(timeout=30)
+            assert [r["z"] for r in res.collect()] == \
+                list(np.arange(8.0) + 1.0)
+        assert counters.get("serve.device_lost_retries") == 1
+
+
+# ---------------------------------------------------------------------------
+# reshard invariants
+# ---------------------------------------------------------------------------
+
+class TestReshard:
+    def test_prefix_reshard_preserves_order(self, mesh8):
+        dist = par.distribute(_int_frame(20), mesh8)
+        small = elastic.shrink_mesh(dist.mesh, [2])
+        out = elastic.reshard(dist, small)
+        assert out.num_rows == 20
+        assert out.mesh.num_data_shards == 7
+        assert [r["x"] for r in out.collect_frame().collect()] == \
+            list(range(20))
+
+    def test_explicit_shard_rows_layout(self, mesh8):
+        dist = par.distribute(_int_frame(16), mesh8)
+        rows = np.array([4, 4, 2, 2, 2, 1, 1, 0])
+        out = elastic.reshard(dist, dist.mesh, shard_rows=rows)
+        assert list(out.per_shard_valid()) == list(rows)
+        assert [r["x"] for r in out.collect_frame().collect()] == \
+            list(range(16))
+
+    def test_bad_shard_rows_rejected(self, mesh8):
+        dist = par.distribute(_int_frame(16), mesh8)
+        with pytest.raises(ValueError, match="does not distribute"):
+            elastic.reshard(dist, dist.mesh,
+                            shard_rows=np.array([1] * 8))
+
+    def test_shrink_rejects_non_data_mesh(self):
+        mesh = par.local_mesh(8, axis_names=("data", "model"),
+                              shape=(4, 2))
+        with pytest.raises(ValueError, match="data-only"):
+            elastic.shrink_mesh(mesh, [0])
+
+    def test_shrink_keeps_non_leading_data_axis(self):
+        # survivors must land on the DATA axis wherever it sits, not
+        # on axis 0
+        from jax.sharding import Mesh
+
+        devices = np.array(jax.devices()).reshape(1, 8)
+        mesh = par.DeviceMesh(Mesh(devices, ("model", "data")),
+                              data_axis="data")
+        small = elastic.shrink_mesh(mesh, [2])
+        assert dict(small.mesh.shape) == {"model": 1, "data": 7}
+        assert small.num_data_shards == 7
+
+    def test_loss_after_rebalance_drops_stale_record(self, mesh8):
+        # a loss inside the same call re-shards with an even prefix
+        # layout; the pre-loss rebalance info must not be reported
+        dist = par.distribute(tft.frame({"x": np.arange(80)}), mesh8)
+        for _ in range(3):
+            elastic.note_dispatch(mesh8, "dmap_blocks",
+                                  [0.001] * 7 + [0.01])
+        with faults.inject("device", 1):
+            out = par.dmap_blocks(lambda x: {"z": x + 1}, dist)
+        assert out.mesh.num_devices == 7
+        assert getattr(out, "_rebalance", None) is None
+        assert [r["z"] for r in out.collect_frame().collect()] == \
+            [i + 1 for i in range(80)]

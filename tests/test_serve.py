@@ -31,6 +31,7 @@ import numpy as np
 import pytest
 
 import tensorframes_tpu as tft
+from conftest import timing_margin
 from tensorframes_tpu import observability as obs
 from tensorframes_tpu import serve
 from tensorframes_tpu.computation import Computation, TensorSpec
@@ -253,7 +254,7 @@ class TestDeadlinesAndAdmission:
             time.sleep(0.05)
             assert sched.step()
             with pytest.raises(DeadlineExceeded):
-                fut.result(timeout=5)
+                fut.result(timeout=timing_margin(5))
             assert fut.state == "failed"
             snap = sched.snapshot()
             assert snap["t"]["failed"] == 1
@@ -268,7 +269,7 @@ class TestDeadlinesAndAdmission:
             fut = sched.submit(_frame(8), tenant="t", est_bytes=500)
             assert sched.step()
             with pytest.raises(AdmissionDeadline) as ei:
-                fut.result(timeout=5)
+                fut.result(timeout=timing_margin(5))
             assert error_kind(ei.value) == "deadline_admission"
             assert not is_transient(ei.value)
             assert is_permanent(ei.value)
@@ -293,7 +294,7 @@ class TestDeadlinesAndAdmission:
             fut = sched.submit(_frame(8), lambda x: {"z": x + 1.0},
                                tenant="t", est_bytes=500)
             assert sched.step()
-            out = fut.result(timeout=5)
+            out = fut.result(timeout=timing_margin(5))
             np.testing.assert_allclose(_z(out), np.arange(8.0) + 1.0)
             assert len(calls) >= 3
             assert tracing.counters.get("serve.admission_waits") == 1
@@ -304,7 +305,7 @@ class TestDeadlinesAndAdmission:
             fut = sched.submit(_frame(8), tenant="t",
                                est_bytes=10 ** 15)
             assert sched.step()
-            fut.result(timeout=5)
+            fut.result(timeout=timing_margin(5))
             assert fut.state == "done"
 
 

@@ -8,15 +8,19 @@
    available instead.
 
 2. No ``except Exception: pass`` under ``tensorframes_tpu/observability/``,
-   ``tensorframes_tpu/serve/``, or ``tensorframes_tpu/stream/``: the
-   observability layer is the last place a failure may vanish silently —
-   an event sink or metrics endpoint that swallows an error without at
-   least logging it hides exactly the evidence it exists to surface —
-   the serving layer's whole contract is CLASSIFIED failure (a scheduler
-   that silently eats an error turns a rejection into a hang), and the
-   streaming layer's batch-skip contract is skip-AND-COUNT (a silently
-   swallowed batch error is a data-loss bug with no trace). Handle it
-   or log it (``_log.debug`` is enough).
+   ``tensorframes_tpu/serve/``, ``tensorframes_tpu/stream/``, or
+   ``tensorframes_tpu/parallel/``: the observability layer is the last
+   place a failure may vanish silently — an event sink or metrics
+   endpoint that swallows an error without at least logging it hides
+   exactly the evidence it exists to surface — the serving layer's whole
+   contract is CLASSIFIED failure (a scheduler that silently eats an
+   error turns a rejection into a hang), the streaming layer's
+   batch-skip contract is skip-AND-COUNT (a silently swallowed batch
+   error is a data-loss bug with no trace), and the parallel layer's
+   elastic recovery depends on device-loss errors REACHING its
+   classifier (a swallowed mesh error turns a recoverable loss into
+   silent corruption or a later hang). Handle it or log it
+   (``_log.debug`` is enough).
 
 AST-based, so strings and comments never false-positive.
 """
@@ -27,7 +31,8 @@ import sys
 
 ROOT = pathlib.Path(__file__).resolve().parent.parent / "tensorframes_tpu"
 # packages where `except Exception: pass` (silent swallow) is also banned
-STRICT_ROOTS = (ROOT / "observability", ROOT / "serve", ROOT / "stream")
+STRICT_ROOTS = (ROOT / "observability", ROOT / "serve", ROOT / "stream",
+                ROOT / "parallel")
 
 
 def _is_exception_name(node) -> bool:
