@@ -62,6 +62,16 @@ if [ "${1:-}" = "--elastic" ]; then
   exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m elastic "$@"
 fi
 
+# --memory: run only the device-memory manager lane
+# (tests/test_memory.py: budget ledger, spill/fault bit-identity,
+# external dsort, larger-than-budget relational suite) — fast,
+# CPU-only, no native build needed
+if [ "${1:-}" = "--memory" ]; then
+  shift
+  echo "== memory lane (pytest -m memory, CPU) =="
+  exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m memory "$@"
+fi
+
 # --timing: run only the wall-clock-sensitive deadline tests, serially
 # (they flake under concurrent suite load; TFT_TIMING_MARGIN widens
 # their assertion bounds further on badly oversubscribed boxes)

@@ -43,6 +43,7 @@ from .api import (
 )
 from . import builder
 from . import io
+from . import memory
 from . import serve
 from . import stream
 from .serve import serve_report
@@ -79,6 +80,7 @@ __all__ = [
     "observability",
     "last_query_report",
     "dump_stats",
+    "memory",
     "serve",
     "submit",
     "serve_report",

@@ -24,6 +24,7 @@ import jax
 import numpy as np
 
 from .. import dtypes as _dt
+from .. import memory as _memory
 from .. import native as _native
 from ..computation import Computation
 from ..observability.events import add_event as _obs_event
@@ -182,6 +183,48 @@ def _run_half(executor, comp: Computation, arrays: Mapping, n_rows: int):
         raise
 
 
+def _dispatch_estimate(dev_arrays: Mapping, pad_to, n_rows) -> int:
+    """Admission estimate of one dispatch's device footprint: inputs
+    (scaled to the padded row count when bucketing) plus outputs
+    assumed input-sized — 2x the staged input bytes."""
+    total = 0
+    for a in dev_arrays.values():
+        total += int(a.nbytes)
+    if pad_to and n_rows:
+        total = int(total * (pad_to / n_rows))
+    return 2 * total
+
+
+def _splittable(comp: Computation, row_local: bool, n_rows) -> bool:
+    """Whether the proactive pre-dispatch split is legal: the same
+    row-locality contract as the reactive OOM split (every output
+    row-dimensioned, >= 2 rows to halve)."""
+    return bool(
+        row_local and n_rows and n_rows >= 2
+        and all(s.shape.ndim > 0 and s.shape.head == -1
+                for s in comp.outputs))
+
+
+def _proactive_split_run(executor, comp: Computation, arrays: Mapping,
+                         n_rows: int, est: int):
+    """Split a block BEFORE dispatch when its admission estimate alone
+    exceeds the whole device budget (ROADMAP item 5's "blind split"
+    fix: the reactive ``oom_split`` waited for the allocator to fail
+    first). Counted separately (``memory.proactive_splits``); each half
+    re-enters :meth:`BlockExecutor.run` and splits again if still over.
+    """
+    counters.inc("memory.proactive_splits")
+    _obs_event("proactive_split", rows=n_rows, est_bytes=est)
+    _log.info(
+        "block of %d rows (~%d B estimated) exceeds the device budget; "
+        "splitting before dispatch", n_rows, est)
+    first, second = _split_rows(comp, arrays, n_rows)
+    with span("executor.proactive_split"):
+        out_a = executor.run(comp, first, pad_ok=True)
+        out_b = executor.run(comp, second, pad_ok=True)
+    return _concat_outputs(comp, out_a, out_b)
+
+
 def _next_bucket(n: int, minimum: int = 8) -> int:
     b = minimum
     while b < n:
@@ -255,7 +298,8 @@ class PendingBlock:
     """
 
     __slots__ = ("_executor", "_comp", "_arrays", "_pad_ok", "_out",
-                 "_pad_to", "_n_rows", "_error")
+                 "_pad_to", "_n_rows", "_error", "_host", "_mem_mgr",
+                 "_mem_bytes", "__weakref__")
 
     def __init__(self, executor, comp, arrays, pad_ok, out=None,
                  pad_to=None, n_rows=None, error=None):
@@ -267,8 +311,55 @@ class PendingBlock:
         self._pad_to = pad_to
         self._n_rows = n_rows
         self._error = error
+        # memory-manager integration: while in the FIFO window this
+        # block is a registered spill candidate — its device output can
+        # be drained to pinned host early under pressure
+        self._host: Optional[Dict[str, np.ndarray]] = None
+        self._mem_mgr = None
+        self._mem_bytes = 0
+
+    # -- memory-ledger entry protocol (docs/memory.md) ---------------------
+    def mem_name(self) -> str:
+        return f"pending-block-{id(self):x}"
+
+    def mem_is_spilled(self) -> bool:
+        return self._out is None
+
+    def mem_device_bytes(self) -> int:
+        return self._mem_bytes if self._out is not None else 0
+
+    def mem_host_bytes(self) -> int:
+        return 0  # spilled pendings ARE their drain result; never fault
+
+    def mem_fault(self) -> int:
+        return 0
+
+    def mem_spill(self) -> int:
+        """Early-drain the device output to host (called under the
+        ledger lock, so it cannot race :meth:`drain` — drain unregisters
+        first). A conversion failure records the error for the normal
+        drain-side recovery."""
+        if self._out is None or self._error is not None:
+            return 0
+        try:
+            self._host = self._executor._convert_back(
+                self._comp, self._out, self._pad_to, self._n_rows)
+        except Exception as e:
+            self._error = e
+        self._out = None
+        freed = self._mem_bytes
+        self._mem_bytes = 0
+        return freed
 
     def drain(self) -> Dict[str, np.ndarray]:
+        m = self._mem_mgr
+        if m is not None:
+            # unregister FIRST (under the ledger lock): after this no
+            # concurrent spill can touch our device output
+            self._mem_mgr = None
+            m.drop(self)
+        if self._host is not None:
+            return self._host
         if self._error is None:
             try:
                 faults.check("drain")
@@ -482,38 +573,60 @@ class BlockExecutor:
         errors retry with backoff; a failing bucketed (padded) compile
         falls back to the exact shape; an OOM-shaped error on a row-local
         dispatch re-runs the block as two halves.
+
+        Memory admission (``docs/memory.md``): under an active device
+        budget the dispatch's estimated footprint is reserved first —
+        spilling cold resident buffers, then waiting (bounded) for
+        in-flight work; a row-local block whose estimate alone exceeds
+        the whole budget splits BEFORE dispatch
+        (``memory.proactive_splits``). With no budget configured this is
+        one global read.
         """
         comp = _intern(comp)
         dev_arrays, n_rows = self._convert_inputs(comp, arrays)
         row_local, pad_to = self._plan_pad(n_rows, pad_ok)
+        mgr = _memory.active()
+        mem_tok = 0
+        if mgr is not None:
+            est = _dispatch_estimate(dev_arrays, pad_to, n_rows)
+            if mgr.would_overflow(est) and _splittable(comp, row_local,
+                                                       n_rows):
+                return _proactive_split_run(self, comp, arrays, n_rows,
+                                            est)
+            mem_tok = mgr.reserve(est, op="executor.run")
+        try:
+            out = None
+            if pad_to is not None:
+                try:
+                    faults.check("pad_compile")
+                    padded = _pad_inputs(comp, dev_arrays, pad_to, n_rows)
+                    out = self._dispatch(comp, padded,
+                                         donate=self._donate_padded())
+                except Exception as e:
+                    if is_oom(e):
+                        return _oom_split_run(self, comp, arrays, n_rows,
+                                              e)
+                    counters.inc("pad_fallback.compiles")
+                    _obs_event("pad_fallback", pad_to=pad_to, rows=n_rows,
+                               error=type(e).__name__)
+                    _log.warning(
+                        "bucketed %d-row compile/dispatch failed (%s); "
+                        "falling back to the exact %d-row shape",
+                        pad_to, e, n_rows)
+                    pad_to = None
+            if out is None:
+                try:
+                    out = self._dispatch(comp, dev_arrays)
+                except Exception as e:
+                    if is_oom(e) and row_local:
+                        return _oom_split_run(self, comp, arrays, n_rows,
+                                              e)
+                    raise
 
-        out = None
-        if pad_to is not None:
-            try:
-                faults.check("pad_compile")
-                padded = _pad_inputs(comp, dev_arrays, pad_to, n_rows)
-                out = self._dispatch(comp, padded,
-                                     donate=self._donate_padded())
-            except Exception as e:
-                if is_oom(e):
-                    return _oom_split_run(self, comp, arrays, n_rows, e)
-                counters.inc("pad_fallback.compiles")
-                _obs_event("pad_fallback", pad_to=pad_to, rows=n_rows,
-                           error=type(e).__name__)
-                _log.warning(
-                    "bucketed %d-row compile/dispatch failed (%s); "
-                    "falling back to the exact %d-row shape",
-                    pad_to, e, n_rows)
-                pad_to = None
-        if out is None:
-            try:
-                out = self._dispatch(comp, dev_arrays)
-            except Exception as e:
-                if is_oom(e) and row_local:
-                    return _oom_split_run(self, comp, arrays, n_rows, e)
-                raise
-
-        return self._convert_back(comp, out, pad_to, n_rows)
+            return self._convert_back(comp, out, pad_to, n_rows)
+        finally:
+            if mem_tok:
+                mgr.release(mem_tok)
 
     def submit(self, comp: Computation,
                arrays: Mapping[str, np.ndarray],
@@ -527,9 +640,27 @@ class BlockExecutor:
         """
         comp = _intern(comp)
         pad_to = None
+        mem = None  # (manager, token, est) while a reservation is held
         try:
             dev_arrays, n_rows = self._convert_inputs(comp, arrays)
             _, pad_to = self._plan_pad(n_rows, pad_ok)
+            mgr = _memory.active()
+            if mgr is not None:
+                est = _dispatch_estimate(dev_arrays, pad_to, n_rows)
+                tok = mgr.try_reserve(est, op="executor.submit")
+                if tok is None:
+                    # pressure: the async fast path must NEVER block (a
+                    # stream waiting here while holding its own window
+                    # would deadlock the budget) — run synchronously
+                    # through the admitted path, which may wait, spill,
+                    # or proactively split
+                    counters.inc("memory.sync_dispatches")
+                    _obs_event("mem_sync_dispatch", rows=n_rows,
+                               est_bytes=est)
+                    from .pipeline import ReadyResult
+                    return ReadyResult(self.run(comp, arrays,
+                                                pad_ok=pad_ok))
+                mem = (mgr, tok, est)
             donate = False
             if pad_to is not None:
                 faults.check("pad_compile")
@@ -545,9 +676,21 @@ class BlockExecutor:
                 # call even on the async path — worth attributing
                 out = (_timed_first_dispatch(fn, dev_arrays) if fresh
                        else fn(dev_arrays))
-            return PendingBlock(self, comp, arrays, pad_ok, out=out,
-                                pad_to=pad_to, n_rows=n_rows)
+            pending = PendingBlock(self, comp, arrays, pad_ok, out=out,
+                                   pad_to=pad_to, n_rows=n_rows)
+            if mem is not None:
+                # the reservation becomes a resident ledger entry: while
+                # this block sits in the FIFO window its device output is
+                # a spill candidate (early host drain under pressure)
+                mgr, tok, est = mem
+                pending._mem_mgr = mgr
+                pending._mem_bytes = est
+                mgr.convert_reservation(tok, pending)
+                mem = None
+            return pending
         except Exception as e:
+            if mem is not None:
+                mem[0].release(mem[1])
             # pad_to rides along so drain() knows whether the sync
             # re-run's exact-shape fallback could still recover this
             return PendingBlock(self, comp, arrays, pad_ok, error=e,

@@ -35,6 +35,7 @@ import jax
 import numpy as np
 
 from .. import dtypes as _dt
+from .. import memory as _memory
 from ..computation import Computation, TensorSpec
 from ..frame import Block, GroupedFrame, Row, TensorFrame
 from ..marshal import Column
@@ -361,11 +362,14 @@ def map_blocks(fetches: Fetches, df: TensorFrame, trim: bool = False,
         arrays = {n: b.dense(n) for n in in_names}
         return _pipeline.submit(ex, comp, arrays, pad_ok=not trim)
 
+    rows_h, bytes_h = _memory.propagate_hints(df, out_schema)
     return TensorFrame(out_schema,
                        _stream_thunk(df, ex, run_block, submit_block,
                                      _drain_with(finish_block)),
                        df.num_partitions,
-                       plan=f"map_blocks({df._plan})")
+                       plan=f"map_blocks({df._plan})",
+                       rows_hint=None if trim else rows_h,
+                       bytes_hint=None if trim else bytes_h)
 
 
 # ---------------------------------------------------------------------------
@@ -473,11 +477,13 @@ def map_rows(fetches: Fetches, df: TensorFrame,
         arrays = {n: b.dense(n) for n in in_names}
         return _pipeline.submit(ex, vcomp, arrays)
 
+    rows_h, bytes_h = _memory.propagate_hints(df, out_schema)
     return TensorFrame(out_schema,
                        _stream_thunk(df, ex, run_block, submit_block,
                                      _drain_with(attach_outputs)),
                        df.num_partitions,
-                       plan=f"map_rows({df._plan})")
+                       plan=f"map_rows({df._plan})",
+                       rows_hint=rows_h, bytes_hint=bytes_h)
 
 
 # ---------------------------------------------------------------------------
@@ -586,11 +592,14 @@ def filter_rows(predicate: Fetches, df: TensorFrame,
         arrays = {n: b.dense(n) for n in in_names}
         return _pipeline.submit(ex, comp, arrays, pad_ok=True)
 
+    # the hint is an UPPER bound: a filter keeps at most its input
+    rows_h, bytes_h = _memory.propagate_hints(df, df.schema)
     return TensorFrame(df.schema,
                        _stream_thunk(df, ex, run_block, submit_block,
                                      _drain_with(apply_mask)),
                        df.num_partitions,
-                       plan=f"filter_rows({df._plan})")
+                       plan=f"filter_rows({df._plan})",
+                       rows_hint=rows_h, bytes_hint=bytes_h)
 
 
 # ---------------------------------------------------------------------------
@@ -888,6 +897,7 @@ def _monoid_aggregate(col_combiners: Mapping[str, str],
     combine_np = {"sum": np.add, "prod": np.multiply,
                   "min": np.minimum, "max": np.maximum}
     cols: Dict[str, np.ndarray] = {k: u for k, u in zip(keys, uniques)}
+    mem_mgr = _memory.active()
     with span("aggregate.segment_reduce"):
         for f in fetch_names:
             field = df.schema[f]
@@ -900,8 +910,19 @@ def _monoid_aggregate(col_combiners: Mapping[str, str],
                 if vals.dtype != dd:
                     from .. import native as _native
                     vals = _native.convert(vals, dd)
-                part = np.asarray(_segment_reduce(
-                    col_combiners[f], vals, ids, num_groups))
+                # per-block dispatch admitted against the device budget
+                # (the partial materializes to host immediately below,
+                # so only one block's reduce is device-resident at once)
+                mem_tok = (mem_mgr.reserve(
+                    2 * int(vals.nbytes) + int(ids.nbytes),
+                    op="aggregate.segment_reduce")
+                    if mem_mgr is not None else 0)
+                try:
+                    part = np.asarray(_segment_reduce(
+                        col_combiners[f], vals, ids, num_groups))
+                finally:
+                    if mem_tok:
+                        mem_mgr.release(mem_tok)
                 # groups absent from a block hold the combiner's neutral
                 # element (segment_* identity), so the pairwise combine
                 # is exact
@@ -1046,8 +1067,16 @@ def _aggregate_segmented_fold(comp, fetch_names, fetch_blocks, fact,
             while len(cache) > 64:
                 cache.popitem(last=False)
 
-    with span("aggregate.segmented_fold"):
-        final = fn(ids_sorted, *dev_blocks)
+    mem_mgr = _memory.active()
+    mem_tok = (mem_mgr.reserve(
+        2 * sum(int(a.nbytes) for a in dev_blocks) + int(ids_sorted.nbytes),
+        op="aggregate.segmented_fold") if mem_mgr is not None else 0)
+    try:
+        with span("aggregate.segmented_fold"):
+            final = fn(ids_sorted, *dev_blocks)
+    finally:
+        if mem_tok:
+            mem_mgr.release(mem_tok)
     cols: Dict[str, np.ndarray] = {}
     for f in names:
         v = np.asarray(final[f])

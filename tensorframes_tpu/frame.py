@@ -168,6 +168,14 @@ def _infer_schema_from_rows(rows: Sequence[Sequence],
     return Schema(fields)
 
 
+def _blocks_hints(blocks: Sequence[Block]) -> Dict[str, int]:
+    """Exact size hints for a source frame whose blocks already exist
+    (``from_rows``/``from_columns``/``from_blocks`` build them eagerly)."""
+    from .memory.estimate import blocks_estimate
+    rows, nbytes = blocks_estimate(blocks)
+    return {"rows_hint": rows, "bytes_hint": nbytes}
+
+
 def _split_even(n: int, parts: int) -> List[Tuple[int, int]]:
     """Split n rows into at most ``parts`` non-empty spans (Spark-style:
     never more partitions than rows)."""
@@ -191,7 +199,9 @@ class TensorFrame:
     def __init__(self, schema: Schema,
                  thunk: Callable[[], List[Block]],
                  num_partitions: int,
-                 plan: str = "source"):
+                 plan: str = "source",
+                 rows_hint: Optional[int] = None,
+                 bytes_hint: Optional[int] = None):
         self._schema = schema
         self._thunk = thunk
         self._cache: Optional[List[Block]] = None
@@ -200,6 +210,11 @@ class TensorFrame:
         # the QueryTrace of this frame's forcing (None until forced with
         # tracing enabled); rendered by explain()
         self._trace = None
+        # plan-derived size hints (docs/memory.md): exact at source
+        # constructors, scaled through ops — what gives UNFORCED frames
+        # a serve-admission estimate; None means unknown
+        self._rows_hint = rows_hint
+        self._bytes_hint = bytes_hint
 
     # -- construction ------------------------------------------------------
     @staticmethod
@@ -213,7 +228,8 @@ class TensorFrame:
             schema = _infer_schema_from_rows(rows, columns)
         spans = _split_even(len(rows), num_partitions)
         blocks = [Block.from_rows(rows[a:b], schema) for a, b in spans]
-        return TensorFrame(schema, lambda: blocks, len(blocks))
+        return TensorFrame(schema, lambda: blocks, len(blocks),
+                           **_blocks_hints(blocks))
 
     @staticmethod
     def from_columns(cols: Dict[str, np.ndarray],
@@ -229,11 +245,13 @@ class TensorFrame:
         spans = _split_even(n, num_partitions)
         blocks = [Block({k: v[a:b] for k, v in cols.items()}, b - a)
                   for a, b in spans]
-        return TensorFrame(schema, lambda: blocks, len(blocks))
+        return TensorFrame(schema, lambda: blocks, len(blocks),
+                           **_blocks_hints(blocks))
 
     @staticmethod
     def from_blocks(blocks: List[Block], schema: Schema) -> "TensorFrame":
-        return TensorFrame(schema, lambda: blocks, len(blocks))
+        return TensorFrame(schema, lambda: blocks, len(blocks),
+                           **_blocks_hints(blocks))
 
     # -- basic properties --------------------------------------------------
     @property
@@ -263,7 +281,37 @@ class TensorFrame:
                 self._cache = self._thunk()
             if t is not None:
                 self._trace = t
+            # under an active device budget the forced block cache joins
+            # the host-side accounting (tft_memory_frame_cache_bytes);
+            # one global read otherwise
+            from . import memory as _memory
+            _memory.note_frame_cache(self)
         return self._cache
+
+    def uncache(self) -> "TensorFrame":
+        """Drop the forced block cache (the next ``blocks()`` re-runs
+        the plan) and release it from the memory manager's host-side
+        accounting. The inverse of :meth:`cache`."""
+        self._cache = None
+        from . import memory as _memory
+        _memory.forget_frame_cache(self)
+        return self
+
+    def estimated_rows(self) -> Optional[int]:
+        """Best-effort row count: exact when forced, the plan hint
+        otherwise, ``None`` when unknown (``docs/memory.md``)."""
+        from .memory.estimate import frame_estimate
+        rows, _ = frame_estimate(self)
+        return int(rows) if rows is not None else None
+
+    def estimated_bytes(self) -> Optional[int]:
+        """Best-effort host byte size: exact when forced, the plan hint
+        (an upper bound through filters) otherwise, ``None`` when
+        unknown. The serve scheduler's admission estimate for unforced
+        frames reads this."""
+        from .memory.estimate import frame_estimate
+        _, nbytes = frame_estimate(self)
+        return nbytes
 
     def collect(self) -> List[Row]:
         names = self._schema.names
@@ -292,9 +340,12 @@ class TensorFrame:
     # -- transformations ---------------------------------------------------
     def select(self, names: Sequence[str]) -> "TensorFrame":
         schema = self._schema.select(names)
+        from .memory.estimate import propagate_hints
+        rows_h, bytes_h = propagate_hints(self, schema)
         return TensorFrame(
             schema, lambda: [b.select(names) for b in self.blocks()],
-            self._num_partitions, plan=f"select({self._plan})")
+            self._num_partitions, plan=f"select({self._plan})",
+            rows_hint=rows_h, bytes_hint=bytes_h)
 
     def with_schema(self, schema: Schema) -> "TensorFrame":
         """Same data, refined metadata (used by ``analyze``)."""
@@ -317,8 +368,11 @@ class TensorFrame:
                 out.append(Block(cols, b - a))
             return out
 
+        from .memory.estimate import propagate_hints
+        rows_h, bytes_h = propagate_hints(self, self._schema)
         return TensorFrame(self._schema, thunk, n,
-                           plan=f"repartition({self._plan})")
+                           plan=f"repartition({self._plan})",
+                           rows_hint=rows_h, bytes_hint=bytes_h)
 
     def pad_column(self, name: str, max_len: Optional[int] = None,
                    pow2: bool = False, mask_col: Optional[str] = None,
@@ -460,8 +514,17 @@ class TensorFrame:
                                   f.dtype.np_storage)
                  for f in self._schema}, 0)]
 
+        from .memory.estimate import frame_estimate
+        est_rows, est_bytes = frame_estimate(self)
+        if est_rows:
+            take = min(n, int(est_rows))
+            lim_bytes = (int(est_bytes * take / est_rows)
+                         if est_bytes is not None else None)
+        else:
+            take, lim_bytes = None, None
         return TensorFrame(self._schema, run, self._num_partitions,
-                           plan=f"limit({n})({self._plan})")
+                           plan=f"limit({n})({self._plan})",
+                           rows_hint=take, bytes_hint=lim_bytes)
 
     def sample(self, fraction: float, seed: int = 0) -> "TensorFrame":
         """A Bernoulli row sample (each row kept independently with
@@ -481,8 +544,15 @@ class TensorFrame:
                      for k, v in b.columns.items()}, keep))
             return out
 
-        return TensorFrame(self._schema, run, self._num_partitions,
-                           plan=f"sample({fraction})({self._plan})")
+        from .memory.estimate import frame_estimate
+        est_rows, est_bytes = frame_estimate(self)
+        return TensorFrame(
+            self._schema, run, self._num_partitions,
+            plan=f"sample({fraction})({self._plan})",
+            rows_hint=(int(est_rows * fraction)
+                       if est_rows is not None else None),
+            bytes_hint=(int(est_bytes * fraction)
+                        if est_bytes is not None else None))
 
     def show(self, n: int = 20) -> None:
         """Print the first ``n`` rows as a small aligned table (the Spark
@@ -605,8 +675,11 @@ class TensorFrame:
                 out_blocks.append(Block(cols_out, e - a))
             return out_blocks
 
+        from .memory.estimate import propagate_hints
+        rows_h, bytes_h = propagate_hints(self, self._schema)
         return TensorFrame(self._schema, run, parts,
-                           plan=f"order_by{cols}({self._plan})")
+                           plan=f"order_by{cols}({self._plan})",
+                           rows_hint=rows_h, bytes_hint=bytes_h)
 
     def analyze(self) -> "TensorFrame":
         from . import api

@@ -1,0 +1,212 @@
+"""Out-of-core frames: the device-memory manager (``docs/memory.md``).
+
+Public surface:
+
+- :func:`manager` — the process :class:`~.manager.MemoryManager`
+  (created on first use; budget from ``TFT_MEM_LIMIT_BYTES`` or the
+  backend allocator limit x ``TFT_MEM_FRACTION``);
+- :func:`active` — the manager IF it has a budget, else ``None``: the
+  hot-path gate every integration point checks first, so an unlimited
+  process pays one global read per dispatch and nothing else;
+- :func:`configure` / :func:`bypass` / :func:`_reset` — explicit
+  control for tests and benchmarks;
+- :class:`SpillableBuffer` / :class:`SpillableColumns` /
+  :func:`external_sort` — the spill mechanics and the out-of-core sort
+  (``dsort`` routes here when a frame outgrows the budget).
+
+Integration map: the block executor admits every dispatch
+(``engine/executor.py``: reserve at submit, release at drain, proactive
+pre-dispatch split on predicted overflow); pipelined pending blocks
+register as spill candidates (their device output can drain to host
+early); ``distribute`` registers mesh frames' columns; the serve
+scheduler estimates unforced frames through :func:`frame_estimate` and
+reads :meth:`~.manager.MemoryManager.headroom`; streaming window state
+spills instead of force-evicting. ``tft_memory_*`` gauges join the
+metrics endpoint; ``spill`` / ``fault`` / ``proactive_split`` events
+join query traces and ``explain()``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Mapping, Optional
+
+from .estimate import (blocks_estimate, frame_estimate, propagate_hints,
+                       schema_row_bytes)
+from .external_sort import external_sort
+from .manager import MemoryManager
+from .spill import (SpillableBuffer, SpillableColumns, array_nbytes,
+                    host_value, is_device_value, to_pinned_host,
+                    value_nbytes)
+
+__all__ = [
+    "MemoryManager", "manager", "active", "configure", "bypass",
+    "SpillableBuffer", "SpillableColumns", "spillable_columns",
+    "external_sort", "frame_estimate", "propagate_hints",
+    "blocks_estimate", "schema_row_bytes", "array_nbytes",
+    "host_value", "value_nbytes", "is_device_value", "to_pinned_host",
+    "note_frame_cache", "forget_frame_cache",
+]
+
+_lock = threading.Lock()
+_manager: Optional[MemoryManager] = None
+_active: Optional[MemoryManager] = None
+_resolved = False
+_provider_registered = False
+
+
+def _register_provider() -> None:
+    global _provider_registered
+    if _provider_registered:
+        return
+    try:
+        from ..observability.metrics import register_metrics_provider
+        register_metrics_provider("memory", _metrics_lines)
+        _provider_registered = True
+    except Exception as e:  # metrics are decoration, never a gate
+        from ..utils.logging import get_logger
+        get_logger("memory").warning(
+            "could not register the tft_memory_* metrics provider: %s", e)
+
+
+def _resolve() -> None:
+    global _manager, _active, _resolved
+    with _lock:
+        if _resolved:
+            return
+        _manager = MemoryManager()
+        _active = _manager if _manager.limited else None
+        _resolved = True
+    _register_provider()
+
+
+def manager() -> MemoryManager:
+    """The process memory manager (created on first use)."""
+    if not _resolved:
+        _resolve()
+    return _manager
+
+
+def active() -> Optional[MemoryManager]:
+    """The manager when it has a budget, else ``None`` — the zero-cost
+    gate: unlimited processes take one global read per call."""
+    if not _resolved:
+        _resolve()
+    return _active
+
+
+def configure(limit_bytes: Optional[int] = None,
+              spill: Optional[bool] = None) -> MemoryManager:
+    """Install a fresh manager with an explicit budget (tests and
+    benchmarks; production uses the env knobs). ``limit_bytes=None``
+    re-reads ``TFT_MEM_LIMIT_BYTES`` / the device budget; ``0`` means
+    explicitly unlimited. Returns the new manager."""
+    global _manager, _active, _resolved
+    with _lock:
+        if limit_bytes == 0:
+            m = MemoryManager(limit_bytes=-1, spill=spill)
+        else:
+            m = MemoryManager(limit_bytes=limit_bytes, spill=spill)
+        _manager = m
+        _active = m if m.limited else None
+        _resolved = True
+    _register_provider()
+    return m
+
+
+def _reset() -> None:
+    """Drop the singleton so the next use re-reads the environment
+    (tests monkeypatching ``TFT_MEM_LIMIT_BYTES`` call this)."""
+    global _manager, _active, _resolved
+    with _lock:
+        _manager = None
+        _active = None
+        _resolved = False
+
+
+@contextlib.contextmanager
+def bypass():
+    """Temporarily disable the memory manager entirely (benchmarks
+    measuring the ledger's own overhead)."""
+    global _active
+    if not _resolved:
+        _resolve()
+    with _lock:
+        prev, _active = _active, None
+    try:
+        yield
+    finally:
+        with _lock:
+            _active = prev
+
+
+def spillable_columns(name: str, cols: Mapping[str, Any],
+                      mgr: Optional[MemoryManager] = None):
+    """Wrap a column mapping as a registered LRU spill candidate when a
+    budget is active; returns the mapping unchanged otherwise."""
+    m = mgr if mgr is not None else active()
+    if m is None or not m.spill_enabled:
+        return cols if isinstance(cols, dict) else dict(cols)
+    wrapped = SpillableColumns(name, cols, m)
+    m.register(wrapped)
+    return wrapped
+
+
+def note_frame_cache(frame) -> None:
+    """Record a frame's forced block cache for the host-side gauge."""
+    m = active()
+    if m is not None:
+        m.note_frame_cache(frame)
+
+
+def forget_frame_cache(frame) -> None:
+    m = _active
+    if m is not None:
+        m.forget_frame_cache(frame)
+
+
+def _metrics_lines() -> list:
+    """``tft_memory_*`` exposition lines for the metrics endpoint."""
+    from ..utils.tracing import counters as _counters
+    m = manager()
+    snap = m.snapshot()
+    lines = []
+    gauges = (
+        ("tft_memory_budget_bytes",
+         "Configured device budget (0 = unlimited).",
+         snap["limit_bytes"]),
+        ("tft_memory_inflight_bytes",
+         "Bytes reserved by in-flight block dispatches.",
+         snap["inflight_bytes"]),
+        ("tft_memory_resident_bytes",
+         "Device bytes held by registered spillable buffers.",
+         snap["resident_bytes"]),
+        ("tft_memory_spilled_bytes",
+         "Host bytes held by spilled buffers awaiting fault-back.",
+         snap["spilled_bytes"]),
+        ("tft_memory_resident_buffers",
+         "Registered spillable buffers (spilled or resident).",
+         snap["resident_buffers"]),
+        ("tft_memory_frame_cache_bytes",
+         "Host bytes held by forced TensorFrame block caches.",
+         m.frame_cache_bytes()),
+    )
+    for name, help_s, value in gauges:
+        lines.append(f"# HELP {name} {help_s}")
+        lines.append(f"# TYPE {name} gauge")
+        lines.append(f"{name} {int(value)}")
+    for name, counter in (
+            ("tft_memory_spills_total", "memory.spills"),
+            ("tft_memory_spill_bytes_total", "memory.spill_bytes"),
+            ("tft_memory_faults_total", "memory.faults"),
+            ("tft_memory_fault_bytes_total", "memory.fault_bytes"),
+            ("tft_memory_proactive_splits_total",
+             "memory.proactive_splits"),
+            ("tft_memory_admission_waits_total",
+             "memory.admission_waits"),
+            ("tft_memory_overflow_admissions_total",
+             "memory.overflow_admissions")):
+        lines.append(f"# TYPE {name} counter")
+        lines.append(f"{name} {_counters.get(counter)}")
+    return lines

@@ -1,0 +1,94 @@
+"""Byte/row estimates for frames and dispatches.
+
+The admission side of the memory subsystem needs numbers *before* work
+runs: how many bytes will this block dispatch touch, how big is this
+frame likely to be once forced. Forced frames are exact (their cached
+blocks are counted); lazy frames carry **hints** threaded through the
+plan at construction time — source constructors record their actual
+bytes, and every op scales its input's hint by the schema row-byte
+ratio (an upper bound for ``filter``, exact for ``select``). The serve
+scheduler's admission control consumes these through
+:func:`frame_estimate`, which is what finally gives UNFORCED frames a
+real admission estimate (the PR 5 follow-on).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["array_nbytes", "block_nbytes", "blocks_estimate",
+           "schema_row_bytes", "frame_estimate", "propagate_hints"]
+
+from .spill import array_nbytes
+
+
+def block_nbytes(block) -> int:
+    """Host bytes of one block (ragged list columns sum their cells)."""
+    total = 0
+    for col in block.columns.values():
+        if isinstance(col, np.ndarray):
+            total += int(col.nbytes)
+        else:  # ragged / list-backed: per-cell arrays (or strings)
+            for cell in col:
+                total += array_nbytes(cell) or 8
+    return total
+
+
+def blocks_estimate(blocks: Sequence) -> Tuple[int, int]:
+    """Exact ``(rows, bytes)`` of a materialized block list."""
+    rows = 0
+    nbytes = 0
+    for b in blocks:
+        rows += int(b.num_rows)
+        nbytes += block_nbytes(b)
+    return rows, nbytes
+
+
+def schema_row_bytes(schema) -> int:
+    """Declared bytes per row of a schema: storage itemsize times the
+    known cell size (Unknown dims count 1 — a deliberate floor);
+    non-tensor (string) columns count a pointer."""
+    total = 0
+    for f in schema:
+        if not f.dtype.tensor:
+            total += 8
+            continue
+        cells = 1
+        cell = f.cell_shape
+        if cell is not None:
+            for d in cell.dims:
+                if isinstance(d, int) and d > 0:
+                    cells *= d
+        total += cells * int(np.dtype(f.dtype.np_storage).itemsize)
+    return max(total, 1)
+
+
+def frame_estimate(frame) -> Tuple[Optional[float], Optional[int]]:
+    """Best-effort ``(rows, bytes)`` of a frame: exact when already
+    forced (cached blocks), the construction-time plan hint otherwise,
+    ``(None, None)`` when neither exists — admission and quotas only
+    enforce what they can measure."""
+    blocks = getattr(frame, "_cache", None)
+    if blocks:
+        rows, nbytes = blocks_estimate(blocks)
+        return float(rows), nbytes
+    rows = getattr(frame, "_rows_hint", None)
+    nbytes = getattr(frame, "_bytes_hint", None)
+    return (float(rows) if rows is not None else None,
+            int(nbytes) if nbytes is not None else None)
+
+
+def propagate_hints(src_frame, out_schema
+                    ) -> Tuple[Optional[int], Optional[int]]:
+    """``(rows_hint, bytes_hint)`` for an op's result frame: rows carry
+    over; bytes scale by the schema row-byte ratio. An upper bound for
+    row-dropping ops (filter), exact for column projections."""
+    rows, nbytes = frame_estimate(src_frame)
+    if nbytes is not None:
+        src_schema = getattr(src_frame, "_schema", None)
+        if src_schema is not None and src_schema is not out_schema:
+            nbytes = int(nbytes * schema_row_bytes(out_schema)
+                         / schema_row_bytes(src_schema))
+    return (int(rows) if rows is not None else None, nbytes)

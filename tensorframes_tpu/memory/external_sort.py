@@ -1,0 +1,229 @@
+"""External-memory sort: budget-sized device runs + host k-way merge.
+
+``dsort``'s columnsort assumes the whole frame is device-resident; a
+frame larger than the device budget cannot take that path at all. This
+module is the out-of-core alternative (the classic external merge
+sort, device-flavored):
+
+1. the input rows split into contiguous **runs**, each sized to fit the
+   budget (``TFT_MEM_LIMIT_BYTES`` / the derived device budget, with a
+   4x headroom factor for input + output + staging);
+2. each run sorts **on the device** in one compiled program — the same
+   stable ``lax.sort`` chain as ``dsort``'s single-shard fallback:
+   order-transformed keys (float negation / bitwise-not for
+   ``descending``) with the run-local row position as the
+   least-significant key — admitted against the ledger like any block
+   dispatch;
+3. the sorted run moves to pinned host buffers (each move is a
+   ``memory.spill``: a device-resident intermediate leaving for host);
+4. the runs **k-way merge on the host**: adjacent pairs merge per
+   round (log2(k) rounds). Single-key numeric runs without NaNs merge
+   in O(n) with a vectorized two-pointer (``np.searchsorted``
+   interleave); multi-key or NaN-bearing keys fall back to a stable
+   ``np.lexsort`` over the concatenated pair — both keep earlier-run
+   rows first on ties, so the final order is IDENTICAL to the
+   in-memory sort's (stable by original row position).
+
+Host memory is the destination anyway — a larger-than-budget sorted
+frame can only live spilled — so the merge's host footprint (two runs
+per merge plus the output) is the natural cost, not a regression.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Any, Dict, List, Mapping, Optional, Tuple
+
+import numpy as np
+
+from ..utils.logging import get_logger
+from ..utils.tracing import counters, span
+from .spill import array_nbytes, to_pinned_host
+
+__all__ = ["external_sort"]
+
+_log = get_logger("memory.external_sort")
+
+# compiled run-sort programs keyed by (key sig, column sig); LRU-capped
+_sort_cache: "OrderedDict[Tuple, Any]" = OrderedDict()
+_sort_cache_lock = threading.Lock()
+_SORT_CACHE_CAP = 32
+
+
+def _transform_key(k: np.ndarray, descending: bool) -> np.ndarray:
+    """Order-reversing host transform matching ``dsort``'s device one
+    (``parallel.distributed._key_transform``): float negation, and
+    bitwise-not for ints (never overflows). bfloat16 (numpy kind 'V')
+    widens to float32 first — exact, order-preserving."""
+    if np.dtype(k.dtype).kind == "V":  # ml_dtypes bfloat16
+        k = k.astype(np.float32)
+    if not descending:
+        return k
+    return -k if np.dtype(k.dtype).kind == "f" else ~k
+
+
+def _merge_key(k: np.ndarray) -> np.ndarray:
+    """A merge-comparable host view of a transformed key (bfloat16 is
+    already widened by :func:`_transform_key`)."""
+    return np.ascontiguousarray(k)
+
+
+def _run_sort_fn(key_sig: Tuple, col_sig: Tuple, n_cols: int):
+    """Cached jitted stable run sort: ascending over the transformed
+    keys with the run-local position as the final tiebreak."""
+    import jax
+    import jax.numpy as jnp
+
+    key = (key_sig, col_sig)
+    with _sort_cache_lock:
+        fn = _sort_cache.get(key)
+        if fn is not None:
+            _sort_cache.move_to_end(key)
+            return fn
+
+    def program(keys, cols):
+        n = keys[0].shape[0]
+        pos = jnp.arange(n)
+        sorted_ops = jax.lax.sort(tuple(keys) + (pos,),
+                                  num_keys=len(keys) + 1)
+        order = sorted_ops[-1]
+        outs = tuple(jnp.take(c, order, axis=0) for c in cols)
+        return sorted_ops[:-1], outs, order
+
+    fn = jax.jit(program)
+    with _sort_cache_lock:
+        fn = _sort_cache.setdefault(key, fn)
+        _sort_cache.move_to_end(key)
+        while len(_sort_cache) > _SORT_CACHE_CAP:
+            _sort_cache.popitem(last=False)
+    return fn
+
+
+def _sort_one_run(keys_t: List[np.ndarray], cols: Dict[str, np.ndarray],
+                  names: List[str], start: int, manager
+                  ) -> Dict[str, Any]:
+    """Sort one run on the device within budget; returns the run record
+    spilled to pinned host buffers."""
+    key_sig = tuple((a.shape, str(a.dtype)) for a in keys_t)
+    col_sig = tuple((n, cols[n].shape, str(cols[n].dtype)) for n in names)
+    fn = _run_sort_fn(key_sig, col_sig, len(names))
+    run_bytes = (sum(a.nbytes for a in keys_t)
+                 + sum(cols[n].nbytes for n in names))
+    tok = 0
+    if manager is not None:
+        tok = manager.reserve(2 * run_bytes, op="memory.external_sort")
+    try:
+        with span("memory.run_sort"):
+            s_keys, s_cols, order = fn(tuple(keys_t),
+                                       tuple(cols[n] for n in names))
+            # D2H into pinned buffers: the run leaves the device — this
+            # IS the spill the external path exists to make
+            rec = {
+                "mk": [_merge_key(np.asarray(k)) for k in s_keys],
+                "cols": {n: to_pinned_host(c)
+                         for n, c in zip(names, s_cols)},
+                "ids": np.asarray(order).astype(np.int64) + start,
+            }
+    finally:
+        if manager is not None:
+            manager.release(tok)
+    if manager is not None:
+        manager.note_spill(run_bytes, name=f"sort-run@{start}")
+    return rec
+
+
+def _merge_two(a: Dict[str, Any], b: Dict[str, Any],
+               fast: bool) -> Dict[str, Any]:
+    """Stable merge of two sorted runs; run ``a``'s rows (earlier
+    original positions) come first on equal keys."""
+    na = len(a["ids"])
+    nb = len(b["ids"])
+    if fast:
+        ka, kb = a["mk"][0], b["mk"][0]
+        pos_a = np.arange(na) + np.searchsorted(kb, ka, side="left")
+        pos_b = np.arange(nb) + np.searchsorted(ka, kb, side="right")
+
+        def interleave(x, y):
+            out = np.empty((na + nb,) + x.shape[1:], x.dtype)
+            out[pos_a] = x
+            out[pos_b] = y
+            return out
+    else:
+        cat = [np.concatenate([x, y]) for x, y in zip(a["mk"], b["mk"])]
+        # np.lexsort is stable and the last key is primary; runs
+        # concatenate a-first, so ties keep original order
+        order = np.lexsort(tuple(reversed(cat)))
+
+        def interleave(x, y):
+            return np.concatenate([x, y])[order]
+
+    return {
+        "mk": [interleave(x, y) for x, y in zip(a["mk"], b["mk"])],
+        "cols": {n: interleave(a["cols"][n], b["cols"][n])
+                 for n in a["cols"]},
+        "ids": interleave(a["ids"], b["ids"]),
+    }
+
+
+def external_sort(columns: Mapping[str, np.ndarray], keys: List[str],
+                  descending: bool = False, manager=None,
+                  run_bytes: Optional[int] = None
+                  ) -> Tuple[Dict[str, np.ndarray], np.ndarray,
+                             Dict[str, int]]:
+    """Sort host ``columns`` by ``keys`` out-of-core (module docstring).
+
+    Returns ``(sorted_columns, order, stats)`` where ``order`` maps each
+    output row to its input row (host ride-along columns permute with
+    it) and ``stats`` carries ``{"runs", "rows", "bytes"}``. The result
+    order is bit-identical to a stable in-memory sort by the transformed
+    keys — i.e. to ``dsort`` over the same rows.
+    """
+    names = sorted(columns)
+    for k in keys:
+        if k not in columns:
+            raise KeyError(f"No sort key column {k!r}; columns: {names}")
+    n = int(next(iter(columns.values())).shape[0]) if columns else 0
+    total = sum(array_nbytes(columns[c]) for c in names)
+    if run_bytes is None:
+        budget = getattr(manager, "limit", None)
+        run_bytes = max(budget // 4, 1) if budget else max(total, 1)
+    row_bytes = max(total // max(n, 1), 1)
+    run_rows = max(int(run_bytes) // row_bytes, 1)
+    stats = {"runs": 0, "rows": n, "bytes": total}
+    if n == 0:
+        return ({c: np.asarray(columns[c]) for c in names},
+                np.empty(0, np.int64), stats)
+
+    keys_t = [_transform_key(np.asarray(columns[k]), descending)
+              for k in keys]
+    # the O(n) searchsorted merge needs a single totally-ordered key:
+    # NaNs break the comparator, multi-key needs lexicographic ties
+    fast = (len(keys) == 1
+            and not (np.dtype(keys_t[0].dtype).kind == "f"
+                     and bool(np.isnan(keys_t[0]).any())))
+
+    runs: List[Dict[str, Any]] = []
+    with span("memory.external_sort"):
+        for start in range(0, n, run_rows):
+            end = min(start + run_rows, n)
+            run_cols = {c: np.ascontiguousarray(columns[c][start:end])
+                        for c in names}
+            run_keys = [k[start:end] for k in keys_t]
+            runs.append(_sort_one_run(run_keys, run_cols, names, start,
+                                      manager))
+        stats["runs"] = len(runs)
+        counters.inc("memory.external_sorts")
+        counters.inc("memory.external_sort_runs", len(runs))
+        _log.debug("external sort: %d rows (%d B) in %d run(s) of "
+                   "<=%d rows", n, total, len(runs), run_rows)
+        with span("memory.kway_merge"):
+            while len(runs) > 1:
+                nxt = []
+                for i in range(0, len(runs) - 1, 2):
+                    nxt.append(_merge_two(runs[i], runs[i + 1], fast))
+                if len(runs) % 2:
+                    nxt.append(runs[-1])
+                runs = nxt
+    merged = runs[0]
+    return dict(merged["cols"]), merged["ids"], stats

@@ -264,6 +264,8 @@ class QueryTrace:
             "compile_seconds": 0.0, "dispatches": 0,
             "mesh_dispatches": 0, "collectives": 0,
             "mesh_shrinks": 0, "rebalances": 0,
+            "spills": 0, "spill_bytes": 0, "faults": 0,
+            "proactive_splits": 0, "external_sort_runs": 0,
             "events": 0, "dropped": self.dropped,
             "occupancy_mean": None, "slots": 0,
             "mesh": None, "hbm": None,
@@ -313,6 +315,15 @@ class QueryTrace:
                 s["mesh_shrinks"] += 1
             elif ev.etype == "rebalance":
                 s["rebalances"] += 1
+            elif ev.etype == "spill":
+                s["spills"] += 1
+                s["spill_bytes"] += int(a.get("bytes") or 0)
+            elif ev.etype == "fault":
+                s["faults"] += 1
+            elif ev.etype == "proactive_split":
+                s["proactive_splits"] += 1
+            elif ev.etype == "external_sort":
+                s["external_sort_runs"] += int(a.get("runs") or 0)
             elif ev.etype == "shard":
                 d = a.get("device")
                 if d is not None:

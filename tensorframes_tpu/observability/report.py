@@ -129,6 +129,15 @@ def render(trace: "_events.QueryTrace") -> str:
         lines.append(f"  memory   : peak HBM {_fmt_bytes(h['peak'])} "
                      f"(live {_fmt_bytes(h['live_start'])} -> "
                      f"{_fmt_bytes(h['live_end'])})")
+    if (s["spills"] or s["faults"] or s["proactive_splits"]
+            or s["external_sort_runs"]):
+        ext = (f", external sort in {s['external_sort_runs']} run(s)"
+               if s["external_sort_runs"] else "")
+        lines.append(
+            f"  spill    : {s['spills']} spill(s) "
+            f"({_fmt_bytes(s['spill_bytes'])} to host), "
+            f"{s['faults']} fault(s), {s['proactive_splits']} proactive "
+            f"split(s){ext} (docs/memory.md)")
     extra = f" (+{s['dropped']} dropped)" if s["dropped"] else ""
     lines.append(f"  events   : {s['events']} recorded{extra}")
     if trace.stages:

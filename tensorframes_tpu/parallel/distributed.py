@@ -35,6 +35,7 @@ from jax.sharding import PartitionSpec as P
 from ..utils.compat import shard_map
 
 from .. import dtypes as _dt
+from .. import memory as _memory
 from ..engine import ops as _ops
 from ..frame import Block, TensorFrame
 from ..resilience import default_policy as _default_policy, faults as _faults
@@ -240,7 +241,13 @@ class DistributedFrame:
 
     @property
     def padded_rows(self) -> int:
-        first = next(iter(self.columns.values()))
+        # shape metadata must NOT fault a spilled frame back to the
+        # device (collect_frame/valid_row_mask route through here; a
+        # larger-than-budget collect would re-resident the whole frame)
+        cols = self.columns
+        if isinstance(cols, _memory.SpillableColumns):
+            return cols.leading_rows()
+        first = next(iter(cols.values()))
         return first.shape[0]
 
     def per_shard_valid(self) -> np.ndarray:
@@ -286,7 +293,14 @@ class DistributedFrame:
         Fully-addressable arrays read directly; multi-host arrays gather
         the process-local blocks (process-contiguous row layout, the
         ``cluster.distribute_local`` invariant) with one allgather.
+        Spilled columns (``docs/memory.md``) are served from their
+        pinned host buffers WITHOUT faulting back to the device — a
+        larger-than-budget frame can be collected without ever being
+        device-resident again.
         """
+        if isinstance(self.columns, _memory.SpillableColumns) \
+                and self.columns.mem_is_spilled():
+            return self.columns.host_value(name)
         return _read_global(self.columns[name])
 
     def collect_frame(self, num_partitions: Optional[int] = None) -> TensorFrame:
@@ -423,6 +437,7 @@ def distribute(df: TensorFrame, mesh: DeviceMesh) -> DistributedFrame:
     n = merged.num_rows
     shards = mesh.num_data_shards
     padded = ((n + shards - 1) // shards) * shards if n else shards
+    mem_mgr = _memory.active()
     cols: Dict[str, jax.Array] = {}
     for f in df.schema:
         a = merged.dense(f.name)
@@ -444,8 +459,20 @@ def distribute(df: TensorFrame, mesh: DeviceMesh) -> DistributedFrame:
             with span("distribute.convert_pad"):
                 from .. import native as _native
                 a = _native.convert(a, dd)
+        if mem_mgr is not None:
+            # spill colder frames before placing this column (a single
+            # column larger than the whole budget still proceeds —
+            # docs/memory.md degradation matrix)
+            mem_mgr.make_room(int(a.nbytes))
         with span("distribute.device_put"):
             cols[f.name] = jax.device_put(a, mesh.row_sharding(a.ndim))
+    if mem_mgr is not None and mem_mgr.spill_enabled:
+        # the frame's device columns become one LRU spill candidate:
+        # cold mesh frames move to pinned host buffers under pressure
+        # and fault back transparently on the next column access
+        cols = _memory.spillable_columns(
+            f"distribute:{df._plan.split('(', 1)[0]}@{id(df):x}", cols,
+            mem_mgr)
     result = DistributedFrame(mesh, df.schema, cols, n)
     trace = current_trace()
     if trace is not None:
@@ -511,7 +538,10 @@ def _dmap_blocks(fetches, dist: DistributedFrame, trim: bool,
             _native_mesh_fallback(e)
             outs_np = None
         if outs_np is not None:
-            cols = dict(dist.columns)
+            # per-key copy through __getitem__: dict()'s raw fast-path
+            # copy would bypass SpillableColumns' fault-back and hand a
+            # concurrently-spilled frame's None placeholders downstream
+            cols = {n: dist.columns[n] for n in dist.columns}
             for spec in comp.outputs:
                 a = outs_np[spec.name]
                 cols[spec.name] = jax.device_put(
@@ -560,7 +590,9 @@ def _dmap_blocks(fetches, dist: DistributedFrame, trim: bool,
         raise ValueError(
             f"row_aligned=True but the output has {n_out} rows and the "
             f"input {dist.padded_rows}")
-    cols = {} if trim else dict(dist.columns)
+    # per-key copy through __getitem__ (see the native-mesh branch):
+    # dict() would bypass a spilled SpillableColumns' fault-back
+    cols = {} if trim else {n: dist.columns[n] for n in dist.columns}
     for spec in comp.outputs:
         cols[spec.name] = out[spec.name]
     num_rows = dist.num_rows if row_aligned else n_out
@@ -732,13 +764,14 @@ def dsort(keys, dist: DistributedFrame, descending: bool = False
     if isinstance(keys, str):
         keys = [keys]
     keys = list(keys)
+    ext = _dsort_external_if_needed(keys, dist, descending)
+    if ext is not None:
+        return ext
     return _elastic.elastic_call("dsort", dist,
                                  lambda d: _dsort(keys, d, descending))
 
 
-def _dsort(keys, dist: DistributedFrame, descending: bool
-           ) -> DistributedFrame:
-    schema = dist.schema
+def _validate_dsort_keys(schema: Schema, keys) -> None:
     for k in keys:
         f = schema.get(k)
         if f is None:
@@ -750,6 +783,90 @@ def _dsort(keys, dist: DistributedFrame, descending: bool
         if f.block_shape is not None and len(f.block_shape.dims) != 1:
             raise _ops.InvalidShapeError(
                 f"dsort key {k!r} must be a scalar column")
+
+
+def _dsort_external_if_needed(keys, dist: DistributedFrame,
+                              descending: bool
+                              ) -> Optional[DistributedFrame]:
+    """Route a larger-than-budget frame to the external-memory sort.
+
+    Engages only under an active device budget
+    (``TFT_MEM_LIMIT_BYTES`` / the derived HBM budget) when the frame's
+    tensor columns exceed ``TFT_MEM_SORT_FRACTION`` of it — the
+    in-device columnsort would hold input + exchange buffers resident
+    at once. Sizes are read WITHOUT faulting spilled columns back.
+    """
+    mgr = _memory.active()
+    if mgr is None or not mgr.spill_enabled:
+        return None
+    threshold = mgr.external_sort_threshold()
+    if threshold is None:
+        return None
+    tensor_names = [f.name for f in dist.schema if f.dtype.tensor]
+    total = sum(_memory.value_nbytes(dist.columns, n)
+                for n in tensor_names)
+    if total <= threshold:
+        return None
+    _validate_dsort_keys(dist.schema, keys)
+    return _dsort_external(keys, dist, descending, mgr)
+
+
+def _dsort_external(keys, dist: DistributedFrame, descending: bool,
+                    mgr) -> DistributedFrame:
+    """Out-of-core dsort: budget-sized device runs + host k-way merge
+    (``memory.external_sort``), result bit-identical to the in-memory
+    path — stable by original row order, pads at the global tail
+    (prefix validity), host ride-along columns permuted alongside.
+    """
+    mesh = dist.mesh
+    schema = dist.schema
+    tensor_names = [f.name for f in schema if f.dtype.tensor]
+    host_names = [f.name for f in schema if not f.dtype.tensor]
+    with span("dsort.external"):
+        mask = dist.valid_row_mask()
+        valid_idx = np.flatnonzero(mask)
+        host_cols = {}
+        for n in tensor_names:
+            a = _memory.host_value(dist.columns, n)
+            host_cols[n] = a[mask]
+        sorted_cols, order, stats = _memory.external_sort(
+            host_cols, keys, descending=descending, manager=mgr)
+        trace = current_trace()
+        if trace is not None:
+            trace.add("external_sort", rows=stats["rows"],
+                      runs=stats["runs"], bytes=stats["bytes"])
+        padded = dist.padded_rows
+        n_valid = len(valid_idx)
+        new_cols: Dict[str, jax.Array] = {}
+        for n in tensor_names:
+            s = sorted_cols[n]
+            if padded != n_valid:
+                out = np.zeros((padded,) + s.shape[1:], s.dtype)
+                out[:n_valid] = s
+                s = out
+            mgr.make_room(int(s.nbytes))
+            with span("dsort.external_put"):
+                new_cols[n] = jax.device_put(s, mesh.row_sharding(s.ndim))
+        for n in host_names:
+            col = np.asarray(dist.columns[n], object)
+            g = col[valid_idx[order]]
+            if padded != n_valid:
+                g = np.concatenate(
+                    [g, np.full(padded - n_valid, None, object)])
+            new_cols[n] = g
+        cols = _memory.spillable_columns(
+            f"dsort.external@{id(dist):x}", new_cols, mgr)
+        get_logger("dsort").info(
+            "dsort took the external-memory path: %d rows (%d B) in %d "
+            "run(s), k-way merged on the host", stats["rows"],
+            stats["bytes"], stats["runs"])
+        return DistributedFrame(mesh, schema, cols, dist.num_rows)
+
+
+def _dsort(keys, dist: DistributedFrame, descending: bool
+           ) -> DistributedFrame:
+    schema = dist.schema
+    _validate_dsort_keys(schema, keys)
     mesh = dist.mesh
     S = mesh.num_data_shards
     tensor_names = [f.name for f in schema if f.dtype.tensor]

@@ -163,17 +163,21 @@ class SubmittedQuery:
     """
 
     __slots__ = ("query_id", "tenant", "est_rows", "est_bytes",
-                 "deadline_at", "submitted_at", "started_at",
-                 "finished_at", "state", "_thunk", "_event", "_result",
-                 "_error")
+                 "est_stream_bytes", "deadline_at", "submitted_at",
+                 "started_at", "finished_at", "state", "_thunk",
+                 "_event", "_result", "_error")
 
     def __init__(self, query_id: str, tenant: str, thunk: Callable[[], Any],
                  est_rows: Optional[float], est_bytes: Optional[int],
-                 deadline_at: Optional[float]):
+                 deadline_at: Optional[float],
+                 est_stream_bytes: Optional[int] = None):
         self.query_id = query_id
         self.tenant = tenant
         self.est_rows = est_rows
         self.est_bytes = est_bytes
+        # the streaming working set (~one block of the frame): what the
+        # spill-capable ledger actually has to hold at once
+        self.est_stream_bytes = est_stream_bytes
         self.deadline_at = deadline_at  # monotonic, or None
         self.submitted_at = time.monotonic()
         self.started_at: Optional[float] = None
@@ -243,19 +247,15 @@ class _Tenant:
 
 
 def _estimate(frame) -> Tuple[Optional[float], Optional[int]]:
-    """Best-effort (rows, bytes) of a frame: exact when already forced
-    (cached blocks), None otherwise — admission and quotas only enforce
-    what they can measure."""
-    blocks = getattr(frame, "_cache", None)
-    if not blocks:
-        return None, None
-    rows = 0
-    nbytes = 0
-    for b in blocks:
-        r, nb = _obs.block_meta(b)
-        rows += int(r or 0)
-        nbytes += int(nb or 0)
-    return float(rows), nbytes
+    """Best-effort (rows, bytes) of a frame through the memory
+    manager's estimator (``docs/memory.md``): exact when already forced
+    (cached blocks), the plan-derived hint for UNFORCED frames — source
+    constructors record their actual bytes and ops scale them — and
+    ``(None, None)`` only when neither exists. Admission and quotas
+    enforce what they can measure; before the memory subsystem that
+    meant forced frames only (the PR 5 follow-on this closes)."""
+    from .. import memory as _memory
+    return _memory.frame_estimate(frame)
 
 
 # live schedulers, newest last (serve_report() and the metrics provider
@@ -482,10 +482,15 @@ class QueryScheduler:
                         f"{est_rows:g} rows); retry later (classified "
                         f"'over_quota', transient)")
             dl = deadline if deadline is not None else t.deadline_s
+            est_stream = None
+            if est_bytes:
+                parts = max(1, getattr(frame, "num_partitions", 1) or 1)
+                est_stream = max(1, int(est_bytes / parts))
             q = SubmittedQuery(
                 f"{self.name}-q{next(self._qid)}", tenant, thunk,
                 est_rows, est_bytes,
-                time.monotonic() + dl if dl is not None else None)
+                time.monotonic() + dl if dl is not None else None,
+                est_stream_bytes=est_stream)
             was_empty = not t.queue
             t.queue.append(q)
             if was_empty:
@@ -604,9 +609,27 @@ class QueryScheduler:
         self._finish(q, t, result=result)
 
     def _admit(self, q: SubmittedQuery) -> None:
-        """HBM admission: wait (bounded) for headroom, else shed."""
+        """HBM admission: wait (bounded) for headroom, else shed.
+
+        Against a real backend watermark the whole-frame estimate is
+        the enforceable footprint (pre-spill semantics). When the
+        headroom comes from the spill-capable memory ledger instead
+        (``docs/memory.md`` — no backend stats, ``TFT_MEM_LIMIT_BYTES``
+        set), admission is **spill-aware**: the engine streams the
+        frame block-by-block and the ledger spills or splits the rest,
+        so the footprint compared is the streaming working set
+        (~one block) — a larger-than-budget query is executable
+        out-of-core and must not be shed for its total size.
+        """
         if not self._admission or not q.est_bytes:
             return
+        need = q.est_bytes
+        if q.est_stream_bytes is not None \
+                and _obs_device.watermark() is None:
+            from .. import memory as _memory
+            mgr = _memory.active()
+            if mgr is not None and mgr.spill_enabled:
+                need = min(need, q.est_stream_bytes)
         budget = env_float("TFT_SERVE_ADMISSION_WAIT_S", 5.0)
         poll = env_float("TFT_SERVE_ADMISSION_POLL_S", 0.02)
         give_up_at = time.monotonic() + max(budget, 0.0)
@@ -615,34 +638,45 @@ class QueryScheduler:
         waited = False
         while True:
             headroom = self._hbm_headroom()
-            if headroom is None or q.est_bytes <= headroom:
+            if headroom is None or need <= headroom:
                 if waited:
                     counters.inc("serve.admission_waits")
                 return
             if time.monotonic() >= give_up_at:
                 raise AdmissionDeadline(
                     f"query {q.query_id} (tenant {q.tenant!r}) shed: "
-                    f"estimated footprint {q.est_bytes} B exceeds HBM "
+                    f"estimated footprint {need} B exceeds HBM "
                     f"headroom {headroom} B and admission could not "
                     f"clear within its budget (classified "
                     f"'deadline_admission')")
             if not waited:
                 waited = True
                 _obs.add_event("sched_admission_wait", name=q.query_id,
-                               tenant=q.tenant, est_bytes=q.est_bytes)
+                               tenant=q.tenant, est_bytes=need)
             time.sleep(max(poll, 0.001))
 
     def _hbm_headroom(self) -> Optional[int]:
-        """Bytes below the high-water mark, or None when unenforceable
-        (no memory stats / no limit — e.g. the CPU backend)."""
+        """Bytes below the high-water mark, or None when unenforceable.
+
+        The backend watermark (live allocator stats) is authoritative
+        when the backend reports one; otherwise the memory manager's
+        ledger stands in (``docs/memory.md``) — its budget minus
+        in-flight reservations, with spillable resident bytes counted
+        as reclaimable — which makes admission enforceable even on
+        backends without memory stats (``TFT_MEM_LIMIT_BYTES`` on CPU).
+        None only when neither exists."""
         wm = _obs_device.watermark()
+        frac = env_float("TFT_SERVE_HBM_FRACTION", 0.9)
         if wm is None:
+            from .. import memory as _memory
+            mgr = _memory.active()
+            if mgr is not None:
+                return mgr.headroom(frac)
             return None
         limit = env_int("TFT_SERVE_HBM_LIMIT_BYTES", 0) \
             or wm.get("limit_bytes") or 0
         if limit <= 0:
             return None
-        frac = env_float("TFT_SERVE_HBM_FRACTION", 0.9)
         return int(limit * frac) - int(wm["live_bytes"])
 
     def _finish(self, q: SubmittedQuery, t: _Tenant,

@@ -31,9 +31,12 @@ aggregates, keys lexicographically sorted) and its state is evicted —
 state is bounded by the number of windows the watermark keeps open
 times the live key cardinality. Rows for an already-closed window are
 **late**: counted (``stream.late_rows``) and dropped, never resurrect
-state. ``max_state_rows`` adds a hard cap: the oldest window is
-force-emitted (``stream.state_evictions``) when live state rows would
-exceed it.
+state. ``max_state_rows`` adds a hard cap on DEVICE-resident state
+rows: under an active memory manager (``docs/memory.md``) the oldest
+window SPILLS to pinned host buffers (``stream.state_spills``) —
+staying logically live, faulting back on its next touch — and only
+without a budget does it force-emit early
+(``stream.state_evictions``), the pre-spill behavior.
 
 Without a window the aggregation runs in **update mode**: one global
 state table, and each batch emits the updated rows for the keys it
@@ -99,15 +102,37 @@ def sliding(size: float, slide: float) -> Window:
 
 
 class _WState:
-    """One window's live state: host key table + device value tables."""
+    """One window's live state: host key table + device value tables.
 
-    __slots__ = ("keys_u", "values", "rows")
+    ``spilled`` state holds its value tables as pinned host numpy
+    instead (``spill()``): logically identical — the merge programs
+    accept host arrays and re-place them on the device at the next fold
+    (the transparent fault-back) — but costing zero device bytes, which
+    is what lets ``max_state_rows`` bound DEVICE state without
+    force-emitting incomplete windows (``docs/memory.md``).
+    """
+
+    __slots__ = ("keys_u", "values", "rows", "spilled")
 
     def __init__(self, keys_u: List[np.ndarray], values: Dict[str, object],
                  rows: int):
         self.keys_u = keys_u        # per key column: sorted unique values
         self.values = values        # fetch -> device array [rows, ...]
         self.rows = rows
+        self.spilled = False
+
+    def spill(self) -> int:
+        """Move the device value tables to pinned host buffers; returns
+        the device bytes freed. Bit-identical round trip (the host view
+        keeps the device dtype, bfloat16 included)."""
+        from .. import memory as _memory
+        freed = 0
+        for f, v in list(self.values.items()):
+            if _memory.is_device_value(v):
+                freed += _memory.array_nbytes(v)
+                self.values[f] = _memory.to_pinned_host(v)
+        self.spilled = True
+        return freed
 
     @property
     def nbytes(self) -> int:
@@ -272,6 +297,8 @@ class StreamingAggregation:
         self.late_rows = 0
         self.windows_emitted = 0
         self.state_evictions = 0
+        self.state_spills = 0
+        self.state_faults = 0
 
     # -- introspection (the runtime's metrics read these) -----------------
     @property
@@ -417,6 +444,19 @@ class StreamingAggregation:
         if base is None:
             return _WState([np.asarray(u) for u in fact.uniques], parts,
                            fact.num_groups), np.arange(fact.num_groups)
+        if base.spilled:
+            # transparent fault-back: the merge programs re-place the
+            # host tables on the device as part of the fold (the result
+            # state is device-resident again)
+            from .. import memory as _memory
+            self.state_faults += 1
+            counters.inc("stream.state_faults")
+            mgr = _memory.active()
+            if mgr is not None:
+                mgr.note_fault(
+                    sum(_memory.array_nbytes(v)
+                        for v in base.values.values()),
+                    name="stream-window")
         g, h = base.rows, fact.num_groups
         cat = [np.concatenate([o, n])
                for o, n in zip(base.keys_u, fact.uniques)]
@@ -454,15 +494,42 @@ class StreamingAggregation:
         self._closed_through = max(self._closed_through, wm - size)
 
     def _evict_over_cap(self) -> None:
+        """Bound live DEVICE state to ``max_state_rows``.
+
+        Under an active memory manager the oldest window SPILLS to
+        pinned host buffers instead of force-emitting — the window
+        stays logically live (late rows keep folding in after a
+        transparent fault-back at the next touch) and only stops
+        costing device bytes (``stream.state_spills``). Without a
+        budget, the pre-spill behavior stands: the oldest window
+        force-emits early (``stream.state_evictions``)."""
         if self.max_state_rows is None:
             return
+        from .. import memory as _memory
+        mgr = _memory.active()
+        spill_ok = mgr is not None and mgr.spill_enabled
         while True:
             with self._state_lock:
-                total = sum(w.rows for w in self._windows.values())
-                if total <= self.max_state_rows or not self._windows:
+                live = [(k, w) for k, w in self._windows.items()
+                        if not w.spilled]
+                total = sum(w.rows for _, w in live)
+                if total <= self.max_state_rows or not live:
                     return
-                oldest = min(self._windows)
-                rows = self._windows[oldest].rows
+                oldest = min(k for k, _ in live)
+                state = self._windows[oldest]
+                rows = state.rows
+                if spill_ok:
+                    freed = state.spill()
+            if spill_ok:
+                self.state_spills += 1
+                counters.inc("stream.state_spills")
+                mgr.note_spill(freed, name=f"stream-window@{oldest}")
+                _log.debug(
+                    "stream state over max_state_rows=%d; spilled "
+                    "window %s (%d rows, %d B) to host — it stays live "
+                    "and faults back on the next touch",
+                    self.max_state_rows, oldest, rows, freed)
+                continue
             self.state_evictions += 1
             counters.inc("stream.state_evictions")
             _obs.add_event("state_eviction", window=oldest, rows=rows)

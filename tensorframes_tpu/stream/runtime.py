@@ -457,6 +457,8 @@ class StreamHandle:
                 "watermark": None,
                 "windows_emitted": 0,
                 "state_evictions": 0,
+                "state_spills": 0,
+                "state_faults": 0,
                 "buffered_updates": len(self._updates),
             }
         if self._agg is not None:
@@ -467,6 +469,8 @@ class StreamHandle:
             out["watermark"] = self._agg.watermark
             out["windows_emitted"] = self._agg.windows_emitted
             out["state_evictions"] = self._agg.state_evictions
+            out["state_spills"] = self._agg.state_spills
+            out["state_faults"] = self._agg.state_faults
         return out
 
     def __repr__(self):

@@ -76,6 +76,11 @@ def pytest_configure(config):
                    "repartitioning suite (run-tests.sh --elastic runs "
                    "this lane standalone)")
     config.addinivalue_line(
+        "markers", "memory: device-memory manager suite — budget "
+                   "ledger, spill/fault-back, external sort, "
+                   "larger-than-budget queries (run-tests.sh --memory "
+                   "runs this lane standalone)")
+    config.addinivalue_line(
         "markers", "timing: wall-clock-sensitive deadline assertions — "
                    "margins are widened for loaded machines "
                    "(TFT_TIMING_MARGIN multiplies the bounds; "

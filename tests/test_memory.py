@@ -1,0 +1,762 @@
+"""Device-memory manager suite: budget ledger, spill/fault bit-identity,
+LRU ordering, proactive splits, external dsort, larger-than-budget
+queries (``docs/memory.md``; ``run-tests.sh --memory`` runs this lane).
+
+Every test that configures a budget goes through the ``mem`` fixture so
+the process singleton is always restored — the rest of the suite must
+keep running unlimited (zero-cost path).
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+import ml_dtypes
+
+import tensorframes_tpu as tft
+from tensorframes_tpu import memory
+from tensorframes_tpu.memory import (MemoryManager, SpillableBuffer,
+                                     SpillableColumns, external_sort)
+from tensorframes_tpu.parallel import distributed as D
+from tensorframes_tpu.parallel import mesh as M
+from tensorframes_tpu.utils.tracing import counters
+
+from conftest import timing_margin
+
+pytestmark = pytest.mark.memory
+
+
+@pytest.fixture
+def mem():
+    """Configure an explicit budget; always restores the env-resolved
+    singleton afterwards."""
+    def set_limit(nbytes, spill=None):
+        return memory.configure(limit_bytes=nbytes, spill=spill)
+
+    yield set_limit
+    memory._reset()
+
+
+def _delta(name):
+    """Counter snapshot helper: returns a closure reporting the delta."""
+    start = counters.get(name)
+    return lambda: counters.get(name) - start
+
+
+def _rows(df):
+    return [tuple(r) for r in df.collect()]
+
+
+# ---------------------------------------------------------------------------
+# ledger basics
+# ---------------------------------------------------------------------------
+
+class TestLedger:
+    def test_unlimited_is_inactive(self, mem):
+        m = mem(0)
+        assert not m.limited
+        assert memory.active() is None
+        # admission collapses to a no-op token
+        assert m.reserve(10 ** 12) == 0
+        assert m.try_reserve(10 ** 12) == 0
+        assert m.headroom() is None
+        assert m.would_overflow(10 ** 12) is False
+
+    def test_env_budget_resolution(self, mem, monkeypatch):
+        monkeypatch.setenv("TFT_MEM_LIMIT_BYTES", "12345")
+        memory._reset()
+        m = memory.manager()
+        assert m.limit == 12345
+        assert memory.active() is m
+
+    def test_reserve_release_accounting(self, mem):
+        m = mem(1000)
+        t1 = m.reserve(400, op="t")
+        t2 = m.reserve(400, op="t")
+        assert m.snapshot()["inflight_bytes"] == 800
+        assert m.try_reserve(400) is None  # over budget, nothing to spill
+        m.release(t1)
+        t3 = m.try_reserve(400)
+        assert t3 == 400
+        m.release(t2)
+        m.release(t3)
+        assert m.snapshot()["inflight_bytes"] == 0
+
+    def test_would_overflow_is_whole_budget(self, mem):
+        m = mem(1000)
+        assert m.would_overflow(1001)
+        assert not m.would_overflow(1000)
+
+    def test_soft_admission_counts_overflow(self, mem, monkeypatch):
+        monkeypatch.setenv("TFT_MEM_ADMIT_WAIT_S", "0.05")
+        m = mem(1000)
+        hold = m.reserve(900)
+        over = _delta("memory.overflow_admissions")
+        waits = _delta("memory.admission_waits")
+        tok = m.reserve(900, op="t")  # cannot fit: waits, then admits over
+        assert tok == 900
+        assert over() == 1
+        assert waits() == 1
+        m.release(hold)
+        m.release(tok)
+
+    def test_impossible_request_overflows_without_stalling(self, mem,
+                                                           monkeypatch):
+        import time
+        # nbytes > limit can never fit: reserve must overflow-admit
+        # immediately, not burn the whole admission-wait budget
+        monkeypatch.setenv("TFT_MEM_ADMIT_WAIT_S", "5.0")
+        m = mem(1000)
+        over = _delta("memory.overflow_admissions")
+        t0 = time.monotonic()
+        tok = m.reserve(2000, op="t")
+        assert time.monotonic() - t0 < 1.0
+        assert tok == 2000
+        assert over() == 1
+        m.release(tok)
+
+    @pytest.mark.timing
+    def test_admission_wait_is_bounded(self, mem, monkeypatch):
+        import time
+        monkeypatch.setenv("TFT_MEM_ADMIT_WAIT_S", "0.2")
+        m = mem(1000)
+        hold = m.reserve(1000)
+        t0 = time.monotonic()
+        tok = m.reserve(500, op="t")
+        took = time.monotonic() - t0
+        assert took < timing_margin(3.0)
+        assert took >= 0.15
+        m.release(hold)
+        m.release(tok)
+
+    def test_admission_unblocks_on_release(self, mem, monkeypatch):
+        monkeypatch.setenv("TFT_MEM_ADMIT_WAIT_S", "5.0")
+        m = mem(1000)
+        hold = m.reserve(900)
+        over = _delta("memory.overflow_admissions")
+        got = []
+
+        def admit():
+            got.append(m.reserve(500, op="t"))
+
+        t = threading.Thread(target=admit)
+        t.start()
+        m.release(hold)
+        t.join(timeout=timing_margin(10.0))
+        assert not t.is_alive()
+        assert got == [500]
+        assert over() == 0  # a clean admission, not an overflow
+        m.release(500)
+
+
+# ---------------------------------------------------------------------------
+# spill / fault bit-identity
+# ---------------------------------------------------------------------------
+
+SPILL_DTYPES = [np.float32, np.float64, np.int32, np.int64, np.uint32,
+                np.bool_, ml_dtypes.bfloat16]
+
+
+class TestSpillFault:
+    @pytest.mark.parametrize("dtype", SPILL_DTYPES,
+                             ids=[np.dtype(d).name for d in SPILL_DTYPES])
+    def test_round_trip_bit_identity(self, dtype, rng):
+        raw = rng.standard_normal(257) * 100
+        host = raw.astype(dtype)
+        dev = jax.device_put(host)
+        buf = SpillableBuffer("t", {"x": dev})
+        nbytes = buf.mem_device_bytes()
+        assert nbytes == host.nbytes
+        freed = buf.spill()
+        assert freed == nbytes and buf.spilled
+        assert buf.mem_device_bytes() == 0
+        assert buf.mem_host_bytes() == nbytes
+        back = buf.get("x")  # faults the buffer back
+        assert not buf.spilled
+        out = np.asarray(back)
+        assert out.dtype == host.dtype
+        # BIT identity, not value closeness
+        np.testing.assert_array_equal(out.view(np.uint8),
+                                      host.view(np.uint8))
+
+    def test_string_ride_along_untouched(self):
+        s = np.array(["a", "bb", None], object)
+        dev = jax.device_put(np.arange(3.0))
+        buf = SpillableBuffer("t", {"x": dev, "s": s})
+        buf.spill()
+        assert buf.mem_device_bytes() == 0
+        got = buf.arrays()
+        assert got["s"] is s  # never copied, never converted
+        np.testing.assert_array_equal(np.asarray(got["x"]),
+                                      np.arange(3.0))
+
+    def test_double_spill_and_fault_are_idempotent(self):
+        buf = SpillableBuffer("t", {"x": jax.device_put(np.arange(8.0))})
+        assert buf.spill() > 0
+        assert buf.spill() == 0
+        assert buf.fault() > 0
+        assert buf.fault() == 0
+
+    def test_spillable_columns_transparent_access(self, mem):
+        m = mem(10 ** 9)
+        cols = {"x": jax.device_put(np.arange(16.0)),
+                "s": np.array(list("abcdefghijklmnop"), object)}
+        sc = memory.spillable_columns("t", cols, m)
+        assert isinstance(sc, SpillableColumns)
+        faults = _delta("memory.faults")
+        sc.mem_spill()
+        assert sc.mem_is_spilled()
+        # any access faults the mapping back, through the manager
+        np.testing.assert_array_equal(np.asarray(sc["x"]),
+                                      np.arange(16.0))
+        assert not sc.mem_is_spilled()
+        assert faults() == 1
+
+    def test_spillable_columns_host_value_does_not_fault(self, mem):
+        m = mem(10 ** 9)
+        sc = memory.spillable_columns(
+            "t", {"x": jax.device_put(np.arange(16.0))}, m)
+        sc.mem_spill()
+        np.testing.assert_array_equal(sc.host_value("x"), np.arange(16.0))
+        assert sc.mem_is_spilled()  # still spilled
+
+    def test_inactive_manager_returns_plain_dict(self, mem):
+        mem(0)
+        cols = {"x": jax.device_put(np.arange(4.0))}
+        out = memory.spillable_columns("t", cols)
+        assert type(out) is dict
+
+
+# ---------------------------------------------------------------------------
+# LRU ordering under pressure
+# ---------------------------------------------------------------------------
+
+class TestLRU:
+    def _buf(self, name, n=100):
+        return SpillableBuffer(
+            name, {"x": jax.device_put(np.arange(n, dtype=np.float64))})
+
+    def test_cold_entry_spills_first(self, mem):
+        m = mem(3000)  # three 800 B buffers fit
+        a, b, c = self._buf("a"), self._buf("b"), self._buf("c")
+        for buf in (a, b, c):
+            m.register(buf)
+        m.touch(a)  # a is now hottest; b is the coldest
+        tok = m.reserve(2000, op="t")  # needs two spills
+        assert b.spilled and c.spilled
+        assert not a.spilled
+        m.release(tok)
+
+    def test_registration_over_budget_spills_immediately(self, mem):
+        m = mem(1000)
+        spills = _delta("memory.spills")
+        a, b = self._buf("a"), self._buf("b")
+        m.register(a)
+        m.register(b)  # 1600 B resident > 1000: the LRU one spills
+        assert a.spilled and not b.spilled
+        assert spills() == 1
+
+    def test_fault_back_spills_others(self, mem):
+        m = mem(1000)
+        a, b = self._buf("a"), self._buf("b")
+        m.register(a)
+        m.register(b)
+        assert a.spilled
+        m.touch(a)  # faulting a back must push b out
+        assert not a.spilled and b.spilled
+
+    def test_dead_entries_are_pruned(self, mem):
+        m = mem(10 ** 6)
+        buf = self._buf("a")
+        m.register(buf)
+        assert m.snapshot()["resident_buffers"] == 1
+        del buf
+        import gc
+        gc.collect()
+        assert m.snapshot()["resident_buffers"] == 0
+
+    def test_drop_releases_entry(self, mem):
+        m = mem(10 ** 6)
+        buf = self._buf("a")
+        m.register(buf)
+        m.drop(buf)
+        assert m.snapshot()["resident_buffers"] == 0
+
+
+# ---------------------------------------------------------------------------
+# executor integration: proactive splits, sync dispatch, window spill
+# ---------------------------------------------------------------------------
+
+class TestExecutorAdmission:
+    def test_proactive_split_before_dispatch(self, mem):
+        mem(4096)
+        proactive = _delta("memory.proactive_splits")
+        oom = _delta("oom_split.dispatches")
+        df = tft.frame({"x": np.arange(4096, dtype=np.float64)})
+        out = df.map_rows(lambda x: {"z": x + 1.0})
+        z = np.concatenate([np.asarray(b.columns["z"])
+                            for b in out.blocks()])
+        np.testing.assert_array_equal(z, np.arange(4096.0) + 1.0)
+        assert proactive() > 0
+        assert oom() == 0  # split BEFORE the allocator, not after
+
+    def test_pipeline_pressure_falls_back_to_sync(self, mem, monkeypatch):
+        # window of 64 KiB blocks against a 100 KiB budget: the async
+        # submit path cannot hold depth x est in flight and must run
+        # some blocks synchronously (admitted) instead of blocking
+        monkeypatch.setenv("TFT_PIPELINE_DEPTH", "4")
+        mem(100 * 1024)
+        sync = _delta("memory.sync_dispatches")
+        df = tft.frame({"x": np.arange(32768, dtype=np.float64)},
+                       num_partitions=4)
+        out = df.map_blocks(lambda x: {"z": x * 2.0})
+        z = np.concatenate([np.asarray(b.columns["z"])
+                            for b in out.blocks()])
+        np.testing.assert_array_equal(z, np.arange(32768.0) * 2.0)
+        assert sync() > 0
+
+    def test_unlimited_run_reserves_nothing(self, mem):
+        mem(0)
+        waits = _delta("memory.admission_waits")
+        spills = _delta("memory.spills")
+        df = tft.frame({"x": np.arange(10000.0)}, num_partitions=4)
+        df.map_blocks(lambda x: {"z": x + 1.0}).blocks()
+        assert waits() == 0 and spills() == 0
+
+    def test_pending_block_is_spill_candidate(self, mem):
+        from tensorframes_tpu.engine.executor import BlockExecutor
+        from tensorframes_tpu.computation import Computation, TensorSpec
+        from tensorframes_tpu.shape import Shape, Unknown
+        from tensorframes_tpu import dtypes as _dt
+
+        m = mem(10 ** 6)
+        comp = Computation.trace(
+            lambda x: {"z": x + 1.0},
+            [TensorSpec("x", _dt.double, Shape(Unknown))])
+        ex = BlockExecutor()
+        arrays = {"x": np.arange(64, dtype=np.float64)}
+        pending = ex.submit(comp, arrays, pad_ok=False)
+        assert m.snapshot()["resident_buffers"] == 1
+        # a ledger spill early-drains the device output to host
+        assert m.make_room(10 ** 6)
+        assert pending.mem_is_spilled()
+        out = pending.drain()
+        np.testing.assert_array_equal(out["z"], np.arange(64.0) + 1.0)
+        assert m.snapshot()["resident_buffers"] == 0
+
+
+# ---------------------------------------------------------------------------
+# external sort
+# ---------------------------------------------------------------------------
+
+class TestExternalSort:
+    def _cols(self, rng, n=5000):
+        return {"k": rng.integers(0, 200, n).astype(np.int64),
+                "v": rng.random(n)}
+
+    @pytest.mark.parametrize("descending", [False, True])
+    def test_matches_stable_inmemory_sort(self, rng, descending, mem):
+        m = mem(16 * 1024)
+        cols = self._cols(rng)
+        out, order, stats = external_sort(cols, ["k"],
+                                          descending=descending,
+                                          manager=m)
+        assert stats["runs"] > 1
+        key = -cols["k"] if descending else cols["k"]
+        ref = np.argsort(key, kind="stable")
+        np.testing.assert_array_equal(order, ref)
+        np.testing.assert_array_equal(out["k"], cols["k"][ref])
+        np.testing.assert_array_equal(out["v"], cols["v"][ref])
+
+    def test_multi_key_lexicographic(self, rng, mem):
+        m = mem(16 * 1024)
+        n = 4000
+        cols = {"a": rng.integers(0, 8, n).astype(np.int64),
+                "b": rng.integers(0, 8, n).astype(np.int64),
+                "v": rng.random(n)}
+        out, order, _ = external_sort(cols, ["a", "b"], manager=m)
+        ref = np.lexsort((cols["b"], cols["a"]))
+        np.testing.assert_array_equal(order, ref)
+        np.testing.assert_array_equal(out["v"], cols["v"][ref])
+
+    def test_nan_keys_sort_last(self, rng, mem):
+        m = mem(8 * 1024)
+        n = 3000
+        k = rng.random(n)
+        k[rng.integers(0, n, 50)] = np.nan
+        cols = {"k": k, "v": np.arange(n, dtype=np.float64)}
+        out, order, _ = external_sort(cols, ["k"], manager=m)
+        ref = np.argsort(k, kind="stable")  # numpy puts NaN last too
+        np.testing.assert_array_equal(order, ref)
+
+    def test_counts_run_spills(self, rng, mem):
+        m = mem(16 * 1024)
+        spills = _delta("memory.spills")
+        _, _, stats = external_sort(self._cols(rng), ["k"], manager=m)
+        assert spills() >= stats["runs"]
+
+    def test_empty_input(self, mem):
+        m = mem(1024)
+        out, order, stats = external_sort(
+            {"k": np.empty(0, np.int64)}, ["k"], manager=m)
+        assert len(order) == 0 and stats["runs"] == 0
+
+
+# ---------------------------------------------------------------------------
+# external dsort vs in-memory dsort
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def mesh4():
+    return M.local_mesh(4)
+
+
+class TestExternalDsort:
+    def _frame(self, rng, n=8192):
+        return tft.frame(
+            {"k": rng.integers(0, 500, n).astype(np.int64),
+             "v": rng.random(n)}, num_partitions=4)
+
+    def test_equals_inmemory_dsort(self, rng, mesh4, mem):
+        df = self._frame(rng)
+        mem(0)
+        ref = _rows(D.dsort("k", D.distribute(df, mesh4)).collect_frame())
+        mem(32 * 1024)  # frame is 128 KiB of device columns
+        ext = _delta("memory.external_sorts")
+        got = _rows(D.dsort("k", D.distribute(df, mesh4)).collect_frame())
+        assert ext() == 1  # the external path actually ran
+        assert got == ref
+
+    def test_descending_equals_inmemory(self, rng, mesh4, mem):
+        df = self._frame(rng)
+        mem(0)
+        ref = _rows(D.dsort("v", D.distribute(df, mesh4),
+                            descending=True).collect_frame())
+        mem(32 * 1024)
+        got = _rows(D.dsort("v", D.distribute(df, mesh4),
+                            descending=True).collect_frame())
+        assert got == ref
+
+    def test_string_ride_along_permutes(self, rng, mesh4, mem):
+        n = 4096
+        df = tft.frame(
+            {"k": rng.integers(0, 97, n).astype(np.int64),
+             "s": np.array([f"row{i}" for i in range(n)], object)},
+            num_partitions=4)
+        mem(0)
+        ref = _rows(D.dsort("k", D.distribute(df, mesh4)).collect_frame())
+        mem(8 * 1024)
+        got = _rows(D.dsort("k", D.distribute(df, mesh4)).collect_frame())
+        assert got == ref
+
+    def test_under_threshold_keeps_columnsort(self, rng, mesh4, mem):
+        mem(10 ** 9)  # limited, but the frame fits comfortably
+        ext = _delta("memory.external_sorts")
+        df = self._frame(rng, n=512)
+        D.dsort("k", D.distribute(df, mesh4)).collect_frame()
+        assert ext() == 0
+
+    def test_invalid_key_still_raises(self, rng, mesh4, mem):
+        mem(8 * 1024)
+        dist = D.distribute(self._frame(rng), mesh4)
+        with pytest.raises(KeyError):
+            D.dsort("nope", dist)
+
+    def test_spilled_frame_collects_without_faulting(self, rng, mesh4,
+                                                     mem):
+        # the PR's core promise: a larger-than-budget frame collects
+        # from its pinned host buffers — shape metadata (padded_rows /
+        # valid_row_mask) and host reads must never fault it back
+        m = mem(16 * 1024)
+        df = self._frame(rng)  # 128 KiB of device columns
+        dist = D.distribute(df, mesh4)
+        assert dist.columns.mem_is_spilled()  # registration spilled it
+        faults = _delta("memory.faults")
+        assert dist.padded_rows == 8192
+        out = dist.collect_frame()
+        assert out.count() == 8192
+        assert faults() == 0, \
+            "collect_frame re-resident a spilled frame"
+        assert dist.columns.mem_is_spilled()
+
+    def test_dmap_result_copies_through_accessors(self, rng, mesh4,
+                                                  mem):
+        # dict(dist.columns) would raw-copy a spilled mapping's None
+        # placeholders; the per-key copy faults back and stays correct
+        m = mem(10 ** 9)
+        dist = D.distribute(self._frame(rng, n=512), mesh4)
+        dist.columns.mem_spill()
+        out = D.dmap_blocks(lambda v: {"z": v + 1.0}, dist)
+        got = out.collect_frame()
+        assert got.count() == 512
+        assert all(c is not None for c in
+                   (out.columns[n] for n in out.columns))
+
+
+# ---------------------------------------------------------------------------
+# the acceptance bar: a frame 4x the budget completes the relational
+# suite bit-identical, with spills and zero allocator OOMs
+# ---------------------------------------------------------------------------
+
+class TestLargerThanBudget:
+    def test_relational_suite_4x_limit(self, rng, mesh4, mem):
+        n = 16384  # 2 f64 columns = 256 KiB
+        df = tft.frame(
+            {"k": rng.integers(0, 100, n).astype(np.float64),
+             "v": rng.random(n)}, num_partitions=8)
+
+        def suite():
+            mapped = df.map_blocks(lambda v: {"mv": v * 2.0})
+            filtered = mapped.filter(lambda k: k < 50.0)
+            map_rows = [tuple(r) for r in filtered.collect()]
+            agg = tft.aggregate({"v": "sum"}, df.group_by("k"))
+            agg_rows = [tuple(r) for r in agg.collect()]
+            dist = D.distribute(df, mesh4)
+            sort_rows = _rows(D.dsort("k", dist).collect_frame())
+            red = tft.reduce_blocks(
+                lambda v_input: {"v": v_input.sum()}, df)
+            if isinstance(red, dict):
+                red = red["v"]
+            return map_rows, agg_rows, sort_rows, float(np.asarray(red))
+
+        mem(0)
+        ref = suite()
+        mem(64 * 1024)  # the frame is 4x this budget
+        spills = _delta("memory.spills")
+        oom = _delta("oom_split.dispatches")
+        got = suite()
+        assert got[0] == ref[0], "map/filter diverged under the budget"
+        assert got[1] == ref[1], "aggregate diverged under the budget"
+        assert got[2] == ref[2], "dsort diverged under the budget"
+        assert got[3] == pytest.approx(ref[3], rel=1e-12)
+        assert spills() > 0, "a 4x-budget run must spill"
+        assert oom() == 0, "zero allocator OOMs: the ledger acts first"
+
+
+# ---------------------------------------------------------------------------
+# serve integration: unforced estimates + ledger headroom
+# ---------------------------------------------------------------------------
+
+class TestServeIntegration:
+    def test_unforced_frame_gets_estimate(self, mem):
+        from tensorframes_tpu.serve.scheduler import _estimate
+        df = tft.frame({"x": np.arange(512.0)})
+        lazy = df.map_blocks(lambda x: {"z": x + 1.0})
+        rows, nbytes = _estimate(lazy)
+        assert rows == 512.0
+        assert nbytes == 512 * 8 * 2  # x + z, f64
+        # forced stays exact
+        lazy.blocks()
+        rows2, nbytes2 = _estimate(lazy)
+        assert rows2 == 512.0 and nbytes2 == nbytes
+
+    def test_filter_estimate_is_upper_bound(self, mem):
+        df = tft.frame({"x": np.arange(512.0)})
+        f = df.filter(lambda x: x < 0.0)
+        assert f.estimated_rows() == 512  # bound, not truth
+        f.blocks()
+        assert f.estimated_rows() == 0  # exact once forced
+
+    def test_ledger_headroom_backs_admission(self, mem):
+        m = mem(10000)
+        from tensorframes_tpu.serve.scheduler import QueryScheduler
+        sched = QueryScheduler(workers=0, name="memtest")
+        try:
+            assert sched._hbm_headroom() == int(10000 * 0.9)
+            tok = m.reserve(5000)
+            assert sched._hbm_headroom() == int(10000 * 0.9) - 5000
+            m.release(tok)
+        finally:
+            sched.close()
+
+    def test_larger_than_budget_query_admits_spill_aware(self, mem):
+        # the engine executes a 4x-budget frame out-of-core, so the
+        # ledger-backed admission must compare the streaming working
+        # set (~one block), not the whole frame — and serve it
+        mem(64 * 1024)
+        from tensorframes_tpu.serve.scheduler import QueryScheduler
+        df = tft.frame({"x": np.arange(32768, dtype=np.float64)},
+                       num_partitions=16)  # 256 KiB, blocks of 16 KiB
+        with QueryScheduler(workers=1, name="memadmit") as sched:
+            fut = sched.submit(df.map_blocks(lambda x: {"z": x + 1.0}),
+                               tenant="t")
+            out = fut.result(timeout=timing_margin(60.0))
+            assert out.count() == 32768
+            assert fut.state == "done"
+
+    def test_no_budget_headroom_is_none(self, mem):
+        mem(0)
+        from tensorframes_tpu.serve.scheduler import QueryScheduler
+        sched = QueryScheduler(workers=0, name="memtest2")
+        try:
+            assert sched._hbm_headroom() is None
+        finally:
+            sched.close()
+
+
+# ---------------------------------------------------------------------------
+# stream state: spill instead of force-evict
+# ---------------------------------------------------------------------------
+
+class TestStreamStateSpill:
+    def _run_stream(self, cap):
+        from tensorframes_tpu.stream import GeneratorSource, StreamingFrame
+        from tensorframes_tpu.stream.aggregate import tumbling
+
+        def batches():
+            for i in range(6):
+                yield {"t": np.full(8, float(i)),
+                       "k": np.arange(8, dtype=np.int64),
+                       "v": np.full(8, 1.0)}
+
+        sf = StreamingFrame(GeneratorSource(batches()))
+        agg = sf.group_by("k").aggregate(
+            {"v": "sum"}, window=tumbling(2.0), time_col="t",
+            watermark_delay=10.0,  # nothing emits by watermark
+            max_state_rows=cap)
+        h = agg.start()
+        h.run()
+        frames = h.collect_updates()
+        rows = sorted(tuple(map(float, r))
+                      for f in frames for r in f.collect())
+        return agg, rows
+
+    def test_spills_and_keeps_windows_live(self, mem):
+        mem(0)
+        agg_ref, ref = self._run_stream(cap=10 ** 9)  # uncapped truth
+        mem(10 ** 9)
+        agg, got = self._run_stream(cap=8)
+        assert agg.state_spills > 0
+        assert agg.state_evictions == 0, \
+            "with a memory manager the cap spills, never force-emits"
+        assert got == ref  # results identical to the uncapped run
+        assert agg.state_faults == 0  # no window was touched twice here
+
+    def test_spilled_window_faults_back_on_late_fold(self, mem):
+        from tensorframes_tpu.stream import GeneratorSource, StreamingFrame
+        from tensorframes_tpu.stream.aggregate import tumbling
+
+        def batches():
+            # window 0 fills, window 2 pushes it out (spill), then more
+            # rows for window 0 arrive -> fault-back + fold
+            yield {"t": np.full(8, 0.0),
+                   "k": np.arange(8, dtype=np.int64),
+                   "v": np.full(8, 1.0)}
+            yield {"t": np.full(8, 2.0),
+                   "k": np.arange(8, dtype=np.int64),
+                   "v": np.full(8, 1.0)}
+            yield {"t": np.full(8, 0.5),
+                   "k": np.arange(8, dtype=np.int64),
+                   "v": np.full(8, 2.0)}
+
+        mem(10 ** 9)
+        sf = StreamingFrame(GeneratorSource(batches()))
+        agg = sf.group_by("k").aggregate(
+            {"v": "sum"}, window=tumbling(2.0), time_col="t",
+            watermark_delay=10.0, max_state_rows=8)
+        h = agg.start()
+        h.run()
+        assert agg.state_spills > 0
+        assert agg.state_faults > 0
+        rows = sorted(tuple(map(float, r))
+                      for f in h.collect_updates() for r in f.collect())
+        # window 0: v = 1 + 2 = 3 per key; window 2: v = 1 per key
+        w0 = [r for r in rows if r[0] == 0.0]
+        assert all(r[2] == 3.0 for r in w0) and len(w0) == 8
+
+    def test_without_budget_force_evicts_as_before(self, mem):
+        mem(0)
+        agg, _ = self._run_stream(cap=8)
+        assert agg.state_evictions > 0
+        assert agg.state_spills == 0
+
+
+# ---------------------------------------------------------------------------
+# frame cache accounting + metrics + explain
+# ---------------------------------------------------------------------------
+
+class TestObservability:
+    def test_frame_cache_gauge_and_uncache(self, mem):
+        m = mem(10 ** 9)
+        df = tft.frame({"x": np.arange(1000.0)})
+        df.blocks()
+        assert m.frame_cache_bytes() == 8000
+        df.uncache()
+        assert m.frame_cache_bytes() == 0
+        assert df._cache is None
+
+    def test_metrics_families_present(self, mem):
+        mem(4096)
+        from tensorframes_tpu.observability.metrics import metrics_text
+        tft.frame({"x": np.arange(2048.0)}).map_rows(
+            lambda x: {"z": x + 1.0}).blocks()
+        text = metrics_text()
+        for family in ("tft_memory_budget_bytes",
+                       "tft_memory_inflight_bytes",
+                       "tft_memory_spilled_bytes",
+                       "tft_memory_spills_total",
+                       "tft_memory_proactive_splits_total"):
+            assert family in text, family
+
+    def test_explain_renders_spill_line(self, rng, mesh4, mem):
+        from tensorframes_tpu.utils import tracing
+        from tensorframes_tpu.observability import last_query_report
+        mem(32 * 1024)
+        df = tft.frame(
+            {"k": rng.integers(0, 50, 8192).astype(np.int64),
+             "v": rng.random(8192)}, num_partitions=4)
+        tracing.enable()
+        try:
+            D.dsort("k", D.distribute(df, mesh4))
+            report = last_query_report()
+        finally:
+            tracing.disable()
+        assert "spill" in report
+        assert "external sort" in report
+
+    def test_proactive_split_event_in_trace(self, mem):
+        from tensorframes_tpu.utils import tracing
+        mem(4096)
+        df = tft.frame({"x": np.arange(4096, dtype=np.float64)})
+        out = df.map_rows(lambda x: {"z": x + 1.0})
+        tracing.enable()
+        try:
+            out.blocks()
+            trace = out._trace
+        finally:
+            tracing.disable()
+        assert trace is not None
+        assert trace.summary()["proactive_splits"] > 0
+
+
+# ---------------------------------------------------------------------------
+# zero-cost when unlimited
+# ---------------------------------------------------------------------------
+
+class TestZeroCostUnlimited:
+    def test_active_is_none_without_budget(self, mem):
+        mem(0)
+        assert memory.active() is None
+
+    def test_no_ledger_traffic_in_relational_suite(self, rng, mem):
+        mem(0)
+        before = {k: counters.get(k) for k in
+                  ("memory.spills", "memory.faults",
+                   "memory.admission_waits", "memory.sync_dispatches",
+                   "memory.proactive_splits")}
+        df = tft.frame({"k": rng.integers(0, 10, 1000).astype(np.int64),
+                        "v": rng.random(1000)}, num_partitions=4)
+        df.map_blocks(lambda v: {"z": v + 1.0}).filter(
+            lambda z: z > 0.5).blocks()
+        tft.aggregate({"v": "sum"}, df.group_by("k")).blocks()
+        for k, v in before.items():
+            assert counters.get(k) == v, k
+
+    def test_bypass_context(self, mem):
+        m = mem(1000)
+        assert memory.active() is m
+        with memory.bypass():
+            assert memory.active() is None
+        assert memory.active() is m

@@ -16,10 +16,12 @@
    contract is CLASSIFIED failure (a scheduler that silently eats an
    error turns a rejection into a hang), the streaming layer's
    batch-skip contract is skip-AND-COUNT (a silently swallowed batch
-   error is a data-loss bug with no trace), and the parallel layer's
+   error is a data-loss bug with no trace), the parallel layer's
    elastic recovery depends on device-loss errors REACHING its
    classifier (a swallowed mesh error turns a recoverable loss into
-   silent corruption or a later hang). Handle it or log it
+   silent corruption or a later hang), and the memory layer's spill /
+   fault-back path moves user data between device and host (a silently
+   swallowed spill error is silent data loss). Handle it or log it
    (``_log.debug`` is enough).
 
 AST-based, so strings and comments never false-positive.
@@ -32,7 +34,7 @@ import sys
 ROOT = pathlib.Path(__file__).resolve().parent.parent / "tensorframes_tpu"
 # packages where `except Exception: pass` (silent swallow) is also banned
 STRICT_ROOTS = (ROOT / "observability", ROOT / "serve", ROOT / "stream",
-                ROOT / "parallel")
+                ROOT / "parallel", ROOT / "memory")
 
 
 def _is_exception_name(node) -> bool:
