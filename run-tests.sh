@@ -72,6 +72,16 @@ if [ "${1:-}" = "--memory" ]; then
   exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m memory "$@"
 fi
 
+# --plan: run only the logical-plan lane (tests/test_plan.py: fused vs
+# TFT_FUSE=0 bit-identity across the relational chains, column pruning,
+# device-resident stage chaining, plan-derived estimates, fault
+# injection on fused computations) — fast, CPU-only, no native build
+if [ "${1:-}" = "--plan" ]; then
+  shift
+  echo "== plan lane (pytest -m plan, CPU) =="
+  exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m plan "$@"
+fi
+
 # --timing: run only the wall-clock-sensitive deadline tests, serially
 # (they flake under concurrent suite load; TFT_TIMING_MARGIN widens
 # their assertion bounds further on badly oversubscribed boxes)

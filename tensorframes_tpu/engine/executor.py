@@ -37,7 +37,7 @@ from ..utils.tracing import (counters, enabled as _tracing_enabled,
 
 __all__ = ["BlockExecutor", "PaddingExecutor", "PendingBlock",
            "default_executor", "default_padding_executor",
-           "set_computation_interner"]
+           "set_computation_interner", "to_storage_dtype"]
 
 _log = get_logger("engine.executor")
 
@@ -246,6 +246,16 @@ def _timed_first_dispatch(fn, dev_arrays):
     return out
 
 
+def to_storage_dtype(a: np.ndarray, dtype) -> np.ndarray:
+    """Cast one host output array to its column storage dtype (bfloat16
+    keeps its device view) — the single rule ``_convert_back`` and the
+    plan executor's final-column conversion share."""
+    storage = dtype.np_storage
+    if a.dtype != storage and dtype is not _dt.bfloat16:
+        return _native.convert(a, storage)
+    return a
+
+
 def _row_count(comp: Computation, arrays: Mapping) -> Optional[int]:
     """Leading row count of the first row-dimensioned input, if any."""
     for spec in comp.inputs:
@@ -299,10 +309,11 @@ class PendingBlock:
 
     __slots__ = ("_executor", "_comp", "_arrays", "_pad_ok", "_out",
                  "_pad_to", "_n_rows", "_error", "_host", "_mem_mgr",
-                 "_mem_bytes", "__weakref__")
+                 "_mem_bytes", "_keep_device", "__weakref__")
 
     def __init__(self, executor, comp, arrays, pad_ok, out=None,
-                 pad_to=None, n_rows=None, error=None):
+                 pad_to=None, n_rows=None, error=None,
+                 keep_device=False):
         self._executor = executor
         self._comp = comp
         self._arrays = arrays
@@ -311,6 +322,11 @@ class PendingBlock:
         self._pad_to = pad_to
         self._n_rows = n_rows
         self._error = error
+        # keep_device drains return raw (sliced) device outputs — the
+        # plan executor's pipelined resident edges (docs/plan.md); an
+        # early ledger spill (mem_spill) still hands back host arrays,
+        # which every consumer accepts
+        self._keep_device = keep_device
         # memory-manager integration: while in the FIFO window this
         # block is a registered spill candidate — its device output can
         # be drained to pinned host early under pressure
@@ -363,6 +379,19 @@ class PendingBlock:
         if self._error is None:
             try:
                 faults.check("drain")
+                if self._keep_device:
+                    out = self._out
+                    result = {}
+                    for spec in self._comp.outputs:
+                        a = out[spec.name]
+                        if self._pad_to is not None \
+                                and spec.shape.ndim > 0 \
+                                and spec.shape.head == -1 \
+                                and a.shape[:1] == (self._pad_to,):
+                            a = a[:self._n_rows]
+                        result[spec.name] = a
+                    jax.block_until_ready(result)
+                    return result
                 return self._executor._convert_back(
                     self._comp, self._out, self._pad_to, self._n_rows)
             except Exception as e:
@@ -383,7 +412,8 @@ class PendingBlock:
             "synchronously through the resilient path", self._error)
         self._out = None  # drop the failed device outputs before re-running
         return self._executor.run(self._comp, self._arrays,
-                                  pad_ok=self._pad_ok)
+                                  pad_ok=self._pad_ok,
+                                  keep_device=self._keep_device)
 
 
 class BlockExecutor:
@@ -516,16 +546,24 @@ class BlockExecutor:
     def _convert_inputs(self, comp: Computation, arrays: Mapping):
         """Host marshalling half: inputs cast to device dtypes; returns
         ``(dev_arrays, n_rows)`` with ``n_rows`` the leading row count of
-        the first row-dimensioned input (None when there is none)."""
+        the first row-dimensioned input (None when there is none).
+
+        Already-device-resident inputs (jax arrays in the device dtype —
+        the logical plan's stage chaining, ``docs/plan.md``) pass through
+        untouched: no D2H pull, no host cast, no re-upload."""
         dev_arrays = {}
         n_rows = None
         with span("executor.convert"):
             for spec in comp.inputs:
-                a = np.asarray(arrays[spec.name])
+                a = arrays[spec.name]
                 dd = _dt.device_dtype(spec.dtype)
-                if a.dtype != dd:
-                    a = _native.convert(a, dd)  # threaded kernel when built
-                dev_arrays[spec.name] = a
+                if isinstance(a, jax.Array) and a.dtype == dd:
+                    dev_arrays[spec.name] = a
+                else:
+                    a = np.asarray(a)
+                    if a.dtype != dd:
+                        a = _native.convert(a, dd)  # threaded when built
+                    dev_arrays[spec.name] = a
                 if spec.shape.ndim > 0 and spec.shape.head == -1:
                     n_rows = a.shape[0] if n_rows is None else n_rows
         return dev_arrays, n_rows
@@ -554,17 +592,21 @@ class BlockExecutor:
             if pad_to is not None:
                 host_out = _slice_outputs(comp, host_out, pad_to, n_rows)
             for spec in comp.outputs:
-                a = host_out[spec.name]
-                storage = spec.dtype.np_storage
-                if a.dtype != storage and spec.dtype is not _dt.bfloat16:
-                    a = _native.convert(a, storage)
-                result[spec.name] = a
+                result[spec.name] = to_storage_dtype(
+                    host_out[spec.name], spec.dtype)
         return result
 
     def run(self, comp: Computation,
             arrays: Mapping[str, np.ndarray],
-            pad_ok: bool = True) -> Dict[str, np.ndarray]:
+            pad_ok: bool = True,
+            keep_device: bool = False) -> Dict[str, np.ndarray]:
         """Run a computation on host arrays; returns host arrays.
+
+        ``keep_device=True`` returns the raw device outputs instead of
+        converting back to host storage dtypes — the logical plan's
+        stage chaining feeds them straight into the next stage's inputs
+        (``docs/plan.md``). Recovery paths (OOM split, proactive split)
+        still return host arrays; callers must accept either.
 
         Inputs are cast to their device dtypes (double -> f32 on TPU) and
         outputs cast back to the computation's declared storage dtypes.
@@ -623,6 +665,16 @@ class BlockExecutor:
                                               e)
                     raise
 
+            if keep_device:
+                result = {}
+                for spec in comp.outputs:
+                    a = out[spec.name]
+                    if pad_to is not None and spec.shape.ndim > 0 \
+                            and spec.shape.head == -1 \
+                            and a.shape[:1] == (pad_to,):
+                        a = a[:n_rows]  # slices stay device-resident
+                    result[spec.name] = a
+                return result
             return self._convert_back(comp, out, pad_to, n_rows)
         finally:
             if mem_tok:
@@ -630,7 +682,8 @@ class BlockExecutor:
 
     def submit(self, comp: Computation,
                arrays: Mapping[str, np.ndarray],
-               pad_ok: bool = True) -> PendingBlock:
+               pad_ok: bool = True,
+               keep_device: bool = False) -> PendingBlock:
         """Async fast-path half of :meth:`run`: convert + pad + dispatch
         with NO readiness barrier and NO retry loop. Never raises — any
         failure (including injected compile/dispatch/oom/pad_compile
@@ -659,7 +712,8 @@ class BlockExecutor:
                                est_bytes=est)
                     from .pipeline import ReadyResult
                     return ReadyResult(self.run(comp, arrays,
-                                                pad_ok=pad_ok))
+                                                pad_ok=pad_ok,
+                                                keep_device=keep_device))
                 mem = (mgr, tok, est)
             donate = False
             if pad_to is not None:
@@ -677,7 +731,8 @@ class BlockExecutor:
                 out = (_timed_first_dispatch(fn, dev_arrays) if fresh
                        else fn(dev_arrays))
             pending = PendingBlock(self, comp, arrays, pad_ok, out=out,
-                                   pad_to=pad_to, n_rows=n_rows)
+                                   pad_to=pad_to, n_rows=n_rows,
+                                   keep_device=keep_device)
             if mem is not None:
                 # the reservation becomes a resident ledger entry: while
                 # this block sits in the FIFO window its device output is
@@ -694,7 +749,7 @@ class BlockExecutor:
             # pad_to rides along so drain() knows whether the sync
             # re-run's exact-shape fallback could still recover this
             return PendingBlock(self, comp, arrays, pad_ok, error=e,
-                                pad_to=pad_to)
+                                pad_to=pad_to, keep_device=keep_device)
 
     def clear(self):
         with self._lock:

@@ -306,6 +306,31 @@ def _drain_with(finish):
 # map_blocks
 # ---------------------------------------------------------------------------
 
+def empty_schema_block(schema: Schema) -> Block:
+    """A 0-row block of ``schema``, Unknown cell dims floored at 0 — the
+    empty-partition construction (reference DebugRowOps.scala:374-385).
+    The SINGLE definition: ``map_blocks``' empty guard and the plan
+    executor's empty-chain replay must agree bit-for-bit."""
+    cols: Dict[str, Column] = {}
+    for f in schema:
+        cell = f.cell_shape
+        dims = tuple(0 if d == Unknown else d
+                     for d in (cell.dims if cell else ()))
+        cols[f.name] = np.empty((0,) + dims, f.dtype.np_storage)
+    return Block(cols, 0)
+
+
+def empty_fetch_columns(b: Block, outputs) -> Block:
+    """A 0-row block: ``b``'s columns plus empty fetch columns built
+    from row-level output specs — ``map_rows``' empty guard, shared
+    with the plan executor's empty-chain replay."""
+    cols = dict(b.columns)
+    for s in outputs:
+        dims = tuple(0 if d == Unknown else d for d in s.shape.dims)
+        cols[s.name] = np.empty((0,) + dims, s.dtype.np_storage)
+    return Block(cols, 0)
+
+
 def map_blocks(fetches: Fetches, df: TensorFrame, trim: bool = False,
                executor: Optional[BlockExecutor] = None) -> TensorFrame:
     """Transform a frame block-by-block, appending (or, with ``trim``,
@@ -319,15 +344,7 @@ def map_blocks(fetches: Fetches, df: TensorFrame, trim: bool = False,
                in_names, fetch_names, trim)
 
     def empty_block() -> Block:
-        # Empty-partition guard (reference DebugRowOps.scala:374-385):
-        # emit an empty block of the right schema without executing.
-        cols: Dict[str, Column] = {}
-        for f in out_schema:
-            cell = f.cell_shape
-            dims = tuple(0 if d == Unknown else d
-                         for d in (cell.dims if cell else ()))
-            cols[f.name] = np.empty((0,) + dims, f.dtype.np_storage)
-        return Block(cols, 0)
+        return empty_schema_block(out_schema)
 
     def finish_block(b: Block, out: Dict[str, np.ndarray]) -> Block:
         lead = {out[f].shape[0] for f in fetch_names}
@@ -363,13 +380,19 @@ def map_blocks(fetches: Fetches, df: TensorFrame, trim: bool = False,
         return _pipeline.submit(ex, comp, arrays, pad_ok=not trim)
 
     rows_h, bytes_h = _memory.propagate_hints(df, out_schema)
-    return TensorFrame(out_schema,
-                       _stream_thunk(df, ex, run_block, submit_block,
-                                     _drain_with(finish_block)),
-                       df.num_partitions,
-                       plan=f"map_blocks({df._plan})",
-                       rows_hint=None if trim else rows_h,
-                       bytes_hint=None if trim else bytes_h)
+    out = TensorFrame(out_schema,
+                      _stream_thunk(df, ex, run_block, submit_block,
+                                    _drain_with(finish_block)),
+                      df.num_partitions,
+                      plan=f"map_blocks({df._plan})",
+                      rows_hint=None if trim else rows_h,
+                      bytes_hint=None if trim else bytes_h)
+    if executor is None:
+        # record the logical-plan node (docs/plan.md); an explicit
+        # executor= pins the per-op path, so no node is attached
+        from ..plan.nodes import MapBlocksNode, attach, node_for
+        attach(out, MapBlocksNode(node_for(df), out_schema, comp, trim))
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -423,11 +446,7 @@ def map_rows(fetches: Fetches, df: TensorFrame,
 
     def run_block(b: Block) -> Block:
         if b.num_rows == 0:
-            cols = dict(b.columns)
-            for f in comp.outputs:
-                dims = tuple(0 if d == Unknown else d for d in f.shape.dims)
-                cols[f.name] = np.empty((0,) + dims, f.dtype.np_storage)
-            return Block(cols, 0)
+            return empty_fetch_columns(b, comp.outputs)
         dense = all(not b.is_ragged(n) for n in in_names)
         if dense:
             with span("map_rows.block_dense"):
@@ -478,12 +497,16 @@ def map_rows(fetches: Fetches, df: TensorFrame,
         return _pipeline.submit(ex, vcomp, arrays)
 
     rows_h, bytes_h = _memory.propagate_hints(df, out_schema)
-    return TensorFrame(out_schema,
-                       _stream_thunk(df, ex, run_block, submit_block,
-                                     _drain_with(attach_outputs)),
-                       df.num_partitions,
-                       plan=f"map_rows({df._plan})",
-                       rows_hint=rows_h, bytes_hint=bytes_h)
+    out = TensorFrame(out_schema,
+                      _stream_thunk(df, ex, run_block, submit_block,
+                                    _drain_with(attach_outputs)),
+                      df.num_partitions,
+                      plan=f"map_rows({df._plan})",
+                      rows_hint=rows_h, bytes_hint=bytes_h)
+    if executor is None:
+        from ..plan.nodes import MapRowsNode, attach, node_for
+        attach(out, MapRowsNode(node_for(df), out_schema, comp, vcomp))
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -594,12 +617,16 @@ def filter_rows(predicate: Fetches, df: TensorFrame,
 
     # the hint is an UPPER bound: a filter keeps at most its input
     rows_h, bytes_h = _memory.propagate_hints(df, df.schema)
-    return TensorFrame(df.schema,
-                       _stream_thunk(df, ex, run_block, submit_block,
-                                     _drain_with(apply_mask)),
-                       df.num_partitions,
-                       plan=f"filter_rows({df._plan})",
-                       rows_hint=rows_h, bytes_hint=bytes_h)
+    out = TensorFrame(df.schema,
+                      _stream_thunk(df, ex, run_block, submit_block,
+                                    _drain_with(apply_mask)),
+                      df.num_partitions,
+                      plan=f"filter_rows({df._plan})",
+                      rows_hint=rows_h, bytes_hint=bytes_h)
+    if executor is None:
+        from ..plan.nodes import FilterNode, attach, node_for
+        attach(out, FilterNode(node_for(df), df.schema, comp))
+    return out
 
 
 # ---------------------------------------------------------------------------

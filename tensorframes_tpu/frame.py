@@ -168,12 +168,18 @@ def _infer_schema_from_rows(rows: Sequence[Sequence],
     return Schema(fields)
 
 
-def _blocks_hints(blocks: Sequence[Block]) -> Dict[str, int]:
+def _blocks_hints(blocks: Sequence[Block]) -> Dict[str, object]:
     """Exact size hints for a source frame whose blocks already exist
-    (``from_rows``/``from_columns``/``from_blocks`` build them eagerly)."""
-    from .memory.estimate import blocks_estimate
+    (``from_rows``/``from_columns``/``from_blocks`` build them eagerly).
+    Includes the per-column split the plan cost model seeds from."""
+    from .memory.estimate import blocks_estimate, column_nbytes
     rows, nbytes = blocks_estimate(blocks)
-    return {"rows_hint": rows, "bytes_hint": nbytes}
+    col_bytes: Dict[str, int] = {}
+    for b in blocks:
+        for name, col in b.columns.items():
+            col_bytes[name] = col_bytes.get(name, 0) + column_nbytes(col)
+    return {"rows_hint": rows, "bytes_hint": nbytes,
+            "col_bytes_hint": col_bytes}
 
 
 def _split_even(n: int, parts: int) -> List[Tuple[int, int]]:
@@ -201,7 +207,8 @@ class TensorFrame:
                  num_partitions: int,
                  plan: str = "source",
                  rows_hint: Optional[int] = None,
-                 bytes_hint: Optional[int] = None):
+                 bytes_hint: Optional[int] = None,
+                 col_bytes_hint: Optional[Dict[str, int]] = None):
         self._schema = schema
         self._thunk = thunk
         self._cache: Optional[List[Block]] = None
@@ -215,6 +222,15 @@ class TensorFrame:
         # a serve-admission estimate; None means unknown
         self._rows_hint = rows_hint
         self._bytes_hint = bytes_hint
+        # per-column bytes at source constructors: the logical plan's
+        # per-column cost model seeds from these (docs/plan.md)
+        self._col_bytes_hint = col_bytes_hint
+        # logical-plan IR (docs/plan.md): lazy ops record a PlanNode
+        # here; forcing offers it to the optimizer first, falling back
+        # to the per-op thunk above. _plan_info carries the optimized
+        # plan's rendering for explain() after a fused forcing.
+        self._plan_node = None
+        self._plan_info = None
 
     # -- construction ------------------------------------------------------
     @staticmethod
@@ -278,7 +294,18 @@ class TensorFrame:
             # the ambient trace and yields None here)
             with _obs.query_trace(self._plan.split("(", 1)[0],
                                   plan=self._plan) as t:
-                self._cache = self._thunk()
+                blocks = None
+                if self._plan_node is not None:
+                    # logical-plan path (docs/plan.md): fuse row-local
+                    # op chains into one dispatch per block, prune
+                    # columns, chain stages device-resident. Returns
+                    # None (fusion off / unplannable chain) to defer to
+                    # the per-op thunk — TFT_FUSE=0 is bit-identical to
+                    # the pre-plan engine by construction.
+                    from .plan import maybe_run as _plan_maybe_run
+                    blocks = _plan_maybe_run(self)
+                self._cache = blocks if blocks is not None \
+                    else self._thunk()
             if t is not None:
                 self._trace = t
             # under an active device budget the forced block cache joins
@@ -339,13 +366,17 @@ class TensorFrame:
 
     # -- transformations ---------------------------------------------------
     def select(self, names: Sequence[str]) -> "TensorFrame":
+        names = list(names)
         schema = self._schema.select(names)
         from .memory.estimate import propagate_hints
         rows_h, bytes_h = propagate_hints(self, schema)
-        return TensorFrame(
+        out = TensorFrame(
             schema, lambda: [b.select(names) for b in self.blocks()],
             self._num_partitions, plan=f"select({self._plan})",
             rows_hint=rows_h, bytes_hint=bytes_h)
+        from .plan.nodes import SelectNode, attach, node_for
+        attach(out, SelectNode(node_for(self), schema, names))
+        return out
 
     def with_schema(self, schema: Schema) -> "TensorFrame":
         """Same data, refined metadata (used by ``analyze``)."""

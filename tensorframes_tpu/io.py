@@ -103,12 +103,48 @@ def _column_to_numpy(col, name: str):
     return col.to_numpy(zero_copy_only=False)
 
 
+def _arrow_field_to_field(af):
+    """Footer type -> schema Field matching what the EAGER decode would
+    infer from materialized data, or ``None`` for types the lazy scan
+    does not cover (variable-length lists, dates, decimals...)."""
+    import pyarrow as pa
+
+    from . import dtypes as _dt
+    from .schema import Field
+    from .shape import Shape, Unknown
+
+    t = af.type
+    if pa.types.is_string(t) or pa.types.is_large_string(t):
+        return Field(af.name, _dt.string, sql_rank=0)
+    if pa.types.is_fixed_size_list(t):
+        try:
+            dt = _dt.from_numpy(np.dtype(t.value_type.to_pandas_dtype()))
+        except Exception:
+            return None
+        if not dt.tensor:
+            return None
+        return Field(af.name, dt,
+                     block_shape=Shape(Unknown, t.list_size), sql_rank=1)
+    if pa.types.is_floating(t) or pa.types.is_integer(t) \
+            or pa.types.is_boolean(t):
+        try:
+            dt = _dt.from_numpy(np.dtype(t.to_pandas_dtype()))
+        except Exception:
+            return None
+        return Field(af.name, dt, block_shape=Shape(Unknown), sql_rank=0)
+    return None
+
+
 def read_parquet(path: str, columns: Optional[Sequence[str]] = None,
                  num_partitions: Optional[int] = None,
                  pad_ragged=False,
                  row_group_offset: int = 0,
                  row_group_limit: Optional[int] = None) -> TensorFrame:
     """Read a parquet file into a TensorFrame, row groups → partitions.
+
+    ``columns=`` projects at READ time: only the named columns' chunks
+    are decoded (footer-driven — unrequested columns' bytes are never
+    touched), composing with ``row_group_offset``/``row_group_limit``.
 
     ``num_partitions=None`` keeps the file's row-group structure (the
     natural block layout); an explicit value re-blocks after load.
@@ -127,6 +163,15 @@ def read_parquet(path: str, columns: Optional[Sequence[str]] = None,
     appended, and a limit of 1 pinpoints an unreadable group. An offset
     at/past the end returns an EMPTY frame whose columns are still
     typed from the parquet schema.
+
+    Files of scalar / fixed-size-list / string columns load LAZILY: only
+    the footer is read here; data reads happen at forcing, which lets
+    the logical plan (``docs/plan.md``) push column pruning into the
+    read — a chain that references two of six columns touches two
+    columns' bytes. The row-group range is pinned at footer time, so a
+    concurrently-appended file never changes what a frame reads.
+    ``pad_ragged`` or any other column type falls back to the eager
+    read, byte-for-byte today's behavior.
     """
     import pyarrow as pa
     import pyarrow.parquet as pq
@@ -142,7 +187,115 @@ def read_parquet(path: str, columns: Optional[Sequence[str]] = None,
     if row_group_limit is not None and row_group_limit < 1:
         raise ValueError(
             f"row_group_limit must be >= 1, got {row_group_limit}")
+
     with pq.ParquetFile(path) as pf:
+        file_names = list(pf.schema_arrow.names)
+        names = list(columns) if columns is not None else file_names
+        missing = [n for n in names if n not in file_names]
+        if missing:
+            raise ValueError(
+                f"read_parquet: column(s) {missing} not in {path!r}; "
+                f"file columns: {file_names}")
+        lazy = None
+        if not pad_ragged:
+            lazy = _lazy_parquet_frame(pf, path, names, num_partitions,
+                                       row_group_offset, row_group_limit)
+        if lazy is not None:
+            return lazy
+        # eager fallback reuses the already-open footer (one parse
+        # per call, not two)
+        return _read_parquet_eager(path, names, num_partitions,
+                                   pad_ragged, row_group_offset,
+                                   row_group_limit, pf=pf)
+
+
+def _lazy_parquet_frame(pf, path, names, num_partitions,
+                        row_group_offset, row_group_limit):
+    """A lazy scan frame from the footer alone, or ``None`` when the
+    file needs the eager decode (unsupported types, nothing to read)."""
+    import weakref
+
+    from .frame import _split_even
+    from .plan.nodes import ParquetScanNode, attach
+    from .schema import Schema
+
+    fields = []
+    for n in names:
+        f = _arrow_field_to_field(pf.schema_arrow.field(n))
+        if f is None:
+            return None
+        fields.append(f)
+    if not fields:
+        return None
+    md = pf.metadata
+    end_group = md.num_row_groups
+    if row_group_limit is not None:
+        end_group = min(end_group, row_group_offset + row_group_limit)
+    n_groups = end_group - row_group_offset
+    if n_groups < 1:
+        return None  # empty range: the eager typed-empty frame is cheap
+    # null policy: the eager decode materializes int/bool-with-nulls as
+    # float64 NaN / object (pyarrow to_numpy), so a footer-typed schema
+    # would silently disagree with the data. Floating scalars are safe
+    # (null -> NaN, dtype unchanged); every other column must PROVE
+    # zero nulls via chunk statistics, else the eager path decides.
+    import pyarrow as pa
+    lax_nulls = {n for n in names
+                 if pa.types.is_floating(pf.schema_arrow.field(n).type)}
+    rows = 0
+    col_bytes = {n: 0 for n in names}
+    want = set(names)
+    for g in range(row_group_offset, end_group):
+        rg = md.row_group(g)
+        rows += rg.num_rows
+        for j in range(rg.num_columns):
+            c = rg.column(j)
+            base = c.path_in_schema.split(".", 1)[0]
+            if base not in want:
+                continue
+            col_bytes[base] += int(c.total_uncompressed_size)
+            if base not in lax_nulls:
+                stats = c.statistics
+                if stats is None or stats.null_count is None \
+                        or stats.null_count > 0:
+                    return None
+    if num_partitions is None:
+        parts = n_groups
+    else:
+        parts = len(_split_even(rows, num_partitions))
+
+    def thunk():
+        return _read_parquet_eager(path, names, num_partitions, False,
+                                   row_group_offset, n_groups).blocks()
+
+    import os as _os
+    frame = TensorFrame(
+        Schema(fields), thunk, parts,
+        plan=f"parquet({_os.path.basename(path)})",
+        rows_hint=rows, bytes_hint=sum(col_bytes.values()),
+        col_bytes_hint=col_bytes)
+    node = ParquetScanNode(path, names, row_group_offset, n_groups,
+                           num_partitions, frame.schema, rows, col_bytes)
+    node.frame_ref = weakref.ref(frame)
+    attach(frame, node)
+    return frame
+
+
+def _read_parquet_eager(path: str, columns: Optional[Sequence[str]],
+                        num_partitions: Optional[int], pad_ragged,
+                        row_group_offset: int,
+                        row_group_limit: Optional[int],
+                        pf=None) -> TensorFrame:
+    """The materializing read (the pre-plan ``read_parquet`` body): row
+    groups decode NOW, the returned frame's blocks already exist.
+    ``pf`` reuses a caller's already-open ``ParquetFile`` (one footer
+    parse per ``read_parquet`` call)."""
+    import contextlib
+
+    import pyarrow.parquet as pq
+
+    with (pq.ParquetFile(path) if pf is None
+          else contextlib.nullcontext(pf)) as pf:
         names = list(columns) if columns is not None else [
             c for c in pf.schema_arrow.names]
         blocks: List[dict] = []

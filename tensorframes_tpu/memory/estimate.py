@@ -18,22 +18,28 @@ from typing import Any, Optional, Sequence, Tuple
 
 import numpy as np
 
-__all__ = ["array_nbytes", "block_nbytes", "blocks_estimate",
-           "schema_row_bytes", "frame_estimate", "propagate_hints"]
+__all__ = ["array_nbytes", "column_nbytes", "block_nbytes",
+           "blocks_estimate", "schema_row_bytes", "frame_estimate",
+           "propagate_hints"]
 
 from .spill import array_nbytes
 
 
-def block_nbytes(block) -> int:
-    """Host bytes of one block (ragged list columns sum their cells)."""
+def column_nbytes(col) -> int:
+    """Host bytes of one column (ragged list columns sum their cells).
+    The single definition the plan cost model and block accounting
+    share."""
+    if isinstance(col, np.ndarray):
+        return int(col.nbytes)
     total = 0
-    for col in block.columns.values():
-        if isinstance(col, np.ndarray):
-            total += int(col.nbytes)
-        else:  # ragged / list-backed: per-cell arrays (or strings)
-            for cell in col:
-                total += array_nbytes(cell) or 8
+    for cell in col:  # ragged / list-backed: per-cell arrays (or strings)
+        total += array_nbytes(cell) or 8
     return total
+
+
+def block_nbytes(block) -> int:
+    """Host bytes of one block."""
+    return sum(column_nbytes(col) for col in block.columns.values())
 
 
 def blocks_estimate(blocks: Sequence) -> Tuple[int, int]:
@@ -67,13 +73,29 @@ def schema_row_bytes(schema) -> int:
 
 def frame_estimate(frame) -> Tuple[Optional[float], Optional[int]]:
     """Best-effort ``(rows, bytes)`` of a frame: exact when already
-    forced (cached blocks), the construction-time plan hint otherwise,
-    ``(None, None)`` when neither exists — admission and quotas only
-    enforce what they can measure."""
+    forced (cached blocks); for UNFORCED frames with a logical-plan
+    node, the plan's per-column cost model (measured leaf bytes
+    propagated column-by-column through the chain — ``docs/plan.md``);
+    else the construction-time scalar hint; ``(None, None)`` when
+    nothing exists — admission and quotas only enforce what they can
+    measure."""
     blocks = getattr(frame, "_cache", None)
     if blocks:
         rows, nbytes = blocks_estimate(blocks)
         return float(rows), nbytes
+    node = getattr(frame, "_plan_node", None)
+    if node is not None:
+        try:
+            rows, col_bytes = node.estimate()
+        except Exception as e:
+            from ..utils.logging import get_logger
+            get_logger("memory.estimate").debug(
+                "plan-node estimate failed (%s); falling back to the "
+                "scalar hints", e)
+            rows, col_bytes = None, None
+        if col_bytes is not None:
+            return (float(rows) if rows is not None else None,
+                    int(sum(col_bytes.values())))
     rows = getattr(frame, "_rows_hint", None)
     nbytes = getattr(frame, "_bytes_hint", None)
     return (float(rows) if rows is not None else None,

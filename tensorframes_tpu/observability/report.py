@@ -161,6 +161,16 @@ def frame_report(df) -> str:
     for observability, so it pays for one traced execution rather than
     returning nothing.
     """
+    def with_plan(report: str) -> str:
+        # the optimized logical plan of the forcing (docs/plan.md):
+        # fused groups, pruned columns, resident edges — recorded by
+        # plan.execute when the fused path ran; absent under TFT_FUSE=0
+        # or when the chain fell back to the per-op path
+        info = getattr(df, "_plan_info", None)
+        if info:
+            return report + "\n" + "\n".join(info)
+        return report
+
     t = getattr(df, "_trace", None)
     if t is None:
         if _events.current_trace() is not None:
@@ -184,9 +194,10 @@ def frame_report(df) -> str:
                 tracing.disable()
         t = getattr(df, "_trace", None)
     if t is None:
-        return ("(no query trace recorded — the frame was forced inside "
-                "another query or tracing stayed off)")
-    return render(t)
+        return with_plan(
+            "(no query trace recorded — the frame was forced inside "
+            "another query or tracing stayed off)")
+    return with_plan(render(t))
 
 
 def last_query_report() -> str:

@@ -1,0 +1,47 @@
+"""Lazy logical-plan IR between the frame surface and the engine.
+
+The per-op engine path dispatches every ``map_blocks`` / ``map_rows`` /
+``filter_rows`` / ``select`` in a chain as its own engine dispatch with
+its own host↔device round trip — the measured gap between end-to-end
+execution with marshalling and device-resident execution is exactly that
+per-op tax (ROADMAP item 1). This package closes it without touching the
+per-op path's semantics:
+
+- every lazy frame op *additionally* records a :class:`~.nodes.PlanNode`
+  on its result frame (the thunk chain stays exactly as it was);
+- forcing (``blocks()`` — and therefore ``collect``/``count``/reductions/
+  ``submit()``) first offers the chain to the optimizer
+  (:func:`~.execute.maybe_run`): adjacent row-local ops fuse into ONE
+  composed :class:`~..computation.Computation` dispatched once per block
+  through the existing resilient executor (so retries, OOM splits, fault
+  injection, memory admission, and the serve layer's shared compile
+  cache all apply to the fused program unchanged); column pruning walks
+  the plan and pushes the referenced-column set down into
+  ``io.read_parquet(columns=)``; intermediates between non-fusible stage
+  boundaries stay device-resident (``keep_device`` dispatches chained
+  buffer-to-buffer) instead of round-tripping through host rows;
+- any chain the optimizer cannot *prove* equivalent (non-row-preserving
+  computations, ragged inputs, foreign/static computations, explicit
+  ``executor=`` overrides, a non-default process executor) falls back to
+  the unchanged per-op thunk — which is also the whole path when
+  ``TFT_FUSE=0``, making the kill switch bit-identical by construction;
+- plan nodes carry per-column row/byte estimates
+  (:meth:`~.nodes.PlanNode.estimate`) that replace the whole-schema-ratio
+  heuristics for UNFORCED frames (``memory.estimate.frame_estimate`` —
+  what serve admission, quotas, and proactive splits consume).
+
+See ``docs/plan.md``.
+"""
+
+from __future__ import annotations
+
+from .nodes import (FilterNode, MapBlocksNode, MapRowsNode, ParquetScanNode,
+                    PlanNode, SelectNode, SourceNode, attach, node_for)
+from .optimize import enabled
+from .execute import maybe_run
+
+__all__ = [
+    "PlanNode", "SourceNode", "ParquetScanNode", "MapBlocksNode",
+    "MapRowsNode", "FilterNode", "SelectNode", "attach", "node_for",
+    "enabled", "maybe_run",
+]

@@ -1,0 +1,270 @@
+"""Plan-node IR: one node per lazy frame op, plus the two leaf kinds.
+
+A node records WHAT an op computes (its canonical
+:class:`~..computation.Computation`, its projection, its output schema)
+— never HOW it will run; the optimizer (:mod:`.optimize`) decides that
+at forcing time. Nodes are built alongside the existing lazy thunks
+(:func:`attach` is called by ``engine.ops`` and ``TensorFrame.select``),
+so a frame always has its per-op path available as the fallback.
+
+Estimates: every node answers :meth:`PlanNode.estimate` with
+``(rows, {column: total_bytes})`` — per-COLUMN byte accounting threaded
+from measured leaf sizes (exact block bytes for in-memory sources,
+footer column-chunk sizes for parquet scans), so projections and fetch
+columns are priced individually instead of by the whole-schema row-byte
+ratio. ``memory.estimate.frame_estimate`` consults this for unforced
+frames; serve admission and quotas read it from there.
+"""
+
+from __future__ import annotations
+
+import weakref
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..computation import Computation, TensorSpec
+from ..schema import Schema
+from ..utils.logging import get_logger
+
+__all__ = ["PlanNode", "SourceNode", "ParquetScanNode", "MapBlocksNode",
+           "MapRowsNode", "FilterNode", "SelectNode", "attach", "node_for"]
+
+_log = get_logger("plan.nodes")
+
+# (rows, per-column total bytes) — either half may be None when unknown
+Estimate = Tuple[Optional[float], Optional[Dict[str, int]]]
+
+OP_KINDS = ("map_blocks", "map_rows", "filter", "select")
+
+
+def _col_nbytes(col) -> int:
+    """Host bytes of one column — delegates to the shared definition so
+    plan estimates and block accounting can never drift."""
+    from ..memory.estimate import column_nbytes
+    return column_nbytes(col)
+
+
+def _cell_bytes(dtype, dims: Sequence) -> int:
+    """Bytes per row of a cell shape (Unknown dims floor at 1, the same
+    deliberate floor ``schema_row_bytes`` uses)."""
+    cells = 1
+    for d in dims:
+        if isinstance(d, int) and d > 0:
+            cells *= d
+    return cells * int(np.dtype(dtype.np_storage).itemsize)
+
+
+def _field_row_bytes(field) -> int:
+    if not field.dtype.tensor:
+        return 8  # strings count a pointer, like schema_row_bytes
+    cell = field.cell_shape
+    return _cell_bytes(field.dtype, cell.dims if cell is not None else ())
+
+
+class PlanNode:
+    """Base: an op node with one input, or a leaf with ``input=None``."""
+
+    kind = "node"
+
+    def __init__(self, input: Optional["PlanNode"], schema: Schema):
+        self.input = input
+        self.schema = schema
+        # weakref to the frame this node produced (set by attach):
+        # linearization stops at an upstream frame whose block cache is
+        # already materialized — re-deriving it would waste work the
+        # per-op path gets for free
+        self.result_ref: Optional[weakref.ref] = None
+
+    def describe(self) -> str:
+        return self.kind
+
+    def estimate(self) -> Estimate:
+        """Cached: computed once per node, like the construction-time
+        scalar hints it replaces (chain building stays O(n), not
+        O(n^2) walks). Callers get a copy of the column dict."""
+        cached = getattr(self, "_est_cache", None)
+        if cached is None:
+            cached = self._est_cache = self._estimate()
+        rows, cols = cached
+        return rows, (dict(cols) if cols is not None else None)
+
+    def _estimate(self) -> Estimate:
+        return None, None
+
+
+class SourceNode(PlanNode):
+    """Leaf over any frame without a plan of its own (eager constructors,
+    ``order_by``/``repartition``/``limit`` results, cached upstreams)."""
+
+    kind = "source"
+
+    def __init__(self, frame):
+        super().__init__(None, frame.schema)
+        self.frame = frame
+
+    def describe(self) -> str:
+        return f"source[{self.frame._plan}]"
+
+    def _estimate(self) -> Estimate:
+        blocks = getattr(self.frame, "_cache", None)
+        if blocks:
+            rows = 0
+            col_bytes: Dict[str, int] = {f.name: 0 for f in self.schema}
+            for b in blocks:
+                rows += int(b.num_rows)
+                for name, col in b.columns.items():
+                    if name in col_bytes:
+                        col_bytes[name] += _col_nbytes(col)
+            return float(rows), col_bytes
+        rows = getattr(self.frame, "_rows_hint", None)
+        rows_f = float(rows) if rows is not None else None
+        cb = getattr(self.frame, "_col_bytes_hint", None)
+        if cb is not None:
+            return rows_f, dict(cb)
+        total = getattr(self.frame, "_bytes_hint", None)
+        if total is None:
+            return rows_f, None
+        # only a whole-frame hint exists: distribute it over the declared
+        # per-row column widths so downstream projections still prune
+        widths = {f.name: _field_row_bytes(f) for f in self.schema}
+        denom = sum(widths.values()) or 1
+        return rows_f, {n: int(total * w / denom)
+                        for n, w in widths.items()}
+
+
+class ParquetScanNode(PlanNode):
+    """Leaf over a lazily-read parquet range: the pruning target.
+
+    ``columns`` is the full requested projection (file order);
+    :meth:`read_blocks` reads any subset of it at force time — one
+    footer read decided everything else (rows, per-column bytes,
+    partition count) at construction.
+    """
+
+    kind = "parquet"
+
+    def __init__(self, path: str, columns: Sequence[str],
+                 row_group_offset: int, row_group_limit: int,
+                 num_partitions: Optional[int], schema: Schema,
+                 rows: int, col_bytes: Dict[str, int]):
+        super().__init__(None, schema)
+        self.path = path
+        self.columns = tuple(columns)
+        self.row_group_offset = int(row_group_offset)
+        # pinned at footer time: a tailed file growing between build and
+        # force must not change what this frame reads
+        self.row_group_limit = int(row_group_limit)
+        self.num_partitions = num_partitions
+        self.rows = int(rows)
+        self.col_bytes = dict(col_bytes)
+        self.frame_ref: Optional[weakref.ref] = None
+
+    def describe(self) -> str:
+        import os
+        return f"parquet[{os.path.basename(self.path)}]"
+
+    def _estimate(self) -> Estimate:
+        return float(self.rows), dict(self.col_bytes)
+
+    def read_blocks(self, names: Sequence[str]) -> List:
+        """Blocks holding (at least) ``names`` — the already-forced frame
+        cache when it exists, a pruned read otherwise."""
+        frame = self.frame_ref() if self.frame_ref is not None else None
+        if frame is not None and getattr(frame, "_cache", None):
+            return frame._cache
+        from ..io import _read_parquet_eager
+        want = [n for n in self.columns if n in set(names)]
+        return _read_parquet_eager(
+            self.path, columns=want, num_partitions=self.num_partitions,
+            pad_ragged=False, row_group_offset=self.row_group_offset,
+            row_group_limit=self.row_group_limit).blocks()
+
+
+class MapBlocksNode(PlanNode):
+    kind = "map_blocks"
+
+    def __init__(self, input: PlanNode, schema: Schema, comp: Computation,
+                 trim: bool):
+        super().__init__(input, schema)
+        self.comp = comp
+        self.trim = bool(trim)
+
+    def describe(self) -> str:
+        return "map_blocks[trim]" if self.trim else "map_blocks"
+
+    def _estimate(self) -> Estimate:
+        rows, cols = self.input.estimate()
+        if self.trim:
+            # the computation owns the row count; nothing is knowable
+            return None, None
+        if rows is None or cols is None:
+            return rows, None
+        out = dict(cols)
+        for s in self.comp.outputs:
+            out[s.name] = int(rows * _cell_bytes(s.dtype, s.shape.dims[1:]))
+        return rows, out
+
+
+class MapRowsNode(PlanNode):
+    kind = "map_rows"
+
+    def __init__(self, input: PlanNode, schema: Schema, comp: Computation,
+                 vcomp: Optional[Computation]):
+        super().__init__(input, schema)
+        self.comp = comp    # row-level user computation
+        self.vcomp = vcomp  # its cached vmapped (block-level) twin
+
+    def _estimate(self) -> Estimate:
+        rows, cols = self.input.estimate()
+        if rows is None or cols is None:
+            return rows, None
+        out = dict(cols)
+        for s in self.comp.outputs:  # row-level: dims ARE the cell dims
+            out[s.name] = int(rows * _cell_bytes(s.dtype, s.shape.dims))
+        return rows, out
+
+
+class FilterNode(PlanNode):
+    kind = "filter"
+
+    def __init__(self, input: PlanNode, schema: Schema, comp: Computation):
+        super().__init__(input, schema)
+        self.comp = comp
+
+    def _estimate(self) -> Estimate:
+        # an upper bound, like the per-op hint: a filter keeps at most
+        # its input
+        return self.input.estimate()
+
+
+class SelectNode(PlanNode):
+    kind = "select"
+
+    def __init__(self, input: PlanNode, schema: Schema,
+                 names: Sequence[str]):
+        super().__init__(input, schema)
+        self.names = tuple(names)
+
+    def describe(self) -> str:
+        return f"select{list(self.names)}"
+
+    def _estimate(self) -> Estimate:
+        rows, cols = self.input.estimate()
+        if cols is None:
+            return rows, None
+        return rows, {n: cols[n] for n in self.names if n in cols}
+
+
+def node_for(frame) -> PlanNode:
+    """The plan node producing ``frame``: its recorded op node, or a
+    fresh :class:`SourceNode` leaf when it has none."""
+    node = getattr(frame, "_plan_node", None)
+    return node if node is not None else SourceNode(frame)
+
+
+def attach(frame, node: PlanNode) -> None:
+    """Record ``node`` as the plan of ``frame`` (called by the lazy ops
+    right after they build the result frame)."""
+    node.result_ref = weakref.ref(frame)
+    frame._plan_node = node

@@ -110,9 +110,17 @@ class _WState:
     (the transparent fault-back) — but costing zero device bytes, which
     is what lets ``max_state_rows`` bound DEVICE state without
     force-emitting incomplete windows (``docs/memory.md``).
+
+    A state is also a duck-typed entry in the global memory manager's
+    LRU (the ``mem_*`` protocol, ``memory/manager.py``): under an active
+    budget the LEDGER drives spills too — an admission squeeze anywhere
+    in the process can push the coldest window to host, not just the
+    stream's own ``max_state_rows`` cap. Registered at commit (weakly
+    held: an emitted window's entry dies with the object).
     """
 
-    __slots__ = ("keys_u", "values", "rows", "spilled")
+    __slots__ = ("keys_u", "values", "rows", "spilled", "on_spill",
+                 "on_fault", "_spill_lock", "__weakref__")
 
     def __init__(self, keys_u: List[np.ndarray], values: Dict[str, object],
                  rows: int):
@@ -120,19 +128,88 @@ class _WState:
         self.values = values        # fetch -> device array [rows, ...]
         self.rows = rows
         self.spilled = False
+        # per-stream spill/fault accounting hooks (set when
+        # ledger-registered)
+        self.on_spill = None
+        self.on_fault = None
+        # the ledger LRU (its own lock) and the max_state_rows eviction
+        # path (the aggregation's state lock) can both pick this state;
+        # a per-state lock + re-check keeps one spill from counting (or
+        # copying) twice
+        self._spill_lock = threading.Lock()
 
     def spill(self) -> int:
         """Move the device value tables to pinned host buffers; returns
-        the device bytes freed. Bit-identical round trip (the host view
-        keeps the device dtype, bfloat16 included)."""
+        the device bytes freed (0 when a concurrent spill won the
+        race). Bit-identical round trip (the host view keeps the device
+        dtype, bfloat16 included)."""
         from .. import memory as _memory
-        freed = 0
-        for f, v in list(self.values.items()):
-            if _memory.is_device_value(v):
-                freed += _memory.array_nbytes(v)
-                self.values[f] = _memory.to_pinned_host(v)
-        self.spilled = True
+        with self._spill_lock:
+            if self.spilled:
+                return 0
+            freed = 0
+            for f, v in list(self.values.items()):
+                if _memory.is_device_value(v):
+                    freed += _memory.array_nbytes(v)
+                    self.values[f] = _memory.to_pinned_host(v)
+            self.spilled = True
         return freed
+
+    # -- memory-ledger entry protocol (docs/memory.md) ---------------------
+    def mem_name(self) -> str:
+        return "stream-window"
+
+    def mem_is_spilled(self) -> bool:
+        return self.spilled
+
+    def mem_device_bytes(self) -> int:
+        if self.spilled:
+            return 0
+        from .. import memory as _memory
+        return sum(_memory.array_nbytes(v) for v in self.values.values()
+                   if _memory.is_device_value(v))
+
+    def mem_host_bytes(self) -> int:
+        if not self.spilled:
+            return 0
+        return sum(int(v.nbytes) for v in self.values.values()
+                   if isinstance(v, np.ndarray))
+
+    def mem_spill(self) -> int:
+        """Ledger-driven spill (called under the ledger lock). The
+        counters the manual ``max_state_rows`` path increments by hand
+        come from the ``on_spill`` hook + the ledger's own accounting.
+        ``spill()`` is race-guarded: a loser returns 0 and counts
+        nothing."""
+        freed = self.spill()
+        if freed:
+            cb = self.on_spill
+            if cb is not None:
+                cb(freed)
+        return freed
+
+    def mem_fault(self) -> int:
+        """Restore the value tables to the device. The fold path faults
+        lazily on its own (the merge programs accept host arrays), so
+        this only runs when the ledger explicitly touches the entry.
+        Same race guard as :meth:`spill` — a fault interleaving with a
+        concurrent spill must not leave device arrays behind a
+        ``spilled=True`` flag (the ledger would under-count them)."""
+        import jax
+        with self._spill_lock:
+            if not self.spilled:
+                return 0
+            restored = 0
+            for f, v in list(self.values.items()):
+                if isinstance(v, np.ndarray):
+                    self.values[f] = jax.device_put(v)
+                    restored += int(v.nbytes)
+            self.spilled = False
+        if restored:
+            cb = self.on_fault  # symmetric with the spill-side hook
+            if cb is not None:
+                cb(restored)
+        return restored
 
     @property
     def nbytes(self) -> int:
@@ -356,6 +433,7 @@ class StreamingAggregation:
                                         key_arrays, val_arrays)
             with self._state_lock:
                 self._windows[None] = state
+            self._register_state(state)
             return [self._update_frame(touched)]
         ts = np.asarray(merged.dense(self.time_col), np.float64)
         if ts.ndim != 1:
@@ -390,6 +468,8 @@ class StreamingAggregation:
         # folded cleanly
         with self._state_lock:
             self._windows.update(pending)
+        for st in pending.values():
+            self._register_state(st)
         self._max_ts = new_max
         if late:
             self.late_rows += late
@@ -417,6 +497,29 @@ class StreamingAggregation:
         return self._drain_backlog()
 
     # -- internals ---------------------------------------------------------
+    def _register_state(self, state: _WState) -> None:
+        """Join the global memory LRU (PR 8 follow-on): the ledger —
+        not just ``max_state_rows`` — drives this window's spills once
+        a device budget is active. Registered OUTSIDE ``_state_lock``
+        (the ledger takes its own lock and may spill immediately)."""
+        from .. import memory as _memory
+        mgr = _memory.active()
+        if mgr is not None and mgr.spill_enabled:
+            state.on_spill = self._note_ledger_spill
+            state.on_fault = self._note_ledger_fault
+            mgr.register(state)
+
+    def _note_ledger_spill(self, freed: int) -> None:
+        self.state_spills += 1
+        counters.inc("stream.state_spills")
+        _log.debug("memory ledger spilled a stream window (%d B) to "
+                   "host; it stays live and faults back on its next "
+                   "touch", freed)
+
+    def _note_ledger_fault(self, restored: int) -> None:
+        self.state_faults += 1
+        counters.inc("stream.state_faults")
+
     def _fold(self, base: Optional[_WState],
               key_arrays: List[np.ndarray],
               val_arrays: Dict[str, np.ndarray]
@@ -521,14 +624,15 @@ class StreamingAggregation:
                 if spill_ok:
                     freed = state.spill()
             if spill_ok:
-                self.state_spills += 1
-                counters.inc("stream.state_spills")
-                mgr.note_spill(freed, name=f"stream-window@{oldest}")
-                _log.debug(
-                    "stream state over max_state_rows=%d; spilled "
-                    "window %s (%d rows, %d B) to host — it stays live "
-                    "and faults back on the next touch",
-                    self.max_state_rows, oldest, rows, freed)
+                if freed:  # a concurrent ledger spill may have won
+                    self.state_spills += 1
+                    counters.inc("stream.state_spills")
+                    mgr.note_spill(freed, name=f"stream-window@{oldest}")
+                    _log.debug(
+                        "stream state over max_state_rows=%d; spilled "
+                        "window %s (%d rows, %d B) to host — it stays "
+                        "live and faults back on the next touch",
+                        self.max_state_rows, oldest, rows, freed)
                 continue
             self.state_evictions += 1
             counters.inc("stream.state_evictions")

@@ -311,9 +311,16 @@ class ParquetTailSource(BlockSource):
         read_n = (total - self._consumed if self._fail_streak == 0
                   else 1)
         try:
-            frame = _io.read_parquet(self._path, columns=self._columns,
-                                     row_group_offset=self._consumed,
-                                     row_group_limit=read_n)
+            # the EAGER reader, deliberately: public read_parquet is
+            # footer-lazy (docs/plan.md), which would (a) pay a second
+            # footer read at blocks() — this source's contract is ONE
+            # footer read per poll — and (b) move decode errors outside
+            # this guard, livelocking the corrupt-group skip machinery
+            frame = _io._read_parquet_eager(
+                self._path, columns=self._columns, num_partitions=None,
+                pad_ragged=False, row_group_offset=self._consumed,
+                row_group_limit=read_n)
+            blocks = frame.blocks()
         except Exception:
             # mid-replace windows heal on the next poll; a PERSISTENTLY
             # unreadable group (corrupt append) must not livelock the
@@ -341,7 +348,7 @@ class ParquetTailSource(BlockSource):
         self._fail_streak = 0
         # one block per row group; a finite (follow=False) source whose
         # file grew mid-replay keeps only the groups inside its end mark
-        self._buffer.extend(frame.blocks()[: total - self._consumed])
+        self._buffer.extend(blocks[: total - self._consumed])
         self._consumed = min(total, self._consumed + read_n)
         if self._buffer:
             return check_block(self._schema, self._buffer.popleft())

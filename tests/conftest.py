@@ -81,6 +81,12 @@ def pytest_configure(config):
                    "larger-than-budget queries (run-tests.sh --memory "
                    "runs this lane standalone)")
     config.addinivalue_line(
+        "markers", "plan: logical-plan IR suite — operator fusion "
+                   "bit-identity vs TFT_FUSE=0, column pruning, "
+                   "device-resident stage chaining, plan-derived "
+                   "estimates (run-tests.sh --plan runs this lane "
+                   "standalone)")
+    config.addinivalue_line(
         "markers", "timing: wall-clock-sensitive deadline assertions — "
                    "margins are widened for loaded machines "
                    "(TFT_TIMING_MARGIN multiplies the bounds; "

@@ -32,6 +32,95 @@ def test_parquet_round_trip_scalar_vector_string(tmp_path):
         np.testing.assert_allclose(np.asarray(ra["v"]), np.asarray(rb["v"]))
 
 
+class TestParquetColumnProjection:
+    """``read_parquet(columns=)`` selects columns at READ time
+    (footer-driven): unrequested columns are never materialized, and
+    the projection composes with ``row_group_offset``/``row_group_limit``
+    (the logical plan's pruning pushes through this — docs/plan.md)."""
+
+    @pytest.fixture
+    def wide_file(self, tmp_path):
+        p = str(tmp_path / "wide.parquet")
+        n = 60
+        cols = {"a": np.arange(float(n)),
+                "b": np.arange(n).astype(np.int64),
+                "c": np.ones((n, 2)),
+                "d": np.asarray([f"s{i}" for i in range(n)], object)}
+        tio.write_parquet(tft.frame(cols, num_partitions=3), p)
+        return p, cols, n
+
+    def test_projection_reads_only_requested(self, wide_file, monkeypatch):
+        p, cols, n = wide_file
+        decoded = []
+        real = tio._column_to_numpy
+        monkeypatch.setattr(tio, "_column_to_numpy",
+                            lambda col, name: decoded.append(name)
+                            or real(col, name))
+        back = tio.read_parquet(p, columns=["a", "d"])
+        assert back.schema.names == ["a", "d"]
+        assert back.count() == n
+        # unread columns were never materialized: the decoder only ever
+        # saw the requested names
+        assert set(decoded) == {"a", "d"}
+        got = np.concatenate([blk.columns["a"] for blk in back.blocks()])
+        assert np.array_equal(got, cols["a"])
+
+    def test_projection_composes_with_row_groups(self, wide_file):
+        p, cols, n = wide_file
+        part = tio.read_parquet(p, columns=["b"], row_group_offset=1,
+                                row_group_limit=1)
+        got = np.concatenate([blk.columns["b"] for blk in part.blocks()])
+        # 60 rows over 3 row groups: group 1 holds rows 20..39
+        assert np.array_equal(got, cols["b"][20:40])
+        assert part.schema.names == ["b"]
+
+    def test_unknown_column_rejected(self, wide_file):
+        p, _, _ = wide_file
+        with pytest.raises(ValueError, match="nope"):
+            tio.read_parquet(p, columns=["a", "nope"])
+
+    def test_lazy_schema_matches_eager_decode(self, wide_file):
+        p, _, _ = wide_file
+        lazy = tio.read_parquet(p)
+        pre = lazy.schema  # footer-derived, nothing read yet
+        assert lazy._cache is None
+        eager = tio._read_parquet_eager(p, None, None, False, 0, None)
+        assert pre == eager.schema
+        assert lazy.num_partitions == eager.num_partitions
+
+    def test_nullable_int_column_falls_back_to_eager(self, tmp_path):
+        # int-with-nulls decodes as float64 NaN (pyarrow to_numpy); a
+        # footer-typed int64 schema would silently disagree with the
+        # data — such files must keep the eager data-derived schema
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+        p = str(tmp_path / "nulls.parquet")
+        pq.write_table(pa.table({"i": pa.array([1, None, 3], pa.int64()),
+                                 "f": pa.array([1.0, 2.0, 3.0])}), p)
+        back = tio.read_parquet(p)
+        assert back._plan_node is None  # eager, like before the plan
+        blk = back.blocks()[0]
+        assert blk.columns["i"].dtype == np.float64
+        assert back.schema["i"].dtype.name == "double"
+        assert np.isnan(blk.columns["i"][1])
+
+    def test_float_nulls_stay_lazy_and_decode_nan(self, tmp_path):
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+        p = str(tmp_path / "fnull.parquet")
+        pq.write_table(pa.table({"f": pa.array([1.0, None, 3.0])}), p)
+        back = tio.read_parquet(p)
+        assert back._plan_node is not None  # NaN round-trips losslessly
+        assert np.isnan(back.blocks()[0].columns["f"][1])
+
+    def test_lazy_rows_bytes_hints_from_footer(self, wide_file):
+        p, cols, n = wide_file
+        lazy = tio.read_parquet(p, columns=["a"])
+        assert lazy._cache is None
+        assert lazy.estimated_rows() == n
+        assert lazy.estimated_bytes() > 0
+
+
 def test_parquet_row_groups_become_partitions(tmp_path):
     p = str(tmp_path / "t.parquet")
     df = tft.frame({"x": np.arange(30.0)}, num_partitions=3)

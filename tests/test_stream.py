@@ -423,6 +423,60 @@ class TestBoundedStateDemo:
         assert tracing.counters.get("stream.state_evictions") > 0
 
 
+class TestWindowStateInGlobalLRU:
+    """PR 8 follow-on: window state is a registered entry in the global
+    memory LRU — the LEDGER drives its spills under pressure, not just
+    the stream's own ``max_state_rows`` cap (``docs/memory.md``)."""
+
+    def test_ledger_pressure_spills_window_state(self):
+        from tensorframes_tpu import memory
+        memory.configure(limit_bytes=1 << 20)
+        try:
+            agg = (stream.from_source(
+                       stream.GeneratorSource(
+                           _batches(6, rows=64, keys=32)))
+                   .group_by("k")
+                   .aggregate({"v": "sum"}, window=stream.tumbling(2.0),
+                              time_col="ts", watermark_delay=1000.0))
+            h = agg.start()
+            assert h.step()  # one committed, ledger-registered window
+            spills0 = tracing.counters.get("stream.state_spills")
+            # admission squeeze from ANYWHERE in the process: a reserve
+            # close to the whole budget must push the coldest resident
+            # (the window state) to host through the LRU
+            mgr = memory.active()
+            tok = mgr.reserve((1 << 20) - 64, op="test.pressure")
+            mgr.release(tok)
+            assert tracing.counters.get("stream.state_spills") > spills0
+            assert agg.state_spills > 0
+            # the window stayed LIVE: the rest of the stream folds into
+            # it (transparent fault-back) and totals stay exact
+            h.run()
+            frames = h.collect_updates()
+            got = sum(float(np.sum(f.blocks()[0].columns["v"]))
+                      for f in frames)
+            want = sum(float(np.sum(b["v"]))
+                       for b in _batches(6, rows=64, keys=32))
+            assert got == pytest.approx(want)
+        finally:
+            memory._reset()
+
+    def test_no_ledger_registration_when_unlimited(self):
+        from tensorframes_tpu import memory
+        memory.configure(limit_bytes=0)
+        try:
+            agg = (stream.from_source(
+                       stream.GeneratorSource(_batches(2)))
+                   .group_by("k")
+                   .aggregate({"v": "sum"}, window=stream.tumbling(2.0),
+                              time_col="ts", watermark_delay=1000.0))
+            h = agg.start()
+            h.run()
+            assert agg.state_spills == 0
+        finally:
+            memory._reset()
+
+
 # ---------------------------------------------------------------------------
 # per-batch failure isolation (acceptance: `batch` fault site)
 # ---------------------------------------------------------------------------
@@ -510,19 +564,21 @@ class TestFailureIsolation:
         tio.write_parquet(
             tft.frame({"x": np.arange(8.0)}, num_partitions=2), path)
         src = stream.ParquetTailSource(path, skip_unreadable_after_s=0.0)
-        real = tio.read_parquet
+        # the source reads through the EAGER entry (one footer read per
+        # poll; lazy frames would defer decode errors) — patch that
+        real = tio._read_parquet_eager
 
         def corrupt(p, *a, **kw):
             raise ValueError("corrupt row group data")
 
-        monkeypatch.setattr(tio, "read_parquet", corrupt)
+        monkeypatch.setattr(tio, "_read_parquet_eager", corrupt)
         # three consecutive failures at the same offset (past the
         # wall-clock floor, zeroed for the test), then the source steps
         # past the unreadable group — forward progress, not a spin
         for _ in range(3):
             with pytest.raises(ValueError):
                 src.poll()
-        monkeypatch.setattr(tio, "read_parquet", real)
+        monkeypatch.setattr(tio, "_read_parquet_eager", real)
         b = src.poll()                        # group 0 was skipped
         np.testing.assert_array_equal(b.columns["x"],
                                       np.arange(4.0, 8.0))
@@ -535,7 +591,7 @@ class TestFailureIsolation:
         tio.write_parquet(
             tft.frame({"x": np.arange(12.0)}, num_partitions=3), path)
         src = stream.ParquetTailSource(path, skip_unreadable_after_s=0.0)
-        real = tio.read_parquet
+        real = tio._read_parquet_eager
 
         def selective(p, *a, row_group_offset=0, row_group_limit=None,
                       **kw):
@@ -546,7 +602,7 @@ class TestFailureIsolation:
             return real(p, *a, row_group_offset=row_group_offset,
                         row_group_limit=row_group_limit, **kw)
 
-        monkeypatch.setattr(tio, "read_parquet", selective)
+        monkeypatch.setattr(tio, "_read_parquet_eager", selective)
         got = []
         for _ in range(10):
             try:
