@@ -82,6 +82,17 @@ if [ "${1:-}" = "--plan" ]; then
   exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m plan "$@"
 fi
 
+# --dplan: run only the distributed logical-plan lane
+# (tests/test_dplan.py: lazy d-op chains fused vs TFT_FUSE=0
+# bit-identity, folded dreduce/daggregate, device-loss recovery through
+# fused programs, ledger spills of resident shard edges) — fast,
+# CPU-only (8 virtual devices via conftest), no native build needed
+if [ "${1:-}" = "--dplan" ]; then
+  shift
+  echo "== dplan lane (pytest -m dplan, CPU) =="
+  exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m dplan "$@"
+fi
+
 # --timing: run only the wall-clock-sensitive deadline tests, serially
 # (they flake under concurrent suite load; TFT_TIMING_MARGIN widens
 # their assertion bounds further on badly oversubscribed boxes)

@@ -590,6 +590,10 @@ def filter_rows(predicate: Fetches, df: TensorFrame,
                 f"filter predicate produced shape {mask.shape} for a "
                 f"{b.num_rows}-row block")
         keep = int(mask.sum())
+        # feedback selectivity (ROADMAP 2a): the per-op path observes
+        # too, so chains that never fuse still sharpen their estimates
+        from ..plan.nodes import record_selectivity
+        record_selectivity(comp, b.num_rows, keep)
         if keep == b.num_rows:
             return b
         cols: Dict[str, Column] = {}

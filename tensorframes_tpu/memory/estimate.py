@@ -20,7 +20,7 @@ import numpy as np
 
 __all__ = ["array_nbytes", "column_nbytes", "block_nbytes",
            "blocks_estimate", "schema_row_bytes", "frame_estimate",
-           "propagate_hints"]
+           "dist_frame_estimate", "propagate_hints"]
 
 from .spill import array_nbytes
 
@@ -100,6 +100,47 @@ def frame_estimate(frame) -> Tuple[Optional[float], Optional[int]]:
     nbytes = getattr(frame, "_bytes_hint", None)
     return (float(rows) if rows is not None else None,
             int(nbytes) if nbytes is not None else None)
+
+
+def dist_frame_estimate(frame) -> Tuple[Optional[float], Optional[int]]:
+    """Best-effort ``(rows, device_bytes)`` of a (possibly lazy)
+    :class:`~..parallel.distributed.DistributedFrame`.
+
+    A LAZY frame (``frame.lazy()`` chains, ``docs/plan.md``) answers
+    from its distributed plan node WITHOUT forcing — source column
+    bytes propagated op by op, filters priced at their observed
+    selectivity once any forcing of the same predicate recorded one
+    (the keeps-everything upper bound before that). Materialized frames
+    count their columns exactly.
+    """
+    node = getattr(frame, "_dplan_node", None)
+    forced = getattr(frame, "_forced", None)
+    if node is not None and forced is None:
+        try:
+            rows, cols = node.estimate()
+        except Exception as e:
+            from ..utils.logging import get_logger
+            get_logger("memory.estimate").debug(
+                "distributed plan estimate failed (%s); counting the "
+                "source instead", e)
+            rows, cols = None, None
+        if cols is not None:
+            return (float(rows) if rows is not None else None,
+                    int(sum(cols.values())))
+        frame = getattr(frame, "_source", frame)
+    elif forced is not None:
+        frame = forced
+    try:
+        # value_nbytes reads sizes WITHOUT faulting spilled columns
+        # back to the device — pricing a frame must never re-resident
+        # it (the PR 8 fault-free-metadata rule)
+        from .spill import value_nbytes
+        total = 0
+        for name in frame.schema.names:
+            total += int(value_nbytes(frame.columns, name) or 0)
+        return float(frame.num_rows), total
+    except Exception:
+        return None, None
 
 
 def propagate_hints(src_frame, out_schema

@@ -108,6 +108,16 @@ def render(trace: "_events.QueryTrace") -> str:
                 f"{_skew_threshold():g}; straggling shard or skewed "
                 f"rows, see the per-device table above; persistent "
                 f"skew triggers re-partitioning, docs/resilience.md)")
+    for ev in list(trace.events):
+        if ev.etype == "fused_stage":
+            a = ev.args or {}
+            res = (f", {a.get('resident')} column(s) pass through "
+                   f"device-resident" if a.get("resident") else "")
+            lines.append(
+                f"  dplan    : fused stage '{ev.name}' — "
+                f"{a.get('ops')} op(s) in ONE GSPMD program, "
+                f"{a.get('filters', 0)} in-program filter(s){res} "
+                f"(docs/plan.md)")
     if s["mesh_shrinks"]:
         for ev in list(trace.events):
             if ev.etype == "mesh_shrink":

@@ -46,10 +46,17 @@ from . import elastic as _elastic
 from ..observability.events import (DEVICE_TRACK_BASE, current_trace,
                                     traced_query)
 from ..utils.logging import get_logger
-from ..utils.tracing import span
+from ..utils.tracing import counters, span
 
 __all__ = ["DistributedFrame", "distribute", "dmap_blocks", "dfilter",
            "dsort", "dreduce_blocks", "daggregate"]
+
+
+def _lazy_input(dist):
+    """The lazy recording view when ``dist`` is one (``frame.lazy()``),
+    else None — the d-op entry points continue recorded chains instead
+    of forcing them (``plan/dist.py``)."""
+    return dist if getattr(dist, "_tft_lazy_dist", False) else None
 
 _cached_reduce_computation = _ops.cached_reduce_computation
 
@@ -340,6 +347,21 @@ class DistributedFrame:
         """True (un-padded) global row count."""
         return self.num_rows
 
+    def lazy(self):
+        """A RECORDING view of this frame: subsequent ``dmap_blocks`` /
+        ``dfilter`` / ``select`` calls record distributed plan nodes
+        instead of dispatching, and the chain forces as ONE fused GSPMD
+        program per mesh stage with shard intermediates staying
+        device-resident (terminal monoid ``dreduce_blocks`` /
+        ``daggregate`` fold into the same program). Returns ``self``
+        when fusion cannot apply — ``TFT_FUSE=0``, the native ``pjrt``
+        executor, multi-process meshes, frames whose rows do not tile
+        the data axis — so chains then run eagerly per-op,
+        bit-identical by construction. See ``docs/plan.md``.
+        """
+        from ..plan import dist as _dplan
+        return _dplan.lazy_frame(self)
+
     def explain(self) -> str:
         """Schema + placement report (the mesh-side ``explain`` /
         ``print_schema`` analogue): per-column dtype, declared shape,
@@ -365,6 +387,12 @@ class DistributedFrame:
                 except Exception:
                     place = type(col).__name__
             lines.append(f"  {f.describe()} sharding={place}")
+        info = getattr(self, "_dplan_info", None)
+        if info:
+            # the distributed plan section (docs/plan.md): fused stage
+            # layout, resident shard edges, fallback reasons — set by
+            # plan.dist when this frame came out of a lazy chain
+            lines.extend(info)
         return "\n".join(lines)
 
     def __repr__(self):
@@ -483,7 +511,6 @@ def distribute(df: TensorFrame, mesh: DeviceMesh) -> DistributedFrame:
     return result
 
 
-@traced_query("dmap_blocks", _meta_with_fetches)
 def dmap_blocks(fetches, dist: DistributedFrame, trim: bool = False,
                 row_aligned: Optional[bool] = None) -> DistributedFrame:
     """Mesh-parallel map: one jit dispatch, all shards in parallel.
@@ -508,7 +535,26 @@ def dmap_blocks(fetches, dist: DistributedFrame, trim: bool = False,
     Like every mesh op, the dispatch runs through the elastic boundary
     (``parallel/elastic.py``): a classified device loss shrinks the mesh,
     re-shards, and re-runs; persistent skew re-partitions first.
+
+    On a LAZY frame (:meth:`DistributedFrame.lazy`) a proven
+    row-preserving non-trim map RECORDS a plan node and defers — the
+    chain forces as one fused GSPMD program (``docs/plan.md``); trim /
+    unprovable computations materialize the chain and dispatch eagerly.
     """
+    lz = _lazy_input(dist)
+    if lz is not None:
+        from ..plan import dist as _dplan
+        out = _dplan.record_map(fetches, lz, trim, row_aligned)
+        if out is not None:
+            return out
+        dist = _dplan.materialize(lz)
+    return _dmap_blocks_eager(fetches, dist, trim, row_aligned)
+
+
+@traced_query("dmap_blocks", _meta_with_fetches)
+def _dmap_blocks_eager(fetches, dist: DistributedFrame, trim: bool = False,
+                       row_aligned: Optional[bool] = None
+                       ) -> DistributedFrame:
     return _elastic.elastic_call(
         "dmap_blocks", dist,
         lambda d: _dmap_blocks(fetches, d, trim, row_aligned))
@@ -538,6 +584,7 @@ def _dmap_blocks(fetches, dist: DistributedFrame, trim: bool,
             _native_mesh_fallback(e)
             outs_np = None
         if outs_np is not None:
+            counters.inc("mesh.dispatches")
             # per-key copy through __getitem__: dict()'s raw fast-path
             # copy would bypass SpillableColumns' fault-back and hand a
             # concurrently-spilled frame's None placeholders downstream
@@ -570,6 +617,7 @@ def _dmap_blocks(fetches, dist: DistributedFrame, trim: bool,
     t0 = (_trace_shards(trace, "dmap_blocks", dist=dist)
           if trace is not None else 0.0)
     out = policy.call(_dispatch, op="dmap_blocks.dispatch")
+    counters.inc("mesh.dispatches")
     if trace is not None:
         _trace_mesh_done(trace, [out[s.name] for s in comp.outputs], t0,
                          "dmap_blocks", mesh=mesh)
@@ -603,7 +651,6 @@ def _dmap_blocks(fetches, dist: DistributedFrame, trim: bool,
                                          else None))
 
 
-@traced_query("dfilter", _meta_dfilter)
 def dfilter(predicate, dist: DistributedFrame) -> DistributedFrame:
     """Mesh filter: keep the rows where ``predicate`` holds (nonzero).
 
@@ -620,7 +667,23 @@ def dfilter(predicate, dist: DistributedFrame) -> DistributedFrame:
     ``predicate`` follows :func:`tensorframes_tpu.filter_rows`'s
     contract: named args select columns, one rank-1 boolean/integer
     fetch.
+
+    On a LAZY frame the filter RECORDS: its compaction fragment runs
+    INSIDE the chain's fused program and the survivor counts stay
+    traced between ops (no host readback until the chain forces).
     """
+    lz = _lazy_input(dist)
+    if lz is not None:
+        from ..plan import dist as _dplan
+        out = _dplan.record_filter(predicate, lz)
+        if out is not None:
+            return out
+        dist = _dplan.materialize(lz)
+    return _dfilter_eager(predicate, dist)
+
+
+@traced_query("dfilter", _meta_dfilter)
+def _dfilter_eager(predicate, dist: DistributedFrame) -> DistributedFrame:
     return _elastic.elastic_call("dfilter", dist,
                                  lambda d: _dfilter(predicate, d))
 
@@ -710,9 +773,19 @@ def _dfilter(predicate, dist: DistributedFrame) -> DistributedFrame:
             outs = fn(cnt_dev, *arrays)
         if trace is not None:
             _trace_mesh_done(trace, list(outs), t0, "dfilter", mesh=mesh)
+    counters.inc("mesh.dispatches")
     new_cols: Dict[str, jax.Array] = dict(zip(tensor_names, outs))
     counts = _read_global(outs[len(tensor_names)]).astype(np.int64)
+    # the survivor counts (and, with host ride-alongs, the keep mask)
+    # cross to the host between this op and the next — the inter-stage
+    # transfer the fused plan keeps traced (docs/plan.md)
+    counters.inc("mesh.interstage_host_bytes", 4 * S)
+    # feedback selectivity (ROADMAP 2a): observed rows-in/rows-out
+    # sharpen estimates for later plans over the same predicate
+    from ..plan.nodes import record_selectivity
+    record_selectivity(comp, dist.num_rows, int(counts.sum()))
     if host_names:
+        counters.inc("mesh.interstage_host_bytes", dist.padded_rows)
         keep_host = _read_global(outs[len(tensor_names) + 1])
         for n in host_names:
             a = dist.columns[n]
@@ -730,7 +803,6 @@ _dsort_cache: "OrderedDict[tuple, object]" = OrderedDict()
 _DSORT_CACHE_CAP = 32
 
 
-@traced_query("dsort", _meta_dsort)
 def dsort(keys, dist: DistributedFrame, descending: bool = False
           ) -> DistributedFrame:
     """Rows globally sorted by scalar key column(s), on the mesh.
@@ -760,10 +832,24 @@ def dsort(keys, dist: DistributedFrame, descending: bool = False
     Keys must be device (numeric) columns; sort by a string key on the
     host via ``TensorFrame.order_by`` instead. Host-side string
     ride-along columns are permuted on the host from the same order.
+
+    A LAZY frame materializes first (its pending chain forces fused;
+    the sort consumes the still-device-resident result — the resident
+    shard edge between mesh stages).
     """
+    lz = _lazy_input(dist)
+    if lz is not None:
+        from ..plan import dist as _dplan
+        dist = _dplan.materialize(lz)
     if isinstance(keys, str):
         keys = [keys]
     keys = list(keys)
+    return _dsort_eager(keys, dist, descending)
+
+
+@traced_query("dsort", _meta_dsort)
+def _dsort_eager(keys, dist: DistributedFrame, descending: bool = False
+                 ) -> DistributedFrame:
     ext = _dsort_external_if_needed(keys, dist, descending)
     if ext is not None:
         return ext
@@ -988,6 +1074,7 @@ def _dsort_local(dist, keys, descending, tensor_names, arrays, valid_dev,
           if trace is not None else 0.0)
     with span("dsort.dispatch"):
         outs = fn(valid_dev, *arrays)
+    counters.inc("mesh.dispatches")
     if trace is not None:
         _trace_mesh_done(trace, list(outs), t0, "dsort", mesh=mesh)
     return outs
@@ -1199,12 +1286,12 @@ def _dsort_columnsort(dist, keys, descending, tensor_names, arrays,
                   op="dsort.columnsort")
     with span("dsort.columnsort_dispatch"):
         outs = fn(valid_dev, *arrays)
+    counters.inc("mesh.dispatches")
     if trace is not None:
         _trace_mesh_done(trace, list(outs), t0, "dsort", mesh=mesh)
     return outs
 
 
-@traced_query("dreduce_blocks", _meta_with_fetches)
 def dreduce_blocks(fetches, dist: DistributedFrame):
     """Mesh-parallel reduce to one row.
 
@@ -1217,7 +1304,24 @@ def dreduce_blocks(fetches, dist: DistributedFrame):
       BASELINE north-star path.
     - ``fetches`` is a computation (z/z_input contract): generic combine —
       per-shard async jit dispatches, partials stacked, one final reduce.
+
+    On a LAZY frame a monoid reduce FOLDS into the pending chain's
+    fused program as the terminal combiner (one mesh dispatch for chain
+    + reduction, DrJAX-style); generic computations materialize the
+    chain and run the eager path.
     """
+    lz = _lazy_input(dist)
+    if lz is not None:
+        from ..plan import dist as _dplan
+        out = _dplan.record_reduce(fetches, lz)
+        if out is not None:
+            return out
+        dist = _dplan.materialize(lz)
+    return _dreduce_blocks_eager(fetches, dist)
+
+
+@traced_query("dreduce_blocks", _meta_with_fetches)
+def _dreduce_blocks_eager(fetches, dist: DistributedFrame):
     if isinstance(fetches, Mapping) and all(
             isinstance(v, str) for v in fetches.values()):
         return _elastic.elastic_call(
@@ -1347,6 +1451,7 @@ def _collective_reduce(col_combiners: Mapping[str, str],
         if trace is not None:
             _trace_mesh_done(trace, list(outs), t0, "dreduce_blocks",
                              mesh=mesh)
+    counters.inc("mesh.dispatches")
     result = {}
     for name, a in zip(names, outs):
         v = np.asarray(a)
@@ -1395,6 +1500,98 @@ def _group_ids_cache_put(dist: DistributedFrame, ckey: tuple, hit: tuple):
     dist._group_ids_cache[ckey] = hit
     while len(dist._group_ids_cache) > _GROUP_IDS_CACHE_CAP:
         dist._group_ids_cache.popitem(last=False)
+
+
+def _monoid_group_plan(dist: DistributedFrame, keys):
+    """Host-key group ids + the hot-key salt plan for a monoid
+    aggregation — ONE definition shared by ``_daggregate``'s jax path
+    and the fused distributed plan's folded ``daggregate``
+    (``plan/dist.py``), so the two can never drift.
+
+    Returns ``(ids_dev, uniques, num_groups, salt_plan)``; salting is
+    cached per (frame, keys, threshold) like the group ids themselves.
+    """
+    ids_dev, uniques, _, _, num_groups = _cached_group_ids(
+        dist, keys, None)
+    salt_plan = None
+    if dist.mesh.num_data_shards > 1:
+        frac = _elastic.salt_fraction()
+        if frac is not None:
+            skey = ("salt", tuple(keys), frac)
+            cached = _group_ids_cache_get(dist, skey)
+            if cached is None:
+                cached = (_elastic.plan_key_salt(
+                    dist, ids_dev, num_groups,
+                    dist.mesh.num_data_shards),)
+                _group_ids_cache_put(dist, skey, cached)
+            salt_plan = cached[0]
+    return ids_dev, uniques, num_groups, salt_plan
+
+
+def _monoid_agg_shard_fn(fetch_names, col_combiners, axis,
+                         prog_groups: int, seg_impl=None):
+    """The per-shard monoid segment-reduce + collective fragment — ONE
+    definition shared by ``_daggregate`` (jax AND native routes), the
+    fused distributed plan's folded ``daggregate``, and the streaming
+    mesh fold (``plan/dist.py``), so the four dispatch paths can never
+    drift."""
+    from ..ops.segment_reduce import segment_sum as _segsum
+
+    def shard_fn(ids_local, *vals_local):
+        outs = []
+        for f, v in zip(fetch_names, vals_local):
+            cname = col_combiners[f]
+            if cname == "sum":
+                local = _segsum(v, ids_local, prog_groups,
+                                impl=seg_impl)
+            else:
+                # mask pad/out-of-range rows to the combiner's neutral
+                # and clamp their id to 0 so XLA's segment primitive
+                # sees only in-range indices
+                c = COMBINERS[cname]
+                valid = ids_local >= 0
+                vmask = valid.reshape((-1,) + (1,) * (v.ndim - 1))
+                neutral = jnp.asarray(c.neutral(v.dtype))
+                masked = jnp.where(vmask, v, neutral)
+                safe_ids = jnp.where(valid, ids_local, 0)
+                seg = {"min": jax.ops.segment_min,
+                       "max": jax.ops.segment_max,
+                       "prod": jax.ops.segment_prod}[cname]
+                local = seg(masked, safe_ids,
+                            num_segments=prog_groups)
+                # a group absent from this shard holds the identity;
+                # for min/max that identity is +-inf, which the
+                # cross-shard collective absorbs (every group exists
+                # somewhere)
+            outs.append(COMBINERS[cname].collective(local, axis))
+        return tuple(outs)
+
+    return shard_fn
+
+
+def _monoid_agg_result(schema: Schema, keys, fetch_names, tables,
+                       key_cols, num_out: int) -> TensorFrame:
+    """Host assembly of a monoid aggregation's result frame (key
+    columns + sliced/cast fetch tables) — shared by ``_daggregate``
+    and the fused plan's folded ``daggregate``."""
+    from ..schema import Field
+    from ..shape import Unknown
+
+    cols = dict(key_cols)
+    for f, t in zip(fetch_names, tables):
+        v = np.asarray(t)[:num_out]
+        fld = schema[f]
+        if v.dtype != fld.dtype.np_storage and fld.dtype is not _dt.bfloat16:
+            v = v.astype(fld.dtype.np_storage)
+        cols[f] = v
+    out_fields = [schema[k] for k in keys] + [
+        Field(f, schema[f].dtype,
+              block_shape=(schema[f].block_shape.with_lead(Unknown)
+                           if schema[f].block_shape is not None else None),
+              sql_rank=schema[f].sql_rank)
+        for f in fetch_names]
+    return TensorFrame.from_blocks([Block(cols, num_out)],
+                                   Schema(out_fields))
 
 
 def _host_group_ids(dist: DistributedFrame, keys):
@@ -1600,7 +1797,6 @@ def _device_key_columns(dist: DistributedFrame, keys, key_table,
             for i, k in enumerate(keys)}, count
 
 
-@traced_query("daggregate", _meta_daggregate)
 def daggregate(fetches, dist: DistributedFrame, keys,
                max_groups: Optional[int] = None) -> TensorFrame:
     """Mesh-distributed keyed aggregation.
@@ -1660,6 +1856,24 @@ def daggregate(fetches, dist: DistributedFrame, keys,
     keys = list(keys)
     if not keys:
         raise ValueError("daggregate needs at least one key column")
+    lz = _lazy_input(dist)
+    if lz is not None:
+        # a monoid host-key aggregation over a filter-free chain whose
+        # keys pass through untouched FOLDS into the fused program as
+        # the terminal combiner; anything else materializes the chain
+        # (still fused among itself) and runs the eager op on the
+        # device-resident result
+        from ..plan import dist as _dplan
+        out = _dplan.record_aggregate(fetches, lz, keys, max_groups)
+        if out is not None:
+            return out
+        dist = _dplan.materialize(lz)
+    return _daggregate_eager(fetches, dist, keys, max_groups)
+
+
+@traced_query("daggregate", _meta_daggregate)
+def _daggregate_eager(fetches, dist: DistributedFrame, keys,
+                      max_groups: Optional[int] = None) -> TensorFrame:
     return _elastic.elastic_call(
         "daggregate", dist,
         lambda d: _daggregate(fetches, d, keys, max_groups))
@@ -1678,7 +1892,6 @@ def _daggregate(fetches, dist: DistributedFrame, keys,
     col_combiners = fetches
 
     from ..engine.ops import _validate_monoid_fetches
-    from ..ops.segment_reduce import segment_sum as _segsum
 
     mesh = dist.mesh
     axis = mesh.data_axis
@@ -1690,24 +1903,14 @@ def _daggregate(fetches, dist: DistributedFrame, keys,
         raise ValueError("aggregate on an empty distributed frame")
 
     device_keys = max_groups is not None
-    ids_dev, uniques, uniq_dev, count_dev, num_groups = _cached_group_ids(
-        dist, keys, max_groups)
-
-    # hot-key salting (host-key jax path only): split any group holding
-    # more than the threshold fraction of rows across the shards'
-    # salt slots; the per-salt partials fold back on the host below.
-    # Cached per (frame, keys, threshold) like the group ids themselves.
-    salt_plan = None
-    if not device_keys and mesh.num_data_shards > 1:
-        frac = _elastic.salt_fraction()
-        if frac is not None:
-            skey = ("salt", tuple(keys), frac)
-            cached = _group_ids_cache_get(dist, skey)
-            if cached is None:
-                cached = (_elastic.plan_key_salt(
-                    dist, ids_dev, num_groups, mesh.num_data_shards),)
-                _group_ids_cache_put(dist, skey, cached)
-            salt_plan = cached[0]
+    if device_keys:
+        ids_dev, uniques, uniq_dev, count_dev, num_groups = \
+            _cached_group_ids(dist, keys, max_groups)
+        salt_plan = None
+    else:
+        ids_dev, uniques, num_groups, salt_plan = _monoid_group_plan(
+            dist, keys)
+        uniq_dev = count_dev = None
     if salt_plan is not None:
         prog_ids, prog_groups = salt_plan[0], salt_plan[1]
     else:
@@ -1718,37 +1921,6 @@ def _daggregate(fetches, dist: DistributedFrame, keys,
     in_specs = (P(axis),) + tuple(
         P(axis, *([None] * (a.ndim - 1))) for a in arrays)
     out_specs = tuple(P() for _ in fetch_names)
-
-    def make_shard_fn(seg_impl):
-        def shard_fn(ids_local, *vals_local):
-            outs = []
-            for f, v in zip(fetch_names, vals_local):
-                cname = col_combiners[f]
-                if cname == "sum":
-                    local = _segsum(v, ids_local, prog_groups,
-                                    impl=seg_impl)
-                else:
-                    # mask pad/out-of-range rows to the combiner's neutral
-                    # and clamp their id to 0 so XLA's segment primitive
-                    # sees only in-range indices
-                    c = COMBINERS[cname]
-                    valid = ids_local >= 0
-                    vmask = valid.reshape((-1,) + (1,) * (v.ndim - 1))
-                    neutral = jnp.asarray(c.neutral(v.dtype))
-                    masked = jnp.where(vmask, v, neutral)
-                    safe_ids = jnp.where(valid, ids_local, 0)
-                    seg = {"min": jax.ops.segment_min,
-                           "max": jax.ops.segment_max,
-                           "prod": jax.ops.segment_prod}[cname]
-                    local = seg(masked, safe_ids,
-                                num_segments=prog_groups)
-                    # a group absent from this shard holds the identity;
-                    # for min/max that identity is +-inf, which the
-                    # cross-shard collective absorbs (every group exists
-                    # somewhere)
-                outs.append(COMBINERS[cname].collective(local, axis))
-            return tuple(outs)
-        return shard_fn
 
     # TFT_EXECUTOR=pjrt: the per-shard segment reduce + collective runs as
     # ONE GSPMD executable in the native C++ core (the last mesh op to
@@ -1765,8 +1937,10 @@ def _daggregate(fetches, dist: DistributedFrame, keys,
     nm = None if salt_plan is not None else _native_mesh(mesh)
     if nm is not None:
         def build_prog():
-            return shard_map(make_shard_fn("xla"), mesh=mesh.mesh,
-                             in_specs=in_specs, out_specs=out_specs)
+            return shard_map(
+                _monoid_agg_shard_fn(fetch_names, col_combiners, axis,
+                                     prog_groups, seg_impl="xla"),
+                mesh=mesh.mesh, in_specs=in_specs, out_specs=out_specs)
 
         in_shardings = [mesh.row_sharding(1)] + [
             mesh.row_sharding(a.ndim) for a in arrays]
@@ -1785,8 +1959,10 @@ def _daggregate(fetches, dist: DistributedFrame, keys,
         if fn is not None:
             _collective_cache.move_to_end(pkey)
         else:
-            fn = jax.jit(shard_map(make_shard_fn(None), mesh=mesh.mesh,
-                                   in_specs=in_specs, out_specs=out_specs))
+            fn = jax.jit(shard_map(
+                _monoid_agg_shard_fn(fetch_names, col_combiners, axis,
+                                     prog_groups),
+                mesh=mesh.mesh, in_specs=in_specs, out_specs=out_specs))
             _collective_cache[pkey] = fn
             while len(_collective_cache) > _COLLECTIVE_CACHE_CAP:
                 _collective_cache.popitem(last=False)
@@ -1802,32 +1978,19 @@ def _daggregate(fetches, dist: DistributedFrame, keys,
         if trace is not None:
             _trace_mesh_done(trace, list(tables), t0, "daggregate",
                              mesh=mesh)
+    counters.inc("mesh.dispatches")
 
     if salt_plan is not None:
         tables = [_elastic.fold_salted(t, salt_plan[2], col_combiners[f])
                   for f, t in zip(fetch_names, tables)]
     if device_keys:
-        cols, num_out = _device_key_columns(dist, keys, uniq_dev,
-                                            count_dev, max_groups)
+        key_cols, num_out = _device_key_columns(dist, keys, uniq_dev,
+                                                count_dev, max_groups)
     else:
-        cols = {k: u for k, u in zip(keys, uniques)}
+        key_cols = {k: u for k, u in zip(keys, uniques)}
         num_out = num_groups
-    for f, t in zip(fetch_names, tables):
-        v = np.asarray(t)[:num_out]
-        fld = schema[f]
-        if v.dtype != fld.dtype.np_storage and fld.dtype is not _dt.bfloat16:
-            v = v.astype(fld.dtype.np_storage)
-        cols[f] = v
-    from ..schema import Field
-    from ..shape import Unknown
-    out_fields = [schema[k] for k in keys] + [
-        Field(f, schema[f].dtype,
-              block_shape=(schema[f].block_shape.with_lead(Unknown)
-                           if schema[f].block_shape is not None else None),
-              sql_rank=schema[f].sql_rank)
-        for f in fetch_names]
-    return TensorFrame.from_blocks([Block(cols, num_out)],
-                                   Schema(out_fields))
+    return _monoid_agg_result(schema, keys, fetch_names, tables,
+                              key_cols, num_out)
 
 
 def _segmented_fold(comp, names, mesh: DeviceMesh, arrays, ids_dev,
@@ -1973,6 +2136,7 @@ def _segmented_fold(comp, names, mesh: DeviceMesh, arrays, ids_dev,
           if trace is not None else 0.0)
     with span("daggregate.segmented_fold_dispatch"):
         outs = fn(ids_dev, *arrays)
+    counters.inc("mesh.dispatches")
     if trace is not None:
         _trace_mesh_done(trace, [outs[f] for f in names], t0,
                          "daggregate", mesh=mesh)
@@ -2166,6 +2330,7 @@ def _generic_reduce(fetches, dist: DistributedFrame) -> Dict[str, np.ndarray]:
               if trace is not None else 0.0)
         with span("dreduce_blocks.generic_dispatch"):
             final = fn(*arrays)
+        counters.inc("mesh.dispatches")
         if trace is not None:
             _trace_mesh_done(trace, [final[f] for f in names], t0,
                              "dreduce_blocks", mesh=mesh)

@@ -511,6 +511,16 @@ _MESH_FAMILIES = (
      "Skew-adaptive repartitions applied."),
     ("mesh.salted_keys", "tft_mesh_salted_keys_total",
      "Hot key groups salted across shards by daggregate."),
+    ("mesh.dispatches", "tft_mesh_dispatches_total",
+     "Compiled mesh-op program dispatches (a fused distributed plan "
+     "counts ONE for its whole chain — docs/plan.md)."),
+    ("mesh.interstage_host_bytes", "tft_mesh_interstage_host_bytes_total",
+     "Bytes crossing device->host BETWEEN chained mesh ops (dfilter "
+     "survivor counts / keep masks); zero on fused chains."),
+    ("dplan.fused_forcings", "tft_dplan_fused_forcings_total",
+     "Lazy distributed chains forced as one fused GSPMD program."),
+    ("dplan.fallbacks", "tft_dplan_fallbacks_total",
+     "Fused mesh programs that fell back to the per-op replay."),
 )
 
 

@@ -36,12 +36,25 @@ See ``docs/plan.md``.
 from __future__ import annotations
 
 from .nodes import (FilterNode, MapBlocksNode, MapRowsNode, ParquetScanNode,
-                    PlanNode, SelectNode, SourceNode, attach, node_for)
+                    PlanNode, SelectNode, SourceNode, attach, node_for,
+                    observed_selectivity, record_selectivity)
 from .optimize import enabled
 from .execute import maybe_run
 
 __all__ = [
     "PlanNode", "SourceNode", "ParquetScanNode", "MapBlocksNode",
     "MapRowsNode", "FilterNode", "SelectNode", "attach", "node_for",
-    "enabled", "maybe_run",
+    "enabled", "maybe_run", "record_selectivity", "observed_selectivity",
+    "dist",
 ]
+
+
+def __getattr__(name):
+    # plan.dist imports parallel.distributed (which imports engine.ops,
+    # which imports this package): resolve the submodule lazily so the
+    # package import graph stays acyclic. importlib (not `from . import
+    # dist`) because a from-import probes this very __getattr__ first.
+    if name == "dist":
+        import importlib
+        return importlib.import_module(__name__ + ".dist")
+    raise AttributeError(name)

@@ -181,6 +181,15 @@ def _apply_stage_result(plan, st, env, out, n_rows):
     if st.mask:
         mask = np.asarray(out[MASK]).astype(bool)
         keep = int(mask.sum())
+        # feedback selectivity: the forced filter's observed
+        # rows-in/rows-out sharpen PlanNode.estimate() for subsequent
+        # forcings and stream batches of the same predicate
+        fnode = plan.ops[st.op_end]
+        if fnode.kind == "filter":
+            from .nodes import record_selectivity
+            record_selectivity(fnode.comp, mask.size, keep)
+            tin, tout = fnode.observed or (0, 0)
+            fnode.observed = (tin + int(mask.size), tout + keep)
         if keep == 0:
             empty = {k: _mask_value(v, mask, np.empty(0, np.int64))
                      for k, v in new_env.items()}

@@ -28,9 +28,54 @@ from ..schema import Schema
 from ..utils.logging import get_logger
 
 __all__ = ["PlanNode", "SourceNode", "ParquetScanNode", "MapBlocksNode",
-           "MapRowsNode", "FilterNode", "SelectNode", "attach", "node_for"]
+           "MapRowsNode", "FilterNode", "SelectNode", "attach", "node_for",
+           "record_selectivity", "observed_selectivity"]
 
 _log = get_logger("plan.nodes")
+
+# ---------------------------------------------------------------------------
+# feedback selectivity (ROADMAP item 2a, first slice)
+# ---------------------------------------------------------------------------
+#
+# When a filter stage FORCES, the observed rows-in/rows-out land on the
+# predicate's canonical Computation (computations are cached per fetches
+# object — engine.ops.cached_map_computation — so every plan built from
+# the same predicate shares one record: subsequent forcings, per-batch
+# streaming frames, and the mesh dfilter all see it). Estimates then use
+# the observed ratio instead of the keeps-everything upper bound.
+
+_sel_lock = __import__("threading").Lock()
+
+# bumped on every recorded observation: estimate caches key on it, so
+# an upstream filter's sharpened selectivity invalidates EVERY cached
+# downstream estimate (a MapBlocksNode whose input is a filter must not
+# keep pricing the pre-observation upper bound forever)
+_sel_epoch = 0
+
+
+def record_selectivity(comp, rows_in: int, rows_out: int) -> None:
+    """Accumulate one forcing's observed filter selectivity on its
+    predicate computation (best-effort: unsettable comps are skipped)."""
+    global _sel_epoch
+    if rows_in <= 0:
+        return
+    try:
+        with _sel_lock:
+            tin, tout = getattr(comp, "_tft_observed_sel", (0, 0))
+            comp._tft_observed_sel = (tin + int(rows_in),
+                                      tout + int(rows_out))
+            _sel_epoch += 1
+    except Exception as e:  # noqa: BLE001 - feedback is advisory
+        _log.debug("could not record selectivity on %r: %s", comp, e)
+
+
+def observed_selectivity(comp) -> Optional[float]:
+    """The accumulated rows-out/rows-in ratio of a predicate, or
+    ``None`` before its first observed forcing."""
+    rec = getattr(comp, "_tft_observed_sel", None)
+    if not rec or rec[0] <= 0:
+        return None
+    return min(1.0, rec[1] / rec[0])
 
 # (rows, per-column total bytes) — either half may be None when unknown
 Estimate = Tuple[Optional[float], Optional[Dict[str, int]]]
@@ -80,13 +125,16 @@ class PlanNode:
         return self.kind
 
     def estimate(self) -> Estimate:
-        """Cached: computed once per node, like the construction-time
-        scalar hints it replaces (chain building stays O(n), not
-        O(n^2) walks). Callers get a copy of the column dict."""
+        """Cached per selectivity epoch: computed once per node (chain
+        building stays O(n), not O(n^2) walks) and recomputed only
+        after a new filter observation landed anywhere in the process
+        (``record_selectivity`` bumps the epoch) — so a sharpened
+        upstream selectivity propagates through cached downstream
+        estimates. Callers get a copy of the column dict."""
         cached = getattr(self, "_est_cache", None)
-        if cached is None:
-            cached = self._est_cache = self._estimate()
-        rows, cols = cached
+        if cached is None or cached[0] != _sel_epoch:
+            cached = self._est_cache = (_sel_epoch, self._estimate())
+        rows, cols = cached[1]
         return rows, (dict(cols) if cols is not None else None)
 
     def _estimate(self) -> Estimate:
@@ -231,11 +279,23 @@ class FilterNode(PlanNode):
     def __init__(self, input: PlanNode, schema: Schema, comp: Computation):
         super().__init__(input, schema)
         self.comp = comp
+        # observed (rows_in, rows_out) of THIS node's own forcings —
+        # recorded by plan.execute; the cross-plan record lives on the
+        # comp (record_selectivity) so fresh nodes over the same
+        # predicate inherit it
+        self.observed: Optional[Tuple[int, int]] = None
 
     def _estimate(self) -> Estimate:
-        # an upper bound, like the per-op hint: a filter keeps at most
-        # its input
-        return self.input.estimate()
+        # the epoch-keyed base cache re-invokes this after every new
+        # observation, so the ratio is always current
+        rows, cols = self.input.estimate()
+        sel = observed_selectivity(self.comp)
+        if sel is None or rows is None:
+            # an upper bound, like the per-op hint: a filter keeps at
+            # most its input
+            return rows, cols
+        return rows * sel, ({n: int(b * sel) for n, b in cols.items()}
+                            if cols is not None else None)
 
 
 class SelectNode(PlanNode):

@@ -282,7 +282,8 @@ class StreamingAggregation:
                  window: Optional[Window] = None,
                  time_col: Optional[str] = None,
                  watermark_delay: float = 0.0,
-                 max_state_rows: Optional[int] = None):
+                 max_state_rows: Optional[int] = None,
+                 mesh=None):
         if not (isinstance(col_combiners, Mapping) and col_combiners
                 and all(isinstance(v, str)
                         for v in col_combiners.values())):
@@ -298,6 +299,22 @@ class StreamingAggregation:
         self.time_col = time_col
         self.watermark_delay = float(watermark_delay)
         self.max_state_rows = max_state_rows
+        # mesh=: per-batch window folds ride the fused mesh path — each
+        # batch's keyed partial tables compute as ONE GSPMD program
+        # (per-shard segment reduce + psum-family collective, the
+        # daggregate fragment) over the mesh's data axis, so one
+        # windowed stream scales past one device. The [groups, ...]
+        # partial then merges into the same device-resident window
+        # state. Float sums may reassociate across shards, like any
+        # daggregate; integer folds stay exact. A 1-shard mesh (or
+        # None) keeps the single-device segment-reduce dispatch, and so
+        # do multi-process meshes — the batch arrays are process-local,
+        # so sharding them as if they were the global rows would be
+        # wrong (the same guard the lazy d-op recorder applies).
+        import jax as _jax
+        self.mesh = mesh if (mesh is not None
+                             and mesh.num_data_shards > 1
+                             and _jax.process_count() == 1) else None
         if watermark_delay < 0:
             raise ValueError(
                 f"watermark_delay must be >= 0, got {watermark_delay}")
@@ -535,15 +552,30 @@ class StreamingAggregation:
 
         schema = self.upstream.schema
         fact = _factorize_keys(key_arrays)
-        parts = {}
-        with span("stream.aggregate.segment_reduce"):
-            for f in self.fetch_names:
-                v = val_arrays[f]
-                dd = _dt.device_dtype(schema[f].dtype)
-                if v.dtype != dd:
-                    v = _native.convert(v, dd)
-                parts[f] = jnp.asarray(_segment_reduce(
-                    self.col_combiners[f], v, fact.ids, fact.num_groups))
+        converted = {}
+        for f in self.fetch_names:
+            v = val_arrays[f]
+            dd = _dt.device_dtype(schema[f].dtype)
+            if v.dtype != dd:
+                v = _native.convert(v, dd)
+            converted[f] = v
+        if self.mesh is not None:
+            # the distributed-plan path: one fused GSPMD program per
+            # batch (rows shard over the data axis, partial tables
+            # combine with one collective) — docs/plan.md
+            from ..plan import dist as _dplan
+            mesh_parts = _dplan.mesh_segment_partial(
+                self.mesh, self.col_combiners,
+                fact.ids.astype(np.int32), converted, fact.num_groups)
+            parts = {f: jnp.asarray(mesh_parts[f])
+                     for f in self.fetch_names}
+        else:
+            parts = {}
+            with span("stream.aggregate.segment_reduce"):
+                for f in self.fetch_names:
+                    parts[f] = jnp.asarray(_segment_reduce(
+                        self.col_combiners[f], converted[f], fact.ids,
+                        fact.num_groups))
         if base is None:
             return _WState([np.asarray(u) for u in fact.uniques], parts,
                            fact.num_groups), np.arange(fact.num_groups)

@@ -155,7 +155,7 @@ class GroupedStream:
 
     def aggregate(self, fetches, window=None, time_col: Optional[str] = None,
                   watermark_delay: float = 0.0,
-                  max_state_rows: Optional[int] = None):
+                  max_state_rows: Optional[int] = None, mesh=None):
         """Incremental keyed aggregation over the stream: ``fetches`` is
         a ``{column: combiner-name}`` mapping (sum/min/max/prod — the
         monoid set ``aggregate`` and ``daggregate`` serve), combined
@@ -164,13 +164,17 @@ class GroupedStream:
         (:func:`~.aggregate.tumbling` / :func:`~.aggregate.sliding`)
         plus ``time_col`` enable windowing; ``watermark_delay`` is the
         allowed event-time lateness before a window emits and evicts.
+        ``mesh=`` (a :class:`~..parallel.mesh.DeviceMesh`) scales the
+        per-batch fold past one device: each batch's partial tables
+        compute as ONE fused GSPMD program over the mesh's data axis
+        (the ``daggregate`` fragment — ``docs/plan.md``).
         Returns a :class:`~.aggregate.StreamingAggregation`; call
         ``.start()`` on it. See ``docs/streaming.md``."""
         from .aggregate import StreamingAggregation
         return StreamingAggregation(
             self.frame, self.keys, fetches, window=window,
             time_col=time_col, watermark_delay=watermark_delay,
-            max_state_rows=max_state_rows)
+            max_state_rows=max_state_rows, mesh=mesh)
 
     def __repr__(self):
         return f"GroupedStream(keys={self.keys}, frame={self.frame!r})"

@@ -87,6 +87,13 @@ def pytest_configure(config):
                    "estimates (run-tests.sh --plan runs this lane "
                    "standalone)")
     config.addinivalue_line(
+        "markers", "dplan: distributed logical-plan suite — lazy d-op "
+                   "chains fused into one GSPMD program per mesh stage, "
+                   "bit-identity vs TFT_FUSE=0, folded reductions, "
+                   "elastic recovery through fused programs, "
+                   "resident-shard-edge spills (run-tests.sh --dplan "
+                   "runs this lane standalone)")
+    config.addinivalue_line(
         "markers", "timing: wall-clock-sensitive deadline assertions — "
                    "margins are widened for loaded machines "
                    "(TFT_TIMING_MARGIN multiplies the bounds; "

@@ -1,0 +1,643 @@
+"""Distributed logical plan (``tensorframes_tpu/plan/dist.py``):
+lazy d-op chains fused into ONE GSPMD program per mesh stage.
+
+The acceptance spine: every chain shape recorded on a lazy frame
+(``frame.lazy()``) collects BIT-IDENTICAL to the same chain run through
+the eager per-op d-ops (which is also exactly what ``TFT_FUSE=0``
+executes), with one mesh dispatch instead of one per op and zero
+inter-op host transfers; terminal monoid ``dreduce_blocks`` /
+``daggregate`` fold into the same program; an injected ``device:1``
+loss mid-fused-stage shrinks/reshards/re-runs correctly; ledger
+pressure spills resident shard edges that fault back bit-identically.
+Deadline assertions belong in the ``timing`` lane — this suite has
+none by design.
+"""
+
+import numpy as np
+import pytest
+
+import tensorframes_tpu as tft
+from tensorframes_tpu import parallel as par
+from tensorframes_tpu import memory
+from tensorframes_tpu.observability import events as obs_events
+from tensorframes_tpu.parallel import elastic
+from tensorframes_tpu.plan import dist as dplan
+from tensorframes_tpu.plan.nodes import observed_selectivity
+from tensorframes_tpu.resilience import faults
+from tensorframes_tpu.utils import tracing
+from tensorframes_tpu.utils.tracing import counters
+
+pytestmark = pytest.mark.dplan
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    import jax
+    assert len(jax.devices()) == 8, "conftest must provide 8 CPU devices"
+    return par.local_mesh(8)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    counters.reset()
+    faults.reset()
+    elastic._tracker.clear()
+    yield
+    faults.reset()
+    elastic._tracker.clear()
+    tracing.disable()
+    memory._reset()
+
+
+def _frame(n=40, keys=5, strings=False):
+    cols = {"k": (np.arange(n) % keys).astype(np.int64),
+            "x": np.arange(n).astype(np.int64),
+            "f": np.arange(n, dtype=np.float64) * 0.5}
+    if strings:
+        cols["s"] = np.array([f"n{i}" for i in range(n)], object)
+    return tft.frame(cols)
+
+
+def _cols(frame):
+    """Collected columns of a (distributed) frame as exact numpy."""
+    tf = frame.collect_frame()
+    blocks = tf.blocks()
+    out = {}
+    for f in tf.schema:
+        parts = [np.asarray(b.columns[f.name], object)
+                 if not f.dtype.tensor else np.asarray(b.dense(f.name))
+                 for b in blocks]
+        out[f.name] = np.concatenate(parts) if parts else np.empty(0)
+    return out
+
+
+def _assert_identical(got, ref):
+    assert set(got) == set(ref)
+    for n in ref:
+        g, r = got[n], ref[n]
+        assert g.dtype == r.dtype, (n, g.dtype, r.dtype)
+        assert g.shape == r.shape, (n, g.shape, r.shape)
+        if g.dtype == object:
+            assert list(g) == list(r), n
+        else:
+            # bit-identical, not approximately equal
+            assert np.array_equal(g, r), n
+
+
+def _run_chain(chain, dist, lazy: bool):
+    return chain(dist.lazy() if lazy else dist)
+
+
+CHAINS = {
+    "maps": lambda d: par.dmap_blocks(
+        lambda z: {"w": z + 1}, par.dmap_blocks(
+            lambda x: {"z": x * 2}, d)),
+    "map_filter_map": lambda d: par.dmap_blocks(
+        lambda z: {"w": z + 1}, par.dfilter(
+            lambda z: z % 3 == 0, par.dmap_blocks(
+                lambda x: {"z": x * 2}, d))),
+    "filter_first": lambda d: par.dmap_blocks(
+        lambda x: {"z": x + 10}, par.dfilter(lambda x: x % 2 == 0, d)),
+    "multi_filter": lambda d: par.dfilter(
+        lambda x: x < 30, par.dfilter(lambda x: x % 2 == 0, d)),
+    "select_prunes": lambda d: par.dmap_blocks(
+        lambda x: {"z": x * 3}, d).select(["z"]),
+    "float_row_local": lambda d: par.dmap_blocks(
+        lambda f: {"g": f * 1.5 + 0.25}, d),
+    "filter_to_zero": lambda d: par.dmap_blocks(
+        lambda x: {"z": x + 1}, par.dfilter(lambda x: x < 0, d)),
+}
+
+
+# ---------------------------------------------------------------------------
+# fused vs per-op bit-identity
+# ---------------------------------------------------------------------------
+
+class TestEquivalence:
+    @pytest.mark.parametrize("shape", sorted(CHAINS))
+    def test_chain_bit_identical(self, mesh8, shape):
+        dist = par.distribute(_frame(), mesh8)
+        chain = CHAINS[shape]
+        ref = _cols(_run_chain(chain, dist, lazy=False))
+        got = _cols(_run_chain(chain, dist, lazy=True))
+        _assert_identical(got, ref)
+
+    def test_fuse_off_is_the_per_op_path(self, mesh8, monkeypatch):
+        dist = par.distribute(_frame(), mesh8)
+        monkeypatch.setenv("TFT_FUSE", "0")
+        assert dist.lazy() is dist  # the kill switch: no recording at all
+        ref = _cols(CHAINS["map_filter_map"](dist.lazy()))
+        monkeypatch.delenv("TFT_FUSE")
+        got = _cols(CHAINS["map_filter_map"](dist.lazy()))
+        _assert_identical(got, ref)
+
+    def test_string_ride_along_through_fused_filter(self, mesh8):
+        dist = par.distribute(_frame(strings=True), mesh8)
+        chain = CHAINS["map_filter_map"]
+        ref = _cols(chain(dist))
+        got = _cols(chain(dist.lazy()))
+        _assert_identical(got, ref)
+        assert got["s"].dtype == object
+
+    def test_shard_valid_input_frame(self, mesh8):
+        # the chain's SOURCE already carries per-shard validity (a
+        # prior eager dfilter): the fused program masks per shard
+        dist = par.dfilter(lambda x: x % 3 != 1,
+                           par.distribute(_frame(), mesh8))
+        assert dist.shard_valid is not None
+        chain = CHAINS["map_filter_map"]
+        _assert_identical(_cols(chain(dist.lazy())), _cols(chain(dist)))
+
+    def test_empty_shards(self, mesh8):
+        # 3 rows on 8 shards: most shards hold only pad rows
+        dist = par.distribute(_frame(n=3), mesh8)
+        chain = CHAINS["map_filter_map"]
+        _assert_identical(_cols(chain(dist.lazy())), _cols(chain(dist)))
+
+    def test_vector_cells(self, mesh8):
+        df = tft.frame({"x": np.arange(16).astype(np.int64),
+                        "v": np.arange(48, dtype=np.float64)
+                        .reshape(16, 3)})
+        dist = par.distribute(df, mesh8)
+
+        def chain(d):
+            return par.dmap_blocks(
+                lambda m: {"s": m * 2.0},
+                par.dmap_blocks(lambda x, v: {"m": x[:, None] * v}, d))
+
+        _assert_identical(_cols(chain(dist.lazy())), _cols(chain(dist)))
+
+    def test_trim_map_materializes_chain(self, mesh8):
+        # a trim (global) map is not recordable: the pending chain
+        # forces fused, the trim runs eagerly on the resident result
+        dist = par.distribute(_frame(), mesh8)
+        lz = par.dmap_blocks(lambda x: {"z": x * 2}, dist.lazy())
+        out = par.dmap_blocks(lambda z: {"t": z.sum()[None]}, lz,
+                              trim=True)
+        ref = par.dmap_blocks(
+            lambda z: {"t": z.sum()[None]},
+            par.dmap_blocks(lambda x: {"z": x * 2}, dist), trim=True)
+        assert int(out.columns["t"][0]) == int(ref.columns["t"][0])
+
+    def test_record_time_validation_parity(self, mesh8):
+        from tensorframes_tpu.engine import ops as eops
+        dist = par.distribute(_frame(), mesh8)
+        with pytest.raises(ValueError, match="collides"):
+            par.dmap_blocks(lambda x: {"x": x}, dist.lazy())
+        with pytest.raises(KeyError):
+            dist.lazy().select(["nope"])
+        # same error text as the eager op for a predicate naming a
+        # string column (raised at RECORD time, not at force)
+        with pytest.raises(eops.InvalidTypeError, match="non-tensor"):
+            par.dfilter(lambda s: s,
+                        par.distribute(_frame(strings=True),
+                                       mesh8).lazy())
+
+
+# ---------------------------------------------------------------------------
+# folded terminal reductions
+# ---------------------------------------------------------------------------
+
+class TestFoldedReductions:
+    def test_reduce_int_bit_identical(self, mesh8):
+        dist = par.distribute(_frame(), mesh8)
+        fetches = {"x": "sum", "z": "max", "k": "min"}
+        ref = par.dreduce_blocks(
+            fetches, par.dmap_blocks(lambda x: {"z": x * 2}, dist))
+        d0 = counters.get("mesh.dispatches")
+        got = par.dreduce_blocks(
+            fetches, par.dmap_blocks(lambda x: {"z": x * 2},
+                                     dist.lazy()))
+        assert counters.get("mesh.dispatches") - d0 == 1
+        for n in fetches:
+            assert got[n].dtype == ref[n].dtype
+            assert np.array_equal(got[n], ref[n]), n
+
+    def test_reduce_after_filter(self, mesh8):
+        dist = par.distribute(_frame(), mesh8)
+
+        def chain(d):
+            return par.dfilter(lambda x: x % 2 == 0, d)
+
+        ref = par.dreduce_blocks({"x": "sum"}, chain(dist))
+        got = par.dreduce_blocks({"x": "sum"}, chain(dist.lazy()))
+        assert np.array_equal(got["x"], ref["x"])
+
+    def test_reduce_empty_after_filter_raises(self, mesh8):
+        dist = par.distribute(_frame(), mesh8)
+        lz = par.dfilter(lambda x: x < 0, dist.lazy())
+        with pytest.raises(ValueError, match="empty"):
+            par.dreduce_blocks({"x": "sum"}, lz)
+
+    def test_reduce_unknown_column_and_combiner(self, mesh8):
+        dist = par.distribute(_frame(), mesh8)
+        lz = par.dmap_blocks(lambda x: {"z": x + 1}, dist.lazy())
+        with pytest.raises(KeyError, match="No column"):
+            par.dreduce_blocks({"nope": "sum"}, lz)
+        with pytest.raises(KeyError, match="Unknown combiner"):
+            par.dreduce_blocks({"x": "median"}, lz)
+
+    def test_generic_reduce_materializes(self, mesh8):
+        dist = par.distribute(_frame(), mesh8)
+
+        def combine(x_input):
+            return {"x": x_input.sum(axis=0)}
+
+        ref = par.dreduce_blocks(
+            combine, par.dmap_blocks(lambda x: {"z": x * 2}, dist)
+            .select(["x"]))
+        got = par.dreduce_blocks(
+            combine, par.dmap_blocks(lambda x: {"z": x * 2},
+                                     dist.lazy()).select(["x"]))
+        assert np.array_equal(got["x"], ref["x"])
+
+    def test_aggregate_folded_matches_eager(self, mesh8):
+        dist = par.distribute(_frame(), mesh8)
+
+        def chain(d):
+            return par.dmap_blocks(lambda x: {"v": x * 3},
+                                   d).select(["k", "v"])
+
+        ref = par.daggregate({"v": "sum"}, chain(dist), "k")
+        d0 = counters.get("mesh.dispatches")
+        got = par.daggregate({"v": "sum"}, chain(dist.lazy()), "k")
+        assert counters.get("mesh.dispatches") - d0 == 1
+        assert got.collect() == ref.collect()
+
+    def test_aggregate_with_filter_falls_back_correctly(self, mesh8):
+        # a filter invalidates the source key->id layout: the chain
+        # forces fused, the aggregation runs eagerly on the result
+        dist = par.distribute(_frame(), mesh8)
+
+        def chain(d):
+            return par.dfilter(lambda x: x % 2 == 0, d)
+
+        ref = par.daggregate({"x": "sum"}, chain(dist), "k")
+        got = par.daggregate({"x": "sum"}, chain(dist.lazy()), "k")
+        assert got.collect() == ref.collect()
+
+    def test_aggregate_hot_key_salted_exact(self, mesh8):
+        n = 64
+        k = np.zeros(n, np.int64)
+        k[: n // 4] = np.arange(n // 4) % 3 + 1  # one dominant key 0
+        df = tft.frame({"k": k, "x": np.arange(n).astype(np.int64)})
+        dist = par.distribute(df, mesh8)
+        lz = par.dmap_blocks(lambda x: {"v": x + 1}, dist.lazy()) \
+            .select(["k", "v"])
+        got = par.daggregate({"v": "sum"}, lz, "k")
+        ref = par.daggregate(
+            {"v": "sum"},
+            par.dmap_blocks(lambda x: {"v": x + 1}, dist)
+            .select(["k", "v"]), "k")
+        assert got.collect() == ref.collect()
+        assert counters.get("mesh.salted_keys") >= 1
+
+
+# ---------------------------------------------------------------------------
+# laziness, dispatch counts, host transfers
+# ---------------------------------------------------------------------------
+
+class TestLaziness:
+    def test_recording_does_not_dispatch(self, mesh8):
+        dist = par.distribute(_frame(), mesh8)
+        d0 = counters.get("mesh.dispatches")
+        lz = CHAINS["map_filter_map"](dist.lazy())
+        assert counters.get("mesh.dispatches") == d0
+        lz.collect_frame()
+        assert counters.get("mesh.dispatches") == d0 + 1
+
+    def test_at_least_4x_fewer_dispatches(self, mesh8):
+        dist = par.distribute(_frame(), mesh8)
+
+        def four_op(d):
+            d = par.dmap_blocks(lambda x: {"z": x * 2}, d)
+            d = par.dfilter(lambda z: z % 3 == 0, d)
+            d = par.dmap_blocks(lambda z: {"w": z + 1}, d)
+            return par.dreduce_blocks({"w": "sum"}, d)
+
+        d0 = counters.get("mesh.dispatches")
+        ref = four_op(dist)
+        eager_n = counters.get("mesh.dispatches") - d0
+        d1 = counters.get("mesh.dispatches")
+        got = four_op(dist.lazy())
+        fused_n = counters.get("mesh.dispatches") - d1
+        assert np.array_equal(got["w"], ref["w"])
+        assert eager_n == 4
+        assert fused_n == 1  # >= 4x fewer (the acceptance bar is 2x)
+
+    def test_zero_interstage_host_bytes_when_fused(self, mesh8):
+        dist = par.distribute(_frame(), mesh8)
+        chain = CHAINS["map_filter_map"]
+        h0 = counters.get("mesh.interstage_host_bytes")
+        chain(dist).collect_frame()
+        eager_bytes = counters.get("mesh.interstage_host_bytes") - h0
+        h1 = counters.get("mesh.interstage_host_bytes")
+        chain(dist.lazy()).collect_frame()
+        fused_bytes = counters.get("mesh.interstage_host_bytes") - h1
+        assert eager_bytes > 0      # dfilter's counts readback
+        assert fused_bytes == 0     # counts stay traced in-program
+
+    # stable fetch objects: computations (and therefore fused
+    # programs) cache per fetches object, like every per-op path —
+    # a chain rebuilt from the same callables re-dispatches one
+    # compiled program
+    _mk = staticmethod(lambda x: {"z": x * 2})
+    _fl = staticmethod(lambda z: z % 3 == 0)
+    _mk2 = staticmethod(lambda z: {"w": z + 1})
+
+    def test_program_cache_hit_on_reforcing(self, mesh8):
+        dist = par.distribute(_frame(), mesh8)
+
+        def chain(d):
+            return par.dmap_blocks(
+                TestLaziness._mk2, par.dfilter(
+                    TestLaziness._fl, par.dmap_blocks(
+                        TestLaziness._mk, d)))
+
+        chain(dist.lazy()).collect_frame()
+        built = counters.get("dplan.fused_programs")
+        chain(dist.lazy()).collect_frame()  # same comps, same shapes
+        assert counters.get("dplan.fused_programs") == built
+
+    def test_resident_passthrough_skips_program_io(self, mesh8):
+        # a map-only chain's untouched column chains buffer-to-buffer:
+        # the SAME device array object, no copy, no repartition
+        dist = par.distribute(_frame(), mesh8)
+        lz = par.dmap_blocks(lambda x: {"z": x * 2}, dist.lazy())
+        assert lz.columns["k"] is dist.columns["k"]
+
+
+# ---------------------------------------------------------------------------
+# elastic recovery through fused programs
+# ---------------------------------------------------------------------------
+
+class TestElastic:
+    def test_device_loss_mid_fused_stage(self, mesh8):
+        dist = par.distribute(_frame(), mesh8)
+        chain = CHAINS["map_filter_map"]
+        ref = _cols(chain(dist))
+        lz = chain(dist.lazy())
+        tracing.enable()
+        try:
+            with faults.inject("device", 1):
+                lz.count()  # forces mid-inject: the loss hits the
+                #             fused dispatch boundary
+            t = obs_events.last_query()
+        finally:
+            tracing.disable()
+        _assert_identical(_cols(lz), ref)
+        assert lz.mesh.num_devices == 7
+        assert counters.get("mesh.devices_lost") == 1
+        assert counters.get("mesh.reshard_rows") > 0
+        assert t is not None and t.op == "dfused"
+        shr = [e for e in t.events if e.etype == "mesh_shrink"]
+        assert len(shr) == 1 and shr[0].args["devices_after"] == 7
+
+    def test_device_loss_on_folded_reduce(self, mesh8):
+        dist = par.distribute(_frame(), mesh8)
+        lz = par.dmap_blocks(lambda x: {"z": x * 2}, dist.lazy())
+        ref = par.dreduce_blocks(
+            {"z": "sum"}, par.dmap_blocks(lambda x: {"z": x * 2}, dist))
+        with faults.inject("device", 1):
+            got = par.dreduce_blocks({"z": "sum"}, lz)
+        assert np.array_equal(got["z"], ref["z"])
+        assert counters.get("mesh.devices_lost") == 1
+
+    def test_elastic_disabled_raises(self, mesh8, monkeypatch):
+        monkeypatch.setenv("TFT_ELASTIC", "0")
+        dist = par.distribute(_frame(), mesh8)
+        lz = CHAINS["maps"](dist.lazy())
+        with faults.inject("device", 1):
+            with pytest.raises(faults.InjectedFault):
+                lz.collect_frame()
+
+
+# ---------------------------------------------------------------------------
+# fallbacks
+# ---------------------------------------------------------------------------
+
+class TestFallback:
+    def test_permanent_fault_replays_per_op(self, mesh8):
+        dist = par.distribute(_frame(), mesh8)
+        chain = CHAINS["map_filter_map"]
+        ref = _cols(chain(dist))
+        lz = chain(dist.lazy())
+        f0 = counters.get("dplan.fallbacks")
+        with faults.inject("dmap", 1, transient=False):
+            got = _cols(lz)
+        _assert_identical(got, ref)
+        assert counters.get("dplan.fallbacks") == f0 + 1
+
+    def test_transient_fault_retries_through_fused(self, mesh8):
+        dist = par.distribute(_frame(), mesh8)
+        chain = CHAINS["maps"]
+        ref = _cols(chain(dist))
+        lz = chain(dist.lazy())
+        f0 = counters.get("dplan.fallbacks")
+        with faults.inject("dmap", 1):  # transient: the policy retries
+            got = _cols(lz)
+        _assert_identical(got, ref)
+        assert counters.get("dplan.fallbacks") == f0  # no fallback
+
+    def test_fuse_disabled_after_recording_replays(self, mesh8,
+                                                   monkeypatch):
+        dist = par.distribute(_frame(), mesh8)
+        lz = CHAINS["map_filter_map"](dist.lazy())
+        monkeypatch.setenv("TFT_FUSE", "0")  # flipped between record
+        got = _cols(lz)                      # and force
+        monkeypatch.delenv("TFT_FUSE")
+        _assert_identical(got, _cols(CHAINS["map_filter_map"](dist)))
+
+
+# ---------------------------------------------------------------------------
+# memory ledger: resident shard edges
+# ---------------------------------------------------------------------------
+
+class TestLedger:
+    def test_resident_edges_spill_and_fault_back(self, mesh8):
+        memory.configure(limit_bytes=10 ** 9)
+        dist = par.distribute(
+            tft.frame({"x": np.arange(256, dtype=np.float64)}), mesh8)
+        lz = par.dmap_blocks(lambda x: {"z": x + 1.0},
+                             dist.lazy()).select(["z"])
+        ref = _cols(lz)
+        cols = lz.columns
+        assert type(cols).__name__ == "SpillableColumns"
+        freed = cols.mem_spill()   # ledger-driven spill of the edge
+        assert freed > 0
+        _assert_identical(_cols(lz), ref)  # fault-back bit-identical
+
+    def test_passthrough_result_not_double_registered(self, mesh8):
+        # a map-only chain's untouched column IS the source's device
+        # buffer: wrapping the result in a second spillable would
+        # double-count those bytes in the ledger, so the result stays
+        # a plain dict (the source's own registration covers it)
+        memory.configure(limit_bytes=10 ** 9)
+        dist = par.distribute(
+            tft.frame({"x": np.arange(64, dtype=np.float64)}), mesh8)
+        lz = par.dmap_blocks(lambda x: {"z": x + 1.0}, dist.lazy())
+        assert type(lz.columns).__name__ != "SpillableColumns"
+        assert lz.columns["x"] is dist.columns["x"]
+
+    def test_ledger_pressure_spills_fused_result(self, mesh8):
+        # process-wide admission pressure pushes the forced fused
+        # result (a registered resident) out through the ledger LRU;
+        # the next collect faults it back bit-identically
+        mgr = memory.configure(limit_bytes=100_000)
+        dist = par.distribute(
+            tft.frame({"x": np.arange(512, dtype=np.float64)}), mesh8)
+        lz = par.dmap_blocks(lambda x: {"z": x + 1.0},
+                             dist.lazy()).select(["z"])
+        ref = _cols(lz)
+        s0 = counters.get("memory.spills")
+        mgr.make_room(10 ** 9)  # an admission squeeze spills residents
+        assert counters.get("memory.spills") > s0
+        assert lz.columns.mem_is_spilled()
+        _assert_identical(_cols(lz), ref)
+
+    def test_lazy_estimate_without_forcing(self, mesh8):
+        from tensorframes_tpu.memory.estimate import dist_frame_estimate
+        dist = par.distribute(_frame(), mesh8)
+        lz = par.dmap_blocks(lambda f: {"g": f * 2.0}, dist.lazy())
+        rows, nbytes = dist_frame_estimate(lz)
+        assert lz._forced is None  # estimating must not force
+        assert rows == 40
+        assert nbytes is not None and nbytes > 0
+
+
+# ---------------------------------------------------------------------------
+# feedback selectivity (ROADMAP 2a, first slice)
+# ---------------------------------------------------------------------------
+
+class TestFeedbackSelectivity:
+    def test_dfilter_records_observed_selectivity(self, mesh8):
+        dist = par.distribute(_frame(n=60, keys=6), mesh8)
+        pred = lambda x: x % 3 == 0  # noqa: E731 - the shared predicate
+        from tensorframes_tpu.engine.ops import _filter_computation
+        comp = _filter_computation(pred, dist.schema)
+        assert observed_selectivity(comp) is None
+        par.dfilter(pred, dist)
+        sel = observed_selectivity(comp)
+        assert sel is not None and abs(sel - 1 / 3) < 0.05
+
+    def test_fused_filter_records_and_estimates_sharpen(self, mesh8):
+        dist = par.distribute(_frame(n=64, keys=4), mesh8)
+        pred = lambda x: x % 4 == 0  # noqa: E731
+        lz = par.dfilter(pred, dist.lazy())
+        up_rows, _ = lz._dplan_node.estimate()
+        assert up_rows == 64  # upper bound before any observation
+        lz.collect_frame()    # the forcing observes rows-in/rows-out
+        lz2 = par.dfilter(pred, dist.lazy())
+        rows, _ = lz2._dplan_node.estimate()
+        assert rows is not None and rows == pytest.approx(16, rel=0.05)
+
+    def test_single_device_filter_node_sharpens(self):
+        df = tft.frame({"x": np.arange(100, dtype=np.float64)})
+        pred = lambda x: x < 25.0  # noqa: E731
+        f1 = df.filter(pred)
+        r_up, _ = f1._plan_node.estimate()
+        assert r_up == 100
+        f1.blocks()  # force: observes selectivity 0.25
+        f2 = df.filter(pred)
+        r_obs, _ = f2._plan_node.estimate()
+        assert r_obs == pytest.approx(25, rel=0.05)
+
+    def test_downstream_cached_estimate_sharpens_too(self):
+        # the epoch-keyed estimate cache: a node DOWNSTREAM of the
+        # filter, whose estimate was cached before the observation,
+        # re-prices after it (admission must not keep the upper bound
+        # forever)
+        df = tft.frame({"x": np.arange(100, dtype=np.float64)})
+        pred = lambda x: x < 10.0  # noqa: E731
+        chain = df.filter(pred).map_blocks(lambda x: {"z": x * 2.0})
+        r_before, _ = chain._plan_node.estimate()  # caches upper bound
+        assert r_before == 100
+        df.filter(pred).blocks()  # observe selectivity 0.1 elsewhere
+        r_after, _ = chain._plan_node.estimate()
+        assert r_after == pytest.approx(10, rel=0.05)
+
+    def test_record_time_row_aligned_error(self, mesh8):
+        # the bad-argument error fires at RECORD time without
+        # executing the pending chain first
+        dist = par.distribute(_frame(), mesh8)
+        lz = par.dmap_blocks(lambda x: {"z": x + 1}, dist.lazy())
+        with pytest.raises(ValueError, match="row_aligned=False"):
+            par.dmap_blocks(lambda z: {"w": z}, lz, row_aligned=False)
+        assert lz._forced is None  # nothing ran
+
+
+# ---------------------------------------------------------------------------
+# explain / observability
+# ---------------------------------------------------------------------------
+
+class TestExplain:
+    def test_lazy_explain_renders_plan_section(self, mesh8):
+        dist = par.distribute(_frame(), mesh8)
+        lz = CHAINS["map_filter_map"](dist.lazy())
+        text = lz.explain()
+        assert "dplan" in text
+        assert "1 fused GSPMD program" in text
+        assert "compacted in-program" in text
+
+    def test_fuse_off_explain_names_the_reason(self, mesh8,
+                                               monkeypatch):
+        dist = par.distribute(_frame(), mesh8)
+        lz = CHAINS["maps"](dist.lazy())
+        monkeypatch.setenv("TFT_FUSE", "0")
+        text = lz.explain()
+        assert "TFT_FUSE=0" in text
+
+    def test_trace_report_shows_fused_stage(self, mesh8):
+        from tensorframes_tpu.observability.report import render
+        dist = par.distribute(_frame(), mesh8)
+        lz = CHAINS["map_filter_map"](dist.lazy())
+        tracing.enable()
+        try:
+            lz.collect_frame()
+        finally:
+            tracing.disable()
+        t = obs_events.last_query()
+        assert t is not None and t.op == "dfused"
+        text = render(t)
+        assert "ONE GSPMD program" in text
+
+
+# ---------------------------------------------------------------------------
+# distributed streams on the mesh
+# ---------------------------------------------------------------------------
+
+class TestStreamMesh:
+    def _run(self, mesh):
+        from tensorframes_tpu import stream
+
+        def gen():
+            for i in range(8):
+                yield {"k": (np.arange(8) % 2).astype(np.int64),
+                       "v": (np.arange(8) + i).astype(np.int64),
+                       "ts": np.full(8, float(i))}
+
+        agg = (stream.from_source(stream.GeneratorSource(gen()))
+               .group_by("k")
+               .aggregate({"v": "sum"}, window=stream.tumbling(4.0),
+                          time_col="ts", mesh=mesh))
+        h = agg.start()
+        rows = []
+        while not h.done():
+            h.step()
+            for f in h.collect_updates():
+                rows.extend(f.collect())
+        return rows
+
+    def test_windowed_stream_on_mesh_matches_single_device(self, mesh8):
+        ref = self._run(None)
+        m0 = counters.get("stream.mesh_folds")
+        got = self._run(mesh8)
+        assert counters.get("stream.mesh_folds") > m0
+        assert got == ref  # integer sums: exact across shard counts
+
+    def test_one_fused_dispatch_per_batch_fold(self, mesh8):
+        d0 = counters.get("mesh.dispatches")
+        m0 = counters.get("stream.mesh_folds")
+        self._run(mesh8)
+        folds = counters.get("stream.mesh_folds") - m0
+        assert counters.get("mesh.dispatches") - d0 == folds
