@@ -93,6 +93,18 @@ if [ "${1:-}" = "--dplan" ]; then
   exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m dplan "$@"
 fi
 
+# --join: run only the relational lane (tests/test_relational.py:
+# broadcast/sort-merge joins vs the CPU host oracle, ledger-chunked
+# builds, device-loss recovery, sketch error bounds through
+# aggregate/daggregate/streams, parquet predicate pushdown, hot keys)
+# — fast, CPU-only (8 virtual devices via conftest), no native build
+if [ "${1:-}" = "--join" ]; then
+  shift
+  echo "== relational lane (pytest -m 'join or sketch', CPU) =="
+  exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'join or sketch' "$@"
+fi
+
 # --timing: run only the wall-clock-sensitive deadline tests, serially
 # (they flake under concurrent suite load; TFT_TIMING_MARGIN widens
 # their assertion bounds further on badly oversubscribed boxes)

@@ -44,8 +44,11 @@ from .api import (
 from . import builder
 from . import io
 from . import memory
+from . import relational
 from . import serve
 from . import stream
+from .relational import (approx_distinct, approx_quantile, approx_top_k,
+                         join)
 from .serve import serve_report
 
 __all__ = [
@@ -81,6 +84,11 @@ __all__ = [
     "last_query_report",
     "dump_stats",
     "memory",
+    "relational",
+    "join",
+    "approx_distinct",
+    "approx_quantile",
+    "approx_top_k",
     "serve",
     "submit",
     "serve_report",

@@ -848,11 +848,29 @@ def _fact_from_global_ids(ids: np.ndarray) -> KeyFactorization:
                             order, starts)
 
 
+def _is_sketch(c) -> bool:
+    from ..relational.sketch import SketchCombiner
+    return isinstance(c, SketchCombiner)
+
+
+def _monoid_mapping(fetches) -> bool:
+    """True for the ``{column: combiner}`` aggregate form — combiner
+    names (sum/min/max/prod) or :class:`~..relational.sketch
+    .SketchCombiner` instances (approx_distinct / approx_quantile /
+    approx_top_k), freely mixed."""
+    return (isinstance(fetches, Mapping) and bool(fetches)
+            and all(isinstance(v, str) or _is_sketch(v)
+                    for v in fetches.values()))
+
+
 def _validate_monoid_fetches(col_combiners: Mapping[str, str],
                              value_names: Sequence[str],
-                             drop_hint: str) -> None:
-    """Shared checks for the {column: combiner-name} aggregate form (host
-    and mesh paths raise identical exceptions)."""
+                             drop_hint: str,
+                             schema: Optional[Schema] = None) -> None:
+    """Shared checks for the {column: combiner} aggregate form (host
+    and mesh paths raise identical exceptions). Combiners are scalar
+    names or sketch combiners; ``schema`` (when given) lets sketches
+    validate their input column."""
     from ..parallel.collectives import COMBINERS as _known
     unknown = sorted(set(col_combiners) - set(value_names))
     if unknown:
@@ -868,10 +886,15 @@ def _validate_monoid_fetches(col_combiners: Mapping[str, str],
             "Columns %s are not consumed by the aggregation and will be "
             "ignored (drop them %s to silence this)", unused, drop_hint)
     for name, cname in col_combiners.items():
+        if _is_sketch(cname):
+            if schema is not None:
+                cname.validate_input(schema[name])
+            continue
         if cname not in _known:
             raise ValueError(
                 f"Unknown combiner {cname!r} for {name!r}; known: "
-                f"{sorted(_known)}")
+                f"{sorted(_known)} (or a relational sketch combiner — "
+                f"approx_distinct/approx_quantile/approx_top_k)")
 
 
 # Segment-reduce implementations for the monoid combiner names (the same
@@ -895,12 +918,19 @@ def _monoid_aggregate(col_combiners: Mapping[str, str],
     """Keyed aggregation for the associative monoids: key→dense-id
     factorization on the host, then ONE segment-reduce launch per fetch
     column — O(1) device dispatches regardless of the number of groups,
-    where the generic compaction path pays O(groups)."""
+    where the generic compaction path pays O(groups).
+
+    Sketch combiners (``relational.sketch``) ride the same structure:
+    per-block partial STATE tables (group ids shared with the scalar
+    columns; HLL registers / quantile bucket counts fold through the
+    same segment kernels) combined across blocks with the sketch's own
+    monoid, finalized into estimate columns at the end.
+    """
     df = grouped.frame
     keys = grouped.keys
     value_names = [n for n in df.schema.names if n not in keys]
     _validate_monoid_fetches(col_combiners, value_names,
-                             "with select() first")
+                             "with select() first", schema=df.schema)
 
     blocks = df.blocks()
     for b in blocks:
@@ -909,17 +939,30 @@ def _monoid_aggregate(col_combiners: Mapping[str, str],
                 raise InvalidTypeError(
                     f"Key column {k!r} must be scalar-typed")
     fetch_names = sorted(col_combiners)
-    out_fields = [df.schema[k] for k in keys] + [
-        Field(f, df.schema[f].dtype,
-              block_shape=_field_spec(df.schema[f], True, "aggregate")
-              .with_lead(Unknown),
-              sql_rank=df.schema[f].sql_rank)
-        for f in fetch_names]
+    scalar_names = [f for f in fetch_names
+                    if not _is_sketch(col_combiners[f])]
+    sketch_names = [f for f in fetch_names
+                    if _is_sketch(col_combiners[f])]
+    out_fields = [df.schema[k] for k in keys]
+    for f in fetch_names:
+        if f in sketch_names:
+            out_fields.extend(
+                col_combiners[f].out_fields(f, df.schema[f]))
+        else:
+            out_fields.append(Field(
+                f, df.schema[f].dtype,
+                block_shape=_field_spec(df.schema[f], True, "aggregate")
+                .with_lead(Unknown),
+                sql_rank=df.schema[f].sql_rank))
     n = sum(b.num_rows for b in blocks)
     if n == 0:
         return TensorFrame.from_blocks(
-            [Block({f.name: np.empty((0,), f.dtype.np_storage)
-                    for f in out_fields}, 0)], Schema(out_fields))
+            [Block({f.name: np.empty(
+                (0,) + tuple(d for d in (f.cell_shape.dims
+                                         if f.cell_shape else ())
+                             if d != Unknown),
+                f.dtype.np_storage) for f in out_fields}, 0)],
+            Schema(out_fields))
 
     # blockwise: per-block segment-reduce partials combined with the
     # monoid — the frame is never concatenated (bounded host memory)
@@ -929,8 +972,30 @@ def _monoid_aggregate(col_combiners: Mapping[str, str],
                   "min": np.minimum, "max": np.maximum}
     cols: Dict[str, np.ndarray] = {k: u for k, u in zip(keys, uniques)}
     mem_mgr = _memory.active()
+    with span("aggregate.sketch_fold"):
+        for f in sketch_names:
+            sk = col_combiners[f]
+            table = None
+            for b, ids in zip(blocks, ids_blocks):
+                if b.num_rows == 0:
+                    continue
+                vals = np.asarray(b.columns[f])
+                mem_tok = (mem_mgr.reserve(
+                    2 * int(vals.nbytes) + int(ids.nbytes),
+                    op="aggregate.sketch_fold")
+                    if mem_mgr is not None else 0)
+                try:
+                    part = sk.block_partial(vals, ids, num_groups)
+                finally:
+                    if mem_tok:
+                        mem_mgr.release(mem_tok)
+                table = part if table is None \
+                    else sk.combine_np(table, part)
+            from ..utils.tracing import counters as _counters
+            _counters.inc("relational.sketch_folds")
+            cols.update(sk.finalize(f, table))
     with span("aggregate.segment_reduce"):
-        for f in fetch_names:
+        for f in scalar_names:
             field = df.schema[f]
             dd = _dt.device_dtype(field.dtype)
             out = None
@@ -1137,8 +1202,7 @@ def aggregate(fetches: Fetches, grouped: GroupedFrame,
       the UDAF buffered-compaction contract (buffer_size=10 by default,
       ``DebugRowOps.scala:559``).
     """
-    if isinstance(fetches, Mapping) and fetches and all(
-            isinstance(v, str) for v in fetches.values()):
+    if _monoid_mapping(fetches):
         return _monoid_aggregate(fetches, grouped)
     ex = executor or default_executor()
     # the single-program fold runs comp.fn under in-process jax.jit, so it

@@ -508,6 +508,27 @@ class TensorFrame:
         from . import api
         return api.filter_rows(predicate, self, executor=executor)
 
+    def join(self, other: "TensorFrame", on, how: str = "inner",
+             strategy: Optional[str] = None, mesh=None,
+             indicator: Optional[str] = None) -> "TensorFrame":
+        """Join this frame against ``other`` (lazy). Strategies: a
+        broadcast hash join for small build sides (default), or a mesh
+        sort-merge join for large-large (``strategy="sort_merge"`` /
+        auto when ``mesh=`` is given and the build side is big). See
+        ``docs/joins.md``."""
+        from .relational.join import join as _join
+        return _join(self, other, on, how=how, strategy=strategy,
+                     mesh=mesh, indicator=indicator)
+
+    def hot_keys(self) -> List[Dict]:
+        """The hot-key observations recorded when this frame was
+        produced by a salted ``daggregate`` (eager or fused): one dict
+        per hot group — ``{"keys": {col: value}, "fraction":
+        observed-row-fraction, "salt_slots": K}``. Empty for frames no
+        salting touched. The same observations feed the top-k sketch
+        and render as an ``explain()`` line (``docs/joins.md``)."""
+        return list(getattr(self, "_hot_keys", ()) or ())
+
     def submit(self, fetches=None, *, tenant: str = "default",
                deadline: Optional[float] = None, **kwargs):
         """Defer this frame's forcing to the multi-tenant query

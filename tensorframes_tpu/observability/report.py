@@ -178,7 +178,20 @@ def frame_report(df) -> str:
         # or when the chain fell back to the per-op path
         info = getattr(df, "_plan_info", None)
         if info:
-            return report + "\n" + "\n".join(info)
+            report = report + "\n" + "\n".join(info)
+        hot = getattr(df, "_hot_keys", None)
+        if hot:
+            # hot-key observations from the producing daggregate's
+            # salting (docs/joins.md): which keys were skewed enough to
+            # trigger it, and how hot they ran
+            for h in hot:
+                kv = ", ".join(f"{k}={v!r}"
+                               for k, v in h["keys"].items())
+                frac = (f"{h['fraction']:.0%} of rows"
+                        if h.get("fraction") is not None else "hot")
+                report += (f"\n  hot key  : {{{kv}}} — {frac}, salted "
+                           f"across {h['salt_slots']} slot(s) "
+                           f"(frame.hot_keys())")
         return report
 
     t = getattr(df, "_trace", None)

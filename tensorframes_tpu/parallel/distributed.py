@@ -1885,10 +1885,12 @@ def _daggregate(fetches, dist: DistributedFrame, keys,
     for k in keys:
         if k not in schema:
             raise KeyError(f"No key column {k!r}; columns: {schema.names}")
-    if not (isinstance(fetches, Mapping) and fetches and all(
-            isinstance(v, str) for v in fetches.values())):
+    from ..engine.ops import _is_sketch, _monoid_mapping
+    if not _monoid_mapping(fetches):
         return _generic_daggregate(fetches, dist, keys,
                                    max_groups=max_groups)
+    if any(_is_sketch(v) for v in fetches.values()):
+        return _daggregate_sketch(fetches, dist, keys, max_groups)
     col_combiners = fetches
 
     from ..engine.ops import _validate_monoid_fetches
@@ -1989,8 +1991,118 @@ def _daggregate(fetches, dist: DistributedFrame, keys,
     else:
         key_cols = {k: u for k, u in zip(keys, uniques)}
         num_out = num_groups
-    return _monoid_agg_result(schema, keys, fetch_names, tables,
-                              key_cols, num_out)
+    out = _monoid_agg_result(schema, keys, fetch_names, tables,
+                             key_cols, num_out)
+    if salt_plan is not None:
+        attach_hot_keys(out, keys, uniques, salt_plan)
+    return out
+
+
+def attach_hot_keys(frame: TensorFrame, keys, uniques,
+                    salt_plan) -> None:
+    """Record the hot-key OBSERVATIONS that triggered salting on the
+    result frame — the public surface is ``frame.hot_keys()`` and an
+    ``explain()`` line (the PR 7 salting decisions were previously
+    visible only as counters/log lines). Shared by the eager
+    ``_daggregate`` and the fused distributed plan's folded daggregate.
+    """
+    hot, K = salt_plan[2]
+    fracs = salt_plan[3] if len(salt_plan) > 3 else None
+    records = []
+    for j, g in enumerate(hot):
+        kv = {}
+        for k, u in zip(keys, uniques):
+            v = u[int(g)]
+            kv[k] = v.item() if hasattr(v, "item") else v
+        records.append({
+            "keys": kv,
+            "fraction": (float(fracs[j]) if fracs is not None
+                         else None),
+            "salt_slots": int(K),
+        })
+    frame._hot_keys = records
+
+
+def _daggregate_sketch(fetches, dist: DistributedFrame, keys,
+                       max_groups: Optional[int]) -> TensorFrame:
+    """The sketch half of a mesh aggregation (``docs/joins.md``).
+
+    Sketch combiners hash/bucket on the HOST in float64 (the
+    determinism contract that makes aggregate == daggregate == stream
+    bit-identical), so their partials fold from the host copies of the
+    value columns — read per shard layout under the surrounding
+    ``elastic_call`` (a device lost mid-read shrinks/reshards/retries
+    like any mesh op). Scalar combiners mixed into the same mapping
+    keep the full device segment-reduce + collective path; both halves
+    share ONE cached group factorization, so their group order is
+    identical by construction.
+    """
+    from ..engine.ops import (_is_sketch, _validate_monoid_fetches)
+    from ..schema import Schema as _Schema
+
+    schema = dist.schema
+    if max_groups is not None:
+        raise ValueError(
+            "max_groups= (device-side group ids) does not compose with "
+            "sketch combiners — sketches hash on the host; drop "
+            "max_groups or the sketch fetches")
+    value_names = [n for n in schema.names if n not in keys]
+    _validate_monoid_fetches(fetches, value_names,
+                             "before distribute()", schema=schema)
+    if dist.num_rows == 0:
+        raise ValueError("aggregate on an empty distributed frame")
+    scalars = {f: c for f, c in fetches.items() if not _is_sketch(c)}
+    sketches = {f: c for f, c in fetches.items() if _is_sketch(c)}
+
+    ids_dev, uniques, num_groups, salt_plan = _monoid_group_plan(
+        dist, keys)
+    # the scalar half sees only its own columns (no spurious
+    # ride-along warnings about the sketch fetches); the group order
+    # is identical by construction — same key data, same deterministic
+    # host factorization
+    scalar_out = (_daggregate(
+        scalars, dist.select(list(keys) + sorted(scalars)), keys, None)
+        if scalars else None)
+
+    ids_host = np.asarray(ids_dev)
+    valid = ids_host >= 0
+    ids = ids_host[valid].astype(np.int64)
+    mask = dist.valid_row_mask()
+    sketch_cols: Dict[str, np.ndarray] = {}
+    with span("daggregate.sketch_fold"):
+        for f in sorted(sketches):
+            sk = sketches[f]
+            a = _memory.host_value(dist.columns, f)
+            vals = a[mask] if dist.shard_valid is not None \
+                else a[: dist.num_rows]
+            table = sk.block_partial(np.asarray(vals), ids, num_groups)
+            counters.inc("relational.sketch_folds")
+            sketch_cols.update(sk.finalize(f, table))
+
+    # assemble: keys + sorted fetch columns (sketch multi-outputs
+    # inline after their fetch name)
+    out_fields = [schema[k] for k in keys]
+    cols: Dict[str, np.ndarray] = {}
+    if scalar_out is not None:
+        sb = Block.concat(scalar_out.blocks(), scalar_out.schema)
+        for k in keys:
+            cols[k] = sb.columns[k]
+    else:
+        for k, u in zip(keys, uniques):
+            cols[k] = np.asarray(u)
+    for f in sorted(fetches):
+        if f in sketches:
+            for fld in sketches[f].out_fields(f, schema[f]):
+                out_fields.append(fld)
+                cols[fld.name] = sketch_cols[fld.name]
+        else:
+            out_fields.append(scalar_out.schema[f])
+            cols[f] = sb.columns[f]
+    out = TensorFrame.from_blocks(
+        [Block(cols, num_groups)], _Schema(out_fields))
+    if salt_plan is not None:
+        attach_hot_keys(out, keys, uniques, salt_plan)
+    return out
 
 
 def _segmented_fold(comp, names, mesh: DeviceMesh, arrays, ids_dev,

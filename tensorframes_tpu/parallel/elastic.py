@@ -474,7 +474,10 @@ def plan_key_salt(dist, ids_dev, num_groups: int, n_shards: int
                    salt=K, groups=[int(g) for g in hot[:16]])
     _log.info("daggregate: %d hot key group(s) (> %.0f%% of %d rows) "
               "salted across %d slots", hot.size, frac * 100, n, K)
-    return ids2, eff, (hot, K)
+    # 4th element: each hot group's observed row fraction — the
+    # hot-key OBSERVATION surfaced by frame.hot_keys()/explain()
+    # (consumers index [0..2]; the append is compatible)
+    return ids2, eff, (hot, K), counts[hot] / max(n, 1)
 
 
 _SALT_FOLD = {"sum": np.add, "min": np.minimum, "max": np.maximum,

@@ -1129,8 +1129,13 @@ def _exec_aggregate(plan: _DPlan, d, keys):
                                        plan.agg_combiners[f])
                   for f, t in zip(fetch_names, tables)]
     key_cols = {k: u for k, u in zip(keys, uniques)}
-    return D._monoid_agg_result(plan.final_schema, keys, fetch_names,
-                                tables, key_cols, num_groups)
+    out = D._monoid_agg_result(plan.final_schema, keys, fetch_names,
+                               tables, key_cols, num_groups)
+    if salt_plan is not None:
+        # the fused fold surfaces its hot-key observations like the
+        # eager op (frame.hot_keys() / explain() — docs/joins.md)
+        D.attach_hot_keys(out, keys, uniques, salt_plan)
+    return out
 
 
 # ---------------------------------------------------------------------------

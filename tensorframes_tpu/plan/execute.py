@@ -59,9 +59,15 @@ def maybe_run(frame) -> Optional[List]:
         return None
     leaf = plan.leaf
     if leaf.kind == "parquet":
-        leaf_blocks = leaf.read_blocks(plan.leaf_required)
+        leaf_blocks = leaf.read_blocks(plan.leaf_required,
+                                       atoms=plan.scan_atoms)
     else:
-        leaf_blocks = leaf.frame.blocks()
+        if leaf.kind == "join":
+            # pruning reaches INTO the join: only the columns this
+            # chain needs are gathered/materialized (docs/joins.md)
+            leaf_blocks = leaf.read_blocks(plan.leaf_required)
+        else:
+            leaf_blocks = leaf.frame.blocks()
         for b in leaf_blocks:
             for n in plan.scan_names:
                 if b.num_rows and b.is_ragged(n):

@@ -28,8 +28,9 @@ from ..schema import Schema
 from ..utils.logging import get_logger
 
 __all__ = ["PlanNode", "SourceNode", "ParquetScanNode", "MapBlocksNode",
-           "MapRowsNode", "FilterNode", "SelectNode", "attach", "node_for",
-           "record_selectivity", "observed_selectivity"]
+           "MapRowsNode", "FilterNode", "SelectNode", "JoinNode",
+           "attach", "node_for", "record_selectivity",
+           "observed_selectivity"]
 
 _log = get_logger("plan.nodes")
 
@@ -215,18 +216,133 @@ class ParquetScanNode(PlanNode):
     def _estimate(self) -> Estimate:
         return float(self.rows), dict(self.col_bytes)
 
-    def read_blocks(self, names: Sequence[str]) -> List:
+    def _group_stats(self):
+        """Per-row-group footer statistics for this scan's pinned range:
+        a list of ``(num_rows, {column: (min, max)})`` — one footer
+        read, cached on the node. ``None`` stats never refute."""
+        cached = getattr(self, "_rg_stats", None)
+        if cached is not None:
+            return cached
+        stats = []
+        try:
+            import pyarrow.parquet as pq
+            with pq.ParquetFile(self.path) as pf:
+                md = pf.metadata
+                want = set(self.columns)
+                end = min(md.num_row_groups,
+                          self.row_group_offset + self.row_group_limit)
+                for g in range(self.row_group_offset, end):
+                    rg = md.row_group(g)
+                    per = {}
+                    nbytes = {}
+                    for j in range(rg.num_columns):
+                        c = rg.column(j)
+                        base = c.path_in_schema.split(".", 1)[0]
+                        if base not in want:
+                            continue
+                        nbytes[base] = int(c.total_uncompressed_size)
+                        s = c.statistics
+                        if s is not None and s.has_min_max:
+                            per[base] = (s.min, s.max)
+                    stats.append((rg.num_rows, per, nbytes))
+        except Exception as e:  # noqa: BLE001 - no stats, no pushdown
+            _log.debug("row-group stats unavailable for %s (%s); "
+                       "pushdown disabled", self.path, e)
+            stats = []
+        self._rg_stats = stats
+        return stats
+
+    def refuted_groups(self, atoms) -> List[int]:
+        """Row-group indices (0-based within this scan's range) whose
+        footer stats PROVE every row fails some pushdown atom. Only
+        meaningful for 1:1 group->partition scans
+        (``num_partitions is None``)."""
+        if not atoms or self.num_partitions is not None:
+            return []
+        from .. import dtypes as _dt
+        from .predicates import refutes
+        stats = self._group_stats()
+        if len(stats) != self.row_group_limit:
+            return []
+        out = []
+        for gi, (_, per, _nb) in enumerate(stats):
+            for a in atoms:
+                f = self.schema.get(a.column)
+                mm = per.get(a.column)
+                if f is None or mm is None or not f.dtype.tensor:
+                    continue
+                if refutes(a, mm[0], mm[1], _dt.device_dtype(f.dtype)):
+                    out.append(gi)
+                    break
+        return out
+
+    def _empty_block(self, names: Sequence[str]):
+        """A 0-row block typed like this scan's columns (the stand-in
+        for a pushdown-skipped row group; only ever observed at 0 rows,
+        where the per-op empty replay makes the shapes unobservable)."""
+        from ..frame import Block
+        cols = {}
+        for n in names:
+            f = self.schema[n]
+            cell = f.cell_shape
+            dims = tuple(0 if d == -1 else d
+                         for d in (cell.dims if cell else ()))
+            cols[n] = np.empty((0,) + dims, f.dtype.np_storage)
+        return Block(cols, 0)
+
+    def read_blocks(self, names: Sequence[str], atoms=None) -> List:
         """Blocks holding (at least) ``names`` — the already-forced frame
-        cache when it exists, a pruned read otherwise."""
+        cache when it exists, a pruned read otherwise.
+
+        ``atoms`` (pushdown predicates, :mod:`.predicates`) skip whole
+        row groups whose footer statistics refute them: the skipped
+        group's partition becomes a typed 0-row block — bit-identical
+        downstream, because every skipped row was about to fail the
+        filter anyway (``plan.pushdown_groups_skipped`` /
+        ``plan.pushdown_bytes_skipped`` count what was never read)."""
         frame = self.frame_ref() if self.frame_ref is not None else None
         if frame is not None and getattr(frame, "_cache", None):
             return frame._cache
         from ..io import _read_parquet_eager
         want = [n for n in self.columns if n in set(names)]
-        return _read_parquet_eager(
-            self.path, columns=want, num_partitions=self.num_partitions,
-            pad_ragged=False, row_group_offset=self.row_group_offset,
-            row_group_limit=self.row_group_limit).blocks()
+        skip = set(self.refuted_groups(atoms) if atoms else [])
+        if not skip:
+            return _read_parquet_eager(
+                self.path, columns=want,
+                num_partitions=self.num_partitions,
+                pad_ragged=False, row_group_offset=self.row_group_offset,
+                row_group_limit=self.row_group_limit).blocks()
+        from ..utils.tracing import counters
+        stats = self._group_stats()
+        skipped_bytes = 0
+        for gi in skip:
+            _, _, nbytes = stats[gi]
+            # footer chunk sizes of the READ projection only
+            skipped_bytes += sum(int(nbytes.get(n, 0)) for n in want)
+        counters.inc("plan.pushdown_groups_skipped", len(skip))
+        counters.inc("plan.pushdown_bytes_skipped", skipped_bytes)
+        _log.info("parquet pushdown: skipped %d/%d row group(s) "
+                  "(~%d B) of %s", len(skip), self.row_group_limit,
+                  skipped_bytes, self.path)
+        # read surviving groups in contiguous runs, splice typed
+        # empties at skipped positions (group->partition is 1:1 here)
+        blocks: List = [None] * self.row_group_limit
+        run_start = None
+        for gi in range(self.row_group_limit + 1):
+            live = gi < self.row_group_limit and gi not in skip
+            if live and run_start is None:
+                run_start = gi
+            elif not live and run_start is not None:
+                got = _read_parquet_eager(
+                    self.path, columns=want, num_partitions=None,
+                    pad_ragged=False,
+                    row_group_offset=self.row_group_offset + run_start,
+                    row_group_limit=gi - run_start).blocks()
+                for k, b in enumerate(got):
+                    blocks[run_start + k] = b
+                run_start = None
+        empty = self._empty_block(want)
+        return [b if b is not None else empty for b in blocks]
 
 
 class MapBlocksNode(PlanNode):
@@ -314,6 +430,72 @@ class SelectNode(PlanNode):
         if cols is None:
             return rows, None
         return rows, {n: cols[n] for n in self.names if n in cols}
+
+
+class JoinNode(PlanNode):
+    """Leaf over a lazy join (``relational/join.py``): downstream
+    chains fuse over the join result like any source, column pruning
+    reaches INTO the join through :meth:`read_blocks` (build columns
+    the chain never references are not gathered, probe passthrough
+    columns not materialized), and :meth:`estimate` prices join output
+    per column for serve admission / quotas.
+    """
+
+    kind = "join"
+
+    def __init__(self, left: PlanNode, right: Optional[PlanNode],
+                 schema: Schema, on, how: str, strategy: str,
+                 materialize):
+        super().__init__(None, schema)
+        self.left = left
+        self.right = right
+        self.on = tuple(on)
+        self.how = how
+        self.strategy = strategy
+        self._materialize = materialize
+        self.build = None  # the broadcast BuildTable, when that path
+
+    def describe(self) -> str:
+        return f"join[{self.strategy},{self.how}]{list(self.on)}"
+
+    @property
+    def frame(self):
+        """The join result frame (the leaf-execution surface the plan
+        executor's generic path uses)."""
+        return self.result_ref() if self.result_ref is not None else None
+
+    def read_blocks(self, names: Sequence[str]) -> List:
+        frame = self.frame
+        if frame is not None and getattr(frame, "_cache", None):
+            return frame._cache
+        return self._materialize(list(names))
+
+    def _estimate(self) -> Estimate:
+        rows_l, cols_l = self.left.estimate()
+        out: Dict[str, int] = {}
+        if cols_l is not None:
+            out.update({n: b for n, b in cols_l.items()
+                        if n in self.schema})
+        build = self.build
+        if build is not None and build.build_rows and rows_l:
+            scale = rows_l / build.build_rows
+            for f in build.value_fields:
+                if f.name not in self.schema:
+                    continue
+                if f.name in build.tensor_names:
+                    nb = int(build._sorted_host[f.name].nbytes * scale)
+                else:
+                    nb = int(8 * rows_l)
+                out[f.name] = nb
+        elif self.right is not None:
+            rows_r, cols_r = self.right.estimate()
+            if cols_r is not None and rows_r and rows_l:
+                for n, b in cols_r.items():
+                    if n in self.schema and n not in out:
+                        out[n] = int(b * rows_l / rows_r)
+        # rows: the probe side's count — exact for 1:1 left joins, an
+        # estimate under duplicate build keys (documented heuristic)
+        return rows_l, (out or None)
 
 
 def node_for(frame) -> PlanNode:

@@ -283,10 +283,10 @@ class ExecPlan:
     """The optimizer's output: leaf + stages + pruning decisions."""
 
     __slots__ = ("leaf", "ops", "stages", "final_schema", "leaf_required",
-                 "scan_names", "device_ops", "pruned")
+                 "scan_names", "device_ops", "pruned", "scan_atoms")
 
     def __init__(self, leaf, ops, stages, final_schema, leaf_required,
-                 scan_names, device_ops, pruned):
+                 scan_names, device_ops, pruned, scan_atoms=()):
         self.leaf = leaf
         self.ops = ops
         self.stages = stages
@@ -295,6 +295,7 @@ class ExecPlan:
         self.scan_names = scan_names        # leaf columns feeding programs
         self.device_ops = device_ops
         self.pruned = pruned                # leaf columns NOT read
+        self.scan_atoms = scan_atoms        # parquet pushdown predicates
 
     def describe(self) -> List[str]:
         """``explain()``'s plan section: fused groups, pruned columns,
@@ -311,6 +312,12 @@ class ExecPlan:
         else:
             lines.append(f"    source : {src} · "
                          f"{len(self.leaf_required)} column(s)")
+        if self.scan_atoms:
+            preds = ", ".join(f"{a.column} {a.op} {a.value:g}"
+                              for a in self.scan_atoms)
+            lines.append(
+                f"    pushdown: [{preds}] checked against row-group "
+                f"footer statistics (refuted groups never read)")
         for i, st in enumerate(self.stages):
             edge = ("host rows" if i == 0 else "device-resident")
             mask_s = " · mask applied host-side" if st.mask else ""
@@ -339,7 +346,9 @@ def build_plan(frame) -> Optional[ExecPlan]:
         return None
     device_ops = sum(1 for o in ops
                      if o.kind in ("map_blocks", "map_rows", "filter"))
-    prunable_leaf = leaf.kind == "parquet"
+    # parquet scans prune their read; join leaves prune the columns
+    # the join materializes (docs/joins.md)
+    prunable_leaf = leaf.kind in ("parquet", "join")
     if device_ops < 2 and not prunable_leaf:
         return None  # nothing to win; per-op semantics stay canonical
     if MASK in leaf.schema or any(MASK in o.schema for o in ops):
@@ -490,4 +499,30 @@ def build_plan(frame) -> Optional[ExecPlan]:
     pruned = tuple(f.name for f in leaf.schema if f.name not in need) \
         if prunable_leaf else ()
     return ExecPlan(leaf, list(ops), stages, final_schema, leaf_required,
-                    frozenset(scan_names), device_ops, pruned)
+                    frozenset(scan_names), device_ops, pruned,
+                    _scan_atoms(leaf, ops))
+
+
+def _scan_atoms(leaf, ops):
+    """Pushdown atoms for a parquet leaf (ROADMAP 2c): conjunctive
+    ``column <op> literal`` filter predicates over SCAN columns,
+    extractable from any filter BEFORE the first trim (a trim replaces
+    the schema, severing column identity; non-trim maps only append —
+    fetch-name collisions are rejected — so a leaf-named column still
+    carries the leaf's values at every later filter). Sound for
+    whole-group skipping regardless of earlier filters: a group whose
+    every row fails the predicate contributes nothing downstream."""
+    if leaf.kind != "parquet" or leaf.num_partitions is not None:
+        return ()
+    from .predicates import extract_atoms
+    leaf_cols = set(leaf.schema.names)
+    atoms = []
+    for o in ops:
+        if o.kind == "map_blocks" and o.trim:
+            break
+        if o.kind != "filter":
+            continue
+        for a in extract_atoms(o.comp):
+            if a.column in leaf_cols:
+                atoms.append(a)
+    return tuple(atoms)

@@ -1,0 +1,44 @@
+"""Relational half: device-side joins + mergeable sketch aggregates.
+
+The missing relational operators (ROADMAP item 4) — everything the
+repo could run before this package was map/filter/sort/groupby on ONE
+frame. Two op families, both integrated with the existing subsystems
+rather than beside them:
+
+- **Joins** (:mod:`.join`): a broadcast hash join for small build sides
+  (the build table factorized once, broadcast device-resident, one
+  fused gather program per probe block through the resilient
+  :class:`~..engine.executor.BlockExecutor`; a build side the memory
+  ledger refuses to hold resident probes in budget-sized CHUNKS
+  instead) and a mesh sort-merge join for large-large (both sides
+  through ``dsort`` — columnsort all_to_all exchanges, ``elastic_call``
+  device-loss recovery, and the external-memory sort when the ledger
+  demands — then a host merge of the two key-sorted streams).
+  ``StreamingFrame.join`` enriches stream batches against a static
+  build table built ONCE at definition time.
+
+- **Sketches** (:mod:`.sketch`): mergeable summaries for aggregates
+  where exact answers don't scale — HyperLogLog distinct counts,
+  DDSketch-style relative-error quantiles, Misra–Gries top-k heavy
+  hitters. Each is a MONOID combiner, so it drops into ``aggregate``,
+  ``daggregate``, and windowed stream state through the same
+  ``{column: combiner}`` mapping the scalar monoids use; HLL and
+  quantile states merge ELEMENTWISE (max / sum), so the streaming
+  scatter-merge programs and the cross-block folds run unchanged and
+  the three paths return bit-identical sketches.
+
+See ``docs/joins.md``.
+"""
+
+from __future__ import annotations
+
+from .join import BuildTable, broadcast_join, join, sort_merge_join
+from .sketch import (SketchCombiner, approx_distinct, approx_quantile,
+                     approx_top_k, hll_sketch, quantile_sketch,
+                     top_k_sketch)
+
+__all__ = [
+    "join", "broadcast_join", "sort_merge_join", "BuildTable",
+    "SketchCombiner", "hll_sketch", "quantile_sketch", "top_k_sketch",
+    "approx_distinct", "approx_quantile", "approx_top_k",
+]

@@ -1,0 +1,549 @@
+"""Mergeable sketch aggregates: HLL, relative-error quantiles, top-k.
+
+Each sketch is a :class:`SketchCombiner` — a monoid over fixed-width
+per-group state tables — so it drops into the SAME ``{column:
+combiner}`` mapping the scalar monoids (sum/min/max/prod) use, across
+all three aggregation paths:
+
+- ``aggregate`` (``engine.ops._monoid_aggregate``): per-block partial
+  tables folded across blocks with the sketch's combine;
+- ``daggregate`` (``parallel.distributed._daggregate``): partials over
+  the mesh frame's valid rows, under the op's own ``elastic_call`` (a
+  lost device during the column reads recovers like any mesh op);
+- windowed streams (``stream.aggregate``): the per-batch partial folds
+  into the device-resident window state through the EXISTING
+  scatter-merge programs when the sketch merges elementwise
+  (``elementwise`` names the scalar combiner — ``max`` for HLL
+  registers, ``sum`` for quantile bucket counts), and through a host
+  table merge otherwise (top-k).
+
+Determinism: hashing and bucketing run on the host in float64/uint64
+(``_hash64`` is a fixed splitmix64 — no process-seed dependence), and
+HLL/quantile states merge with elementwise integer monoids, so the
+same rows produce BIT-IDENTICAL sketch states through ``aggregate``,
+``daggregate``, and a windowed stream. Top-k (Misra–Gries) is
+order-dependent in its exact state but keeps its error guarantee under
+ANY merge order (mergeable-summaries property): every item with true
+frequency above ``n/(k+1)`` survives, with count undercounted by at
+most ``n/(k+1)``.
+
+Error bounds (asserted in ``tests/test_relational.py``):
+
+- HLL with ``2**bits`` registers: relative standard error
+  ``1.04/sqrt(2**bits)`` (the classic bound; tests assert a 5-sigma
+  envelope on fixed datasets);
+- quantile: returned values are within relative error
+  ``sqrt(gamma) - 1`` (≈ ``alpha``) of the true quantile for values
+  inside ``[min_value, max_value]``; out-of-range values clamp to the
+  edge buckets (documented degradation);
+- top-k: exactness above the ``n/(k+1)`` threshold, counts within
+  ``n/(k+1)``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..schema import Field
+from ..shape import Shape, Unknown
+from ..utils.logging import get_logger
+
+__all__ = ["SketchCombiner", "hll_sketch", "quantile_sketch",
+           "top_k_sketch", "approx_distinct", "approx_quantile",
+           "approx_top_k"]
+
+_log = get_logger("relational.sketch")
+
+
+# ---------------------------------------------------------------------------
+# deterministic 64-bit hashing (host, vectorized)
+# ---------------------------------------------------------------------------
+
+def _splitmix64(x: np.ndarray) -> np.ndarray:
+    """The splitmix64 finalizer over uint64 lanes (fixed constants, no
+    process seed — the same rows hash the same in every path/process)."""
+    with np.errstate(over="ignore"):
+        z = x + np.uint64(0x9E3779B97F4A7C15)
+        z = (z ^ (z >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+        z = (z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+        return z ^ (z >> np.uint64(31))
+
+
+def _hash64(values: np.ndarray) -> np.ndarray:
+    """Deterministic 64-bit hashes of a scalar column (numeric fast
+    path over raw bit patterns; strings through blake2b)."""
+    a = np.asarray(values)
+    if a.dtype == object:
+        import hashlib
+        out = np.empty(len(a), np.uint64)
+        for i, s in enumerate(a):
+            h = hashlib.blake2b(str(s).encode("utf-8"),
+                                digest_size=8).digest()
+            out[i] = np.uint64(int.from_bytes(h, "little"))
+        return _splitmix64(out)
+    if a.dtype.kind in "fV":
+        # kind 'V' is ml_dtypes bfloat16 — a float for hashing purposes
+        # (the int fallback would truncate 0.25/0.5/0.75 to one hash);
+        # bf16 -> f64 is exact
+        x = np.ascontiguousarray(np.asarray(a, np.float64))
+        # canonicalize -0.0 == 0.0 and all NaN payloads before hashing
+        x = np.where(x == 0.0, 0.0, x)
+        x = np.where(np.isnan(x), np.float64(np.nan), x)
+        return _splitmix64(x.view(np.uint64))
+    if a.dtype.kind == "b":
+        return _splitmix64(a.astype(np.uint64))
+    return _splitmix64(np.ascontiguousarray(a).astype(np.int64)
+                       .view(np.uint64))
+
+
+def _clz64(w: np.ndarray) -> np.ndarray:
+    """Leading-zero count of uint64 lanes (0 -> 64), vectorized
+    binary descent (6 steps, no per-row Python)."""
+    n = np.zeros(w.shape, np.int64)
+    x = np.asarray(w, np.uint64).copy()
+    for b in (32, 16, 8, 4, 2, 1):
+        top_zero = x < (np.uint64(1) << np.uint64(64 - b))
+        n = np.where(top_zero, n + b, n)
+        with np.errstate(over="ignore"):
+            x = np.where(top_zero, x << np.uint64(b), x)
+    return np.where(np.asarray(w) == 0, 64, n).astype(np.int32)
+
+
+# ---------------------------------------------------------------------------
+# the combiner protocol
+# ---------------------------------------------------------------------------
+
+class SketchCombiner:
+    """A mergeable summary usable wherever a scalar combiner name is.
+
+    State is a ``[groups, state_width]`` array of ``state_dtype``.
+    ``elementwise`` names the scalar monoid the state merges with
+    (``"max"`` / ``"sum"``) — the streaming scatter-merge programs and
+    the device segment kernels reuse it directly; ``None`` means the
+    state merges through :meth:`merge_tables` on the host (top-k).
+    """
+
+    name = "sketch"
+    elementwise: Optional[str] = None
+    state_width: int = 0
+    state_dtype = np.int32
+
+    # -- validation --------------------------------------------------------
+    def validate_input(self, field) -> None:
+        """Raise for a column this sketch cannot summarize."""
+
+    # -- state -------------------------------------------------------------
+    def neutral_table(self, groups: int) -> np.ndarray:
+        return np.zeros((groups, self.state_width), self.state_dtype)
+
+    def block_partial(self, values, ids: np.ndarray,
+                      num_groups: int) -> np.ndarray:
+        """One block/batch/shard of rows -> a ``[num_groups, S]`` state
+        table (host values in their storage dtype; ``ids`` dense group
+        ids per row)."""
+        raise NotImplementedError
+
+    def combine_np(self, a: np.ndarray, b: np.ndarray) -> np.ndarray:
+        """Elementwise fold of two aligned state tables (host twin of
+        the device merge — exact for the integer states)."""
+        if self.elementwise == "max":
+            return np.maximum(a, b)
+        if self.elementwise == "sum":
+            return a + b
+        raise NotImplementedError
+
+    def merge_tables(self, old: np.ndarray, idx_old: np.ndarray,
+                     new: np.ndarray, idx_new: np.ndarray,
+                     m: int) -> np.ndarray:
+        """Scatter-merge into a ``[m, S]`` union table (the streaming
+        state fold for host-merged sketches; elementwise sketches use
+        the compiled scatter programs instead)."""
+        out = self.neutral_table(m)
+        out[idx_old] = old
+        out[idx_new] = self.combine_np(out[idx_new], new)
+        return out
+
+    # -- output ------------------------------------------------------------
+    def out_fields(self, name: str, in_field) -> List[Field]:
+        raise NotImplementedError
+
+    def finalize(self, name: str,
+                 table: np.ndarray) -> Dict[str, np.ndarray]:
+        """State table -> the output column(s) named by
+        :meth:`out_fields`."""
+        raise NotImplementedError
+
+    def _segment_fold(self, slot: np.ndarray, weight: np.ndarray,
+                      ids: np.ndarray, num_groups: int) -> np.ndarray:
+        """Shared scatter core: fold per-row ``weight`` into state slot
+        ``(group, slot)`` with the sketch's elementwise monoid — ONE
+        device segment-reduce dispatch over the combined id space (the
+        same kernels the monoid ``aggregate`` path launches), host
+        fallback when the rows are tiny (dispatch overhead dominates).
+        """
+        S = self.state_width
+        combined = ids.astype(np.int64) * S + slot.astype(np.int64)
+        if len(combined) >= 4096:
+            from ..engine.ops import _segment_reduce
+            try:
+                flat = np.asarray(_segment_reduce(
+                    self.elementwise, weight.astype(self.state_dtype),
+                    combined, num_groups * S))
+                table = flat.reshape(num_groups, S)
+                if self.elementwise == "max":
+                    # empty (group, slot) cells hold the segment
+                    # identity (int min); sketch registers are >= 0
+                    table = np.maximum(table, 0)
+                return table.astype(self.state_dtype)
+            except Exception as e:  # noqa: BLE001 - host twin is exact
+                _log.debug("device segment fold unavailable (%s); "
+                           "folding on host", e)
+        table = self.neutral_table(num_groups)
+        flat = table.reshape(-1)
+        if self.elementwise == "max":
+            np.maximum.at(flat, combined, weight.astype(self.state_dtype))
+        else:
+            np.add.at(flat, combined, weight.astype(self.state_dtype))
+        return table
+
+
+def _require_scalar_tensor(field, what: str) -> None:
+    if field.sql_rank != 0:
+        raise ValueError(
+            f"{what} expects a scalar column; {field.name!r} holds "
+            f"rank-{field.sql_rank} cells")
+
+
+# ---------------------------------------------------------------------------
+# HyperLogLog distinct counts
+# ---------------------------------------------------------------------------
+
+class HllSketch(SketchCombiner):
+    """HyperLogLog distinct-count sketch: ``2**bits`` int32 registers
+    per group, elementwise-max mergeable. Output: one int64 estimated
+    distinct count per group; relative standard error
+    ``1.04/sqrt(2**bits)``."""
+
+    elementwise = "max"
+    state_dtype = np.int32
+
+    def __init__(self, bits: int = 10):
+        if not 4 <= int(bits) <= 16:
+            raise ValueError(f"hll bits must be in [4, 16], got {bits}")
+        self.bits = int(bits)
+        self.m = 1 << self.bits
+        self.state_width = self.m
+        self.name = f"approx_distinct(bits={self.bits})"
+
+    @property
+    def relative_error(self) -> float:
+        return 1.04 / math.sqrt(self.m)
+
+    def validate_input(self, field) -> None:
+        _require_scalar_tensor(field, "approx_distinct")
+
+    def block_partial(self, values, ids, num_groups):
+        h = _hash64(values)
+        reg = (h >> np.uint64(64 - self.bits)).astype(np.int64)
+        w = h << np.uint64(self.bits)
+        rho = np.minimum(_clz64(w) + 1, 64 - self.bits + 1)
+        return self._segment_fold(reg, rho, ids, num_groups)
+
+    def out_fields(self, name, in_field):
+        from .. import dtypes as _dt
+        return [Field(name, _dt.int64, block_shape=Shape(Unknown),
+                      sql_rank=0)]
+
+    def finalize(self, name, table):
+        m = float(self.m)
+        alpha = 0.7213 / (1.0 + 1.079 / m)
+        est = alpha * m * m / np.sum(
+            np.power(2.0, -np.asarray(table, np.float64)), axis=1)
+        zeros = np.sum(table == 0, axis=1).astype(np.float64)
+        small = (est <= 2.5 * m) & (zeros > 0)
+        with np.errstate(divide="ignore"):
+            lin = m * np.log(np.where(zeros > 0, m / np.maximum(zeros, 1),
+                                      1.0))
+        est = np.where(small, lin, est)
+        return {name: np.rint(est).astype(np.int64)}
+
+
+# ---------------------------------------------------------------------------
+# DDSketch-style relative-error quantiles
+# ---------------------------------------------------------------------------
+
+class QuantileSketch(SketchCombiner):
+    """Log-bucketed quantile sketch (DDSketch scheme): int32 bucket
+    counts over a fixed gamma-geometric grid, elementwise-sum
+    mergeable. For values with ``min_value <= |v| <= max_value`` the
+    returned quantile is within relative error ``sqrt(gamma) - 1``
+    (~``alpha``); smaller magnitudes collapse into an exact-zero
+    bucket, larger ones clamp to the edge bucket (documented
+    degradation, not an error). NaN rows are DROPPED (a NaN has no
+    quantile rank; the scalar min/max monoids are the ops that
+    propagate NaN)."""
+
+    elementwise = "sum"
+    state_dtype = np.int32
+
+    def __init__(self, qs=0.5, alpha: float = 0.02,
+                 min_value: float = 1e-6, max_value: float = 1e6):
+        if not 0.0 < alpha < 0.5:
+            raise ValueError(f"alpha must be in (0, 0.5), got {alpha}")
+        if not 0.0 < min_value < max_value:
+            raise ValueError(
+                f"need 0 < min_value < max_value, got "
+                f"{min_value}/{max_value}")
+        self.qs = tuple(float(q) for q in
+                        (qs if isinstance(qs, (tuple, list)) else (qs,)))
+        for q in self.qs:
+            if not 0.0 <= q <= 1.0:
+                raise ValueError(f"quantile {q} not in [0, 1]")
+        if not self.qs:
+            raise ValueError("need at least one quantile")
+        self.alpha = float(alpha)
+        self.gamma = (1.0 + alpha) / (1.0 - alpha)
+        self._lg = math.log(self.gamma)
+        self.min_value = float(min_value)
+        self.max_value = float(max_value)
+        # per sign: buckets i cover [min * gamma^i, min * gamma^(i+1))
+        self.side = int(math.ceil(
+            math.log(max_value / min_value) / self._lg)) + 1
+        # layout: [neg side (reversed)] [zero] [pos side]
+        self.state_width = 2 * self.side + 1
+        self.name = (f"approx_quantile(q={list(self.qs)}, "
+                     f"alpha={self.alpha})")
+
+    @property
+    def relative_error(self) -> float:
+        """The guaranteed in-range bound: reps sit at the geometric
+        bucket midpoint, so error <= sqrt(gamma) - 1."""
+        return math.sqrt(self.gamma) - 1.0
+
+    def validate_input(self, field) -> None:
+        _require_scalar_tensor(field, "approx_quantile")
+        if not field.dtype.tensor:
+            raise ValueError(
+                f"approx_quantile needs a numeric column; {field.name!r} "
+                f"is {field.dtype.name}")
+
+    def _bucket(self, v: np.ndarray) -> np.ndarray:
+        x = np.asarray(v, np.float64)
+        mag = np.abs(x)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            i = np.floor(np.log(np.maximum(mag, self.min_value)
+                                / self.min_value) / self._lg)
+        i = np.clip(np.nan_to_num(i, nan=0.0), 0,
+                    self.side - 1).astype(np.int64)
+        zero = mag < self.min_value
+        slot = np.where(x >= 0, self.side + 1 + i, self.side - 1 - i)
+        return np.where(zero, self.side, slot).astype(np.int64)
+
+    def _rep(self, slot: int) -> float:
+        if slot == self.side:
+            return 0.0
+        if slot > self.side:
+            i = slot - self.side - 1
+            return self.min_value * self.gamma ** (i + 0.5)
+        i = self.side - 1 - slot
+        return -self.min_value * self.gamma ** (i + 0.5)
+
+    def block_partial(self, values, ids, num_groups):
+        x = np.asarray(values, np.float64)
+        keep = ~np.isnan(x)
+        if not keep.all():
+            # NaN is not a value with a quantile rank: drop it (the
+            # scalar min/max monoids propagate NaN; a sketch counting
+            # it as data would drag every quantile toward -min_value)
+            x = x[keep]
+            ids = np.asarray(ids)[keep]
+        slot = self._bucket(x)
+        ones = np.ones(len(slot), np.int32)
+        return self._segment_fold(slot, ones, ids, num_groups)
+
+    def out_fields(self, name, in_field):
+        from .. import dtypes as _dt
+        if len(self.qs) == 1:
+            return [Field(name, _dt.double, block_shape=Shape(Unknown),
+                          sql_rank=0)]
+        return [Field(name, _dt.double,
+                      block_shape=Shape(Unknown, len(self.qs)),
+                      sql_rank=1)]
+
+    def finalize(self, name, table):
+        t = np.asarray(table, np.int64)
+        g = t.shape[0]
+        cum = np.cumsum(t, axis=1)
+        n = cum[:, -1]
+        out = np.zeros((g, len(self.qs)), np.float64)
+        reps = np.array([self._rep(s) for s in range(self.state_width)])
+        for j, q in enumerate(self.qs):
+            r = np.maximum(1, np.ceil(q * n).astype(np.int64))
+            # first bucket whose cumulative count reaches rank r —
+            # vectorized over groups (cum rows are non-decreasing, so
+            # the count of entries below the rank IS the index)
+            pos = (cum < r[:, None]).sum(axis=1)
+            pos = np.minimum(pos, self.state_width - 1)
+            out[:, j] = reps[pos]
+            out[n == 0, j] = np.nan
+        if len(self.qs) == 1:
+            return {name: out[:, 0]}
+        return {name: out}
+
+
+# ---------------------------------------------------------------------------
+# Misra–Gries top-k heavy hitters
+# ---------------------------------------------------------------------------
+
+class TopKSketch(SketchCombiner):
+    """Misra–Gries heavy-hitter summary over an INTEGER column: ``k``
+    (item, count) slots per group packed as a ``[G, 2k]`` int64 state
+    (items first, counts second; count 0 marks an empty slot).
+
+    The mergeable-summaries guarantee: after summarizing ``n`` rows,
+    every item with true frequency > ``n/(k+1)`` is present, and every
+    kept count is an UNDER-estimate by at most ``n/(k+1)`` — under any
+    merge order (blocks, shards, or stream batches). Merging is a host
+    table fold (``elementwise=None``); stream state for top-k columns
+    therefore lives host-side, which also means it costs zero device
+    bytes. String/float heavy hitters: factorize to integer ids
+    upstream (``daggregate`` hot-key salting + ``frame.hot_keys()``
+    already names hot STRING keys).
+    """
+
+    elementwise = None
+    state_dtype = np.int64
+
+    def __init__(self, k: int = 8):
+        if int(k) < 1:
+            raise ValueError(f"top-k needs k >= 1, got {k}")
+        self.k = int(k)
+        self.state_width = 2 * self.k
+        self.name = f"approx_top_k(k={self.k})"
+
+    def validate_input(self, field) -> None:
+        _require_scalar_tensor(field, "approx_top_k")
+        if not field.dtype.tensor or \
+                np.dtype(field.dtype.np_storage).kind not in "iub":
+            raise ValueError(
+                f"approx_top_k summarizes integer columns; "
+                f"{field.name!r} is {field.dtype.name} (factorize "
+                f"strings/floats to ids first)")
+
+    def _compress(self, items: np.ndarray,
+                  counts: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        """Misra–Gries reduction to k slots: subtract the (k+1)-th
+        largest count from all, keep the positive ones."""
+        if len(items) > self.k:
+            dec = np.partition(counts, -(self.k + 1))[-(self.k + 1)]
+            counts = counts - dec
+            keep = counts > 0
+            items, counts = items[keep], counts[keep]
+            if len(items) > self.k:  # ties at the cut: deterministic trim
+                order = np.lexsort((items, -counts))[: self.k]
+                items, counts = items[order], counts[order]
+        out_i = np.zeros(self.k, np.int64)
+        out_c = np.zeros(self.k, np.int64)
+        order = np.lexsort((items, -counts))
+        out_i[: len(items)] = items[order]
+        out_c[: len(items)] = counts[order]
+        return out_i, out_c
+
+    def _rows(self, state_row: np.ndarray
+              ) -> Tuple[np.ndarray, np.ndarray]:
+        items = state_row[: self.k]
+        counts = state_row[self.k:]
+        live = counts > 0
+        return items[live], counts[live]
+
+    def block_partial(self, values, ids, num_groups):
+        v = np.asarray(values).astype(np.int64)
+        ids = np.asarray(ids, np.int64)
+        order = np.lexsort((v, ids))
+        sv, si = v[order], ids[order]
+        changed = np.ones(len(sv), bool)
+        if len(sv) > 1:
+            changed[1:] = (sv[1:] != sv[:-1]) | (si[1:] != si[:-1])
+        starts = np.flatnonzero(changed)
+        pair_counts = np.diff(np.append(starts, len(sv)))
+        pair_items, pair_gids = sv[starts], si[starts]
+        table = self.neutral_table(num_groups)
+        gchg = np.ones(len(pair_gids), bool)
+        if len(pair_gids) > 1:
+            gchg[1:] = pair_gids[1:] != pair_gids[:-1]
+        gstarts = np.flatnonzero(gchg)
+        gends = np.append(gstarts[1:], len(pair_gids))
+        for a, b in zip(gstarts, gends):
+            g = int(pair_gids[a])
+            it, ct = self._compress(pair_items[a:b], pair_counts[a:b])
+            table[g, : self.k] = it
+            table[g, self.k:] = ct
+        return table
+
+    def combine_np(self, a, b):
+        a = np.asarray(a)
+        b = np.asarray(b)
+        out = np.zeros_like(a)
+        for g in range(a.shape[0]):
+            ia, ca = self._rows(a[g])
+            ib, cb = self._rows(b[g])
+            items = np.concatenate([ia, ib])
+            counts = np.concatenate([ca, cb])
+            if len(items):
+                u, inv = np.unique(items, return_inverse=True)
+                summed = np.zeros(len(u), np.int64)
+                np.add.at(summed, inv, counts)
+                it, ct = self._compress(u, summed)
+            else:
+                it = ct = np.zeros(self.k, np.int64)
+            out[g, : self.k] = it
+            out[g, self.k:] = ct
+        return out
+
+    def out_fields(self, name, in_field):
+        from .. import dtypes as _dt
+        return [Field(name, _dt.int64,
+                      block_shape=Shape(Unknown, self.k), sql_rank=1),
+                Field(f"{name}_counts", _dt.int64,
+                      block_shape=Shape(Unknown, self.k), sql_rank=1)]
+
+    def finalize(self, name, table):
+        t = np.asarray(table, np.int64)
+        return {name: t[:, : self.k].copy(),
+                f"{name}_counts": t[:, self.k:].copy()}
+
+
+# ---------------------------------------------------------------------------
+# public constructors (the names users put in the fetches mapping)
+# ---------------------------------------------------------------------------
+
+def hll_sketch(bits: int = 10) -> HllSketch:
+    """A HyperLogLog distinct-count combiner (``2**bits`` registers)."""
+    return HllSketch(bits=bits)
+
+
+def quantile_sketch(qs=0.5, alpha: float = 0.02,
+                    min_value: float = 1e-6,
+                    max_value: float = 1e6) -> QuantileSketch:
+    """A mergeable relative-error quantile combiner (DDSketch grid)."""
+    return QuantileSketch(qs=qs, alpha=alpha, min_value=min_value,
+                          max_value=max_value)
+
+
+def top_k_sketch(k: int = 8) -> TopKSketch:
+    """A Misra–Gries top-k heavy-hitter combiner."""
+    return TopKSketch(k=k)
+
+
+# ergonomic aliases matching the combiner-name idiom
+approx_distinct = hll_sketch
+approx_quantile = quantile_sketch
+approx_top_k = top_k_sketch
+
+
+# (the mapping-shape checks live in ONE place — engine.ops._is_sketch /
+# _monoid_mapping — and the three aggregation paths all route through
+# them; this module only defines the combiners themselves)

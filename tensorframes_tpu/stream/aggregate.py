@@ -284,13 +284,15 @@ class StreamingAggregation:
                  watermark_delay: float = 0.0,
                  max_state_rows: Optional[int] = None,
                  mesh=None):
+        from ..engine.ops import _is_sketch
         if not (isinstance(col_combiners, Mapping) and col_combiners
-                and all(isinstance(v, str)
+                and all(isinstance(v, str) or _is_sketch(v)
                         for v in col_combiners.values())):
             raise TypeError(
                 "streaming aggregate fetches must be a non-empty "
-                "{column: combiner-name} mapping (the monoid form; "
-                "arbitrary reduce computations cannot fold "
+                "{column: combiner} mapping (the monoid form — "
+                "sum/min/max/prod names or relational sketch "
+                "combiners; arbitrary reduce computations cannot fold "
                 "incrementally)")
         schema = upstream.schema
         self.upstream = upstream
@@ -351,9 +353,16 @@ class StreamingAggregation:
         value_names = [n for n in schema.names
                        if n not in self.keys and n != time_col]
         _validate_monoid_fetches(col_combiners, value_names,
-                                 "upstream with select()")
+                                 "upstream with select()", schema=schema)
         self.col_combiners = dict(col_combiners)
         self.fetch_names = sorted(col_combiners)
+        # sketch combiners (docs/joins.md): their per-window state
+        # folds through the SAME scatter-merge machinery when the
+        # sketch merges elementwise (HLL registers: max; quantile
+        # bucket counts: sum); host-merged sketches (top-k) keep host
+        # state tables — zero device bytes by construction
+        self.sketches = {f: c for f, c in self.col_combiners.items()
+                         if _is_sketch(c)}
         fields: List[Field] = []
         if window is not None:
             # window starts are always float64 (event-time arithmetic
@@ -361,13 +370,17 @@ class StreamingAggregation:
             fields.append(Field(WINDOW_COL, _dt.double,
                                 block_shape=Shape(Unknown), sql_rank=0))
         fields += [schema[k] for k in self.keys]
-        fields += [
-            Field(f, schema[f].dtype,
-                  block_shape=_field_spec(schema[f], True,
-                                          "stream aggregate")
-                  .with_lead(Unknown),
-                  sql_rank=schema[f].sql_rank)
-            for f in self.fetch_names]
+        for f in self.fetch_names:
+            sk = self.sketches.get(f)
+            if sk is not None:
+                fields.extend(sk.out_fields(f, schema[f]))
+            else:
+                fields.append(Field(
+                    f, schema[f].dtype,
+                    block_shape=_field_spec(schema[f], True,
+                                            "stream aggregate")
+                    .with_lead(Unknown),
+                    sql_rank=schema[f].sql_rank))
         self.out_schema = Schema(fields)
         # -- live state ----------------------------------------------------
         # _windows is read by metrics scrapes on other threads while the
@@ -552,30 +565,46 @@ class StreamingAggregation:
 
         schema = self.upstream.schema
         fact = _factorize_keys(key_arrays)
+        scalar_names = [f for f in self.fetch_names
+                        if f not in self.sketches]
         converted = {}
-        for f in self.fetch_names:
+        for f in scalar_names:
             v = val_arrays[f]
             dd = _dt.device_dtype(schema[f].dtype)
             if v.dtype != dd:
                 v = _native.convert(v, dd)
             converted[f] = v
-        if self.mesh is not None:
+        parts = {}
+        if self.mesh is not None and scalar_names:
             # the distributed-plan path: one fused GSPMD program per
             # batch (rows shard over the data axis, partial tables
             # combine with one collective) — docs/plan.md
             from ..plan import dist as _dplan
             mesh_parts = _dplan.mesh_segment_partial(
-                self.mesh, self.col_combiners,
+                self.mesh,
+                {f: self.col_combiners[f] for f in scalar_names},
                 fact.ids.astype(np.int32), converted, fact.num_groups)
             parts = {f: jnp.asarray(mesh_parts[f])
-                     for f in self.fetch_names}
-        else:
-            parts = {}
+                     for f in scalar_names}
+        elif scalar_names:
             with span("stream.aggregate.segment_reduce"):
-                for f in self.fetch_names:
+                for f in scalar_names:
                     parts[f] = jnp.asarray(_segment_reduce(
                         self.col_combiners[f], converted[f], fact.ids,
                         fact.num_groups))
+        if self.sketches:
+            # sketch partials bucket/hash on the host (the cross-path
+            # determinism contract, docs/joins.md); elementwise states
+            # join the device-resident tables, host-merged states
+            # (top-k) stay host numpy
+            with span("stream.aggregate.sketch_fold"):
+                for f, sk in self.sketches.items():
+                    part = sk.block_partial(
+                        np.asarray(val_arrays[f]), fact.ids,
+                        fact.num_groups)
+                    counters.inc("relational.sketch_folds")
+                    parts[f] = (jnp.asarray(part)
+                                if sk.elementwise is not None else part)
         if base is None:
             return _WState([np.asarray(u) for u in fact.uniques], parts,
                            fact.num_groups), np.arange(fact.num_groups)
@@ -604,10 +633,20 @@ class StreamingAggregation:
         with span("stream.aggregate.merge"):
             for f in self.fetch_names:
                 old = base.values[f]
+                sk = self.sketches.get(f)
+                if sk is not None and sk.elementwise is None:
+                    # host-merged sketch state (top-k): the union-table
+                    # fold runs in numpy — never device-resident
+                    values[f] = sk.merge_tables(
+                        np.asarray(old), idx_old,
+                        np.asarray(parts[f]), idx_new, m)
+                    continue
+                cname = (sk.elementwise if sk is not None
+                         else self.col_combiners[f])
                 # .shape/.dtype read device metadata only — never
                 # np.asarray the state here, which would drag the whole
                 # device-resident table to host every batch
-                fn = _merge_program(self.col_combiners[f], m, g, h,
+                fn = _merge_program(cname, m, g, h,
                                     tuple(old.shape[1:]), old.dtype)
                 values[f] = fn(old, idx_old, parts[f], idx_new)
         return _WState([np.asarray(u) for u in gf.uniques], values,
@@ -686,6 +725,12 @@ class StreamingAggregation:
             v = np.asarray(state.values[f])
             if sel is not None:
                 v = v[sel]
+            sk = self.sketches.get(f)
+            if sk is not None:
+                # sketch states finalize into their estimate columns
+                # at emission (the state itself never leaves the fold)
+                cols.update(sk.finalize(f, v))
+                continue
             fld = schema[f]
             if v.dtype != fld.dtype.np_storage \
                     and fld.dtype is not _dt.bfloat16:

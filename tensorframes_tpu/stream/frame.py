@@ -119,6 +119,27 @@ class StreamingFrame:
     # TensorFrame spells it `filter`; keep the alias for symmetry
     filter = filter_rows
 
+    def join(self, table, on, how: str = "left",
+             indicator: Optional[str] = None) -> "StreamingFrame":
+        """Enrich each batch against a STATIC table (the stream-table
+        join): the right side factorizes into a broadcast
+        :class:`~..relational.join.BuildTable` ONCE, here at definition
+        time — schema validation included — and every batch probes it
+        through the same per-block path the batch ``broadcast_join``
+        uses (one fused device gather per block, resilient executor,
+        ledger-admitted build residency). Default ``how="left"``: an
+        enrichment must not drop stream rows silently; pass
+        ``how="inner"`` to keep only matches. See ``docs/joins.md``."""
+        from ..relational.join import (BuildTable, broadcast_join,
+                                       join_schema)
+        build = BuildTable(table, on)
+        out_schema = join_schema(self._schema, build.schema, build.on,
+                                 how, indicator)
+        return self._chain(
+            lambda df: broadcast_join(df, build=build, how=how,
+                                      indicator=indicator),
+            out_schema, f"join[{how}]")
+
     # -- aggregation handoff -----------------------------------------------
     def group_by(self, *keys: str) -> "GroupedStream":
         for k in keys:

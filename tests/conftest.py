@@ -94,6 +94,18 @@ def pytest_configure(config):
                    "resident-shard-edge spills (run-tests.sh --dplan "
                    "runs this lane standalone)")
     config.addinivalue_line(
+        "markers", "join: relational join suite — broadcast hash join "
+                   "and mesh sort-merge join vs the CPU host oracle, "
+                   "ledger-chunked builds, stream enrichment, parquet "
+                   "predicate pushdown, hot-key surfacing "
+                   "(run-tests.sh --join runs this lane standalone)")
+    config.addinivalue_line(
+        "markers", "sketch: approximate-aggregate suite — HLL distinct "
+                   "counts, relative-error quantiles, top-k heavy "
+                   "hitters, error bounds + cross-path bit-identity "
+                   "through aggregate/daggregate/windowed streams "
+                   "(run-tests.sh --join runs this lane too)")
+    config.addinivalue_line(
         "markers", "timing: wall-clock-sensitive deadline assertions — "
                    "margins are widened for loaded machines "
                    "(TFT_TIMING_MARGIN multiplies the bounds; "

@@ -1,0 +1,835 @@
+"""Relational half: joins + sketch aggregates (``tensorframes_tpu/relational/``).
+
+The acceptance spine (ISSUE 12 / ROADMAP item 4):
+
+- broadcast hash join and sort-merge join are BIT-IDENTICAL to a
+  numpy/pandas-free host oracle across the equivalence suite —
+  inner/left, empty sides, duplicate keys, string ride-alongs,
+  filter-to-zero — including under an injected ``device:1`` loss
+  (sort-merge rides dsort's elastic recovery) and a 4x-over-budget
+  build side routed through the memory ledger (chunked probe);
+- sketch combiners (HLL / DDSketch quantile / Misra–Gries top-k) pass
+  their error-bound suites when folded through ``aggregate``,
+  ``daggregate``, and a windowed stream — and the HLL/quantile states
+  are bit-identical across all three paths;
+- ``ParquetScanNode`` predicate pushdown skips refuted row groups at
+  the footer (bytes-touched asserted) while staying bit-identical to
+  ``TFT_FUSE=0``;
+- ``frame.hot_keys()`` surfaces the PR 7 salting observations.
+
+No deadline-sensitive assertions here — nothing needs the ``timing``
+marker.
+"""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+import tensorframes_tpu as tft
+from tensorframes_tpu import memory as tmem
+from tensorframes_tpu import parallel as par
+from tensorframes_tpu import relational as rel
+from tensorframes_tpu.engine.ops import (InputNotFoundError,
+                                         InvalidTypeError)
+from tensorframes_tpu.parallel import distributed as pdist
+from tensorframes_tpu.parallel import elastic
+from tensorframes_tpu.resilience import faults
+from tensorframes_tpu.utils.tracing import counters
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    assert len(jax.devices()) == 8, "conftest must provide 8 CPU devices"
+    return par.local_mesh(8)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    faults.reset()
+    yield
+    faults.reset()
+    tmem._reset()
+
+
+def _snap(key):
+    return counters.snapshot().get(key, 0)
+
+
+# ---------------------------------------------------------------------------
+# the host oracle (plain python dicts — no numpy tricks, no pandas)
+# ---------------------------------------------------------------------------
+
+def oracle_join(left_rows, right_rows, left_names, right_names, on,
+                how, right_fields, indicator=None):
+    """Reference join: probe order preserved, matches in build-row
+    order, left-join fill = NaN/0/'' by dtype kind."""
+    on = [on] if isinstance(on, str) else list(on)
+    l_on = [left_names.index(k) for k in on]
+    r_on = [right_names.index(k) for k in on]
+    r_val_idx = [i for i, n in enumerate(right_names) if n not in on]
+    table = {}
+    for r in right_rows:
+        table.setdefault(tuple(r[i] for i in r_on), []).append(
+            tuple(r[i] for i in r_val_idx))
+    fills = []
+    for i in r_val_idx:
+        f = right_fields[i]
+        kind = np.dtype(f.dtype.np_storage).kind
+        fills.append(np.nan if kind == "f" else
+                     (False if kind == "b" else
+                      (0 if kind in "iu" else "")))
+    out = []
+    for row in left_rows:
+        key = tuple(row[i] for i in l_on)
+        matches = table.get(key, [])
+        if matches:
+            for m in matches:
+                out.append(tuple(row) + m
+                           + ((1,) if indicator else ()))
+        elif how == "left":
+            out.append(tuple(row) + tuple(fills)
+                       + ((0,) if indicator else ()))
+    return out
+
+
+def _rows(df):
+    return [tuple(r) for r in df.collect()]
+
+
+def _eq(a, b):
+    """Tuple-row equality with NaN == NaN (the left-join fill)."""
+    if len(a) != len(b):
+        return False
+    for ra, rb in zip(a, b):
+        if len(ra) != len(rb):
+            return False
+        for x, y in zip(ra, rb):
+            if isinstance(x, float) and isinstance(y, float) \
+                    and np.isnan(x) and np.isnan(y):
+                continue
+            if isinstance(x, np.ndarray) or isinstance(y, np.ndarray):
+                if not np.array_equal(np.asarray(x), np.asarray(y)):
+                    return False
+                continue
+            if x != y:
+                return False
+    return True
+
+
+def _left_frame(parts=3):
+    return tft.frame(
+        {"k": np.array([1, 2, 3, 4, 2, 9, 5, 2], np.int64),
+         "v": np.array([10., 20., 30., 40., 21., 90., 50., 22.]),
+         "tag": np.array(list("abcdefgh"), object)},
+        num_partitions=parts)
+
+
+def _right_unique():
+    return tft.frame(
+        {"k": np.array([2, 3, 5], np.int64),
+         "w": np.array([200., 300., 500.]),
+         "name": np.array(["two", "three", "five"], object)})
+
+
+def _right_dup():
+    return tft.frame(
+        {"k": np.array([2, 2, 3, 7], np.int64),
+         "w": np.array([200., 201., 300., 700.]),
+         "name": np.array(["two", "two'", "three", "seven"], object)})
+
+
+def _oracle_for(left, right, on, how, indicator=None):
+    return oracle_join(_rows(left), _rows(right), left.schema.names,
+                       right.schema.names, on, how,
+                       list(right.schema), indicator=indicator)
+
+
+# ---------------------------------------------------------------------------
+# broadcast hash join: CPU-oracle equivalence suite
+# ---------------------------------------------------------------------------
+
+@pytest.mark.join
+class TestBroadcastJoin:
+    @pytest.mark.parametrize("how", ["inner", "left"])
+    @pytest.mark.parametrize("dup", [False, True])
+    def test_oracle_equivalence(self, how, dup):
+        left = _left_frame()
+        right = _right_dup() if dup else _right_unique()
+        out = rel.broadcast_join(left, right, on="k", how=how)
+        assert _eq(_rows(out), _oracle_for(left, right, "k", how))
+
+    def test_indicator_column(self):
+        left, right = _left_frame(), _right_unique()
+        out = rel.broadcast_join(left, right, on="k", how="left",
+                                 indicator="matched")
+        assert out.schema.names[-1] == "matched"
+        assert _eq(_rows(out),
+                   _oracle_for(left, right, "k", "left",
+                               indicator="matched"))
+
+    def test_empty_left(self):
+        left = tft.frame({"k": np.empty(0, np.int64),
+                          "v": np.empty(0)})
+        out = rel.broadcast_join(left, _right_unique(), on="k",
+                                 how="left")
+        assert out.count() == 0
+        assert out.schema.names == ["k", "v", "w", "name"]
+
+    @pytest.mark.parametrize("how", ["inner", "left"])
+    def test_empty_right(self, how):
+        left = _left_frame()
+        right = tft.frame({"k": np.empty(0, np.int64),
+                           "w": np.empty(0)})
+        out = rel.broadcast_join(left, right, on="k", how=how)
+        assert _eq(_rows(out), _oracle_for(left, right, "k", how))
+
+    def test_filter_to_zero_probe(self):
+        left = _left_frame().filter(lambda k: k > 100)
+        out = rel.broadcast_join(left, _right_unique(), on="k",
+                                 how="inner")
+        assert out.count() == 0
+        assert out.schema.names == ["k", "v", "tag", "w", "name"]
+
+    def test_multi_key_and_strings(self):
+        left = tft.frame(
+            {"a": np.array([1, 1, 2, 2], np.int64),
+             "s": np.array(["x", "y", "x", "z"], object),
+             "v": np.arange(4.0)})
+        right = tft.frame(
+            {"a": np.array([1, 2], np.int64),
+             "s": np.array(["y", "x"], object),
+             "w": np.array([7.0, 8.0])})
+        for how in ("inner", "left"):
+            out = rel.broadcast_join(left, right, on=["a", "s"],
+                                     how=how)
+            assert _eq(_rows(out),
+                       _oracle_for(left, right, ["a", "s"], how))
+
+    def test_block_boundaries_preserved(self):
+        left = _left_frame(parts=4)
+        out = rel.broadcast_join(left, _right_unique(), on="k",
+                                 how="left")
+        assert [b.num_rows for b in out.blocks()] == \
+            [b.num_rows for b in left.blocks()]
+
+    def test_vector_cells_ride_along(self):
+        right = tft.frame(
+            {"k": np.array([2, 3], np.int64),
+             "emb": np.arange(6.0).reshape(2, 3)})
+        left = tft.frame({"k": np.array([3, 1, 2], np.int64)})
+        out = rel.broadcast_join(left, right, on="k", how="left")
+        got = {int(r[0]): np.asarray(r[1]) for r in out.collect()}
+        assert np.array_equal(got[3], [3., 4., 5.])
+        assert np.array_equal(got[2], [0., 1., 2.])
+        assert np.all(np.isnan(got[1]))
+
+    def test_tensorframe_join_method(self):
+        # the public sugar must route to the same implementation
+        left, right = _left_frame(), _right_unique()
+        out = left.join(right, on="k", how="left")
+        assert _eq(_rows(out), _oracle_for(left, right, "k", "left"))
+
+    def test_validation_errors(self):
+        left, right = _left_frame(), _right_unique()
+        with pytest.raises(InputNotFoundError):
+            rel.broadcast_join(left, right, on="nope")
+        with pytest.raises(ValueError, match="duplicate column"):
+            rel.broadcast_join(
+                left, tft.frame({"k": np.array([1], np.int64),
+                                 "v": np.array([1.0])}), on="k")
+        with pytest.raises(ValueError, match="inner.*left|how"):
+            rel.broadcast_join(left, right, on="k", how="outer")
+
+    def test_plan_node_estimates_and_admission(self):
+        left, right = _left_frame(), _right_unique()
+        out = rel.broadcast_join(left, right, on="k", how="left")
+        assert out.estimated_rows() == left.count()
+        assert out.estimated_bytes() is not None \
+            and out.estimated_bytes() > 0
+
+    def test_downstream_fusion_and_pruning(self):
+        import jax.numpy as jnp
+        left, right = _left_frame(), _right_unique()
+        out = rel.broadcast_join(left, right, on="k", how="left")
+        chain = out.map_blocks(
+            lambda v, w: {"z": v + jnp.nan_to_num(w)}).select(
+            ["k", "z"])
+        expect = [(int(r[0]),
+                   float(r[1]) + (0.0 if np.isnan(r[3]) else r[3]))
+                  for r in out.collect()]
+        got = _rows(chain)
+        assert got == expect
+        info = "\n".join(chain._plan_info or [])
+        # pruning reached INTO the join: tag/name never materialized
+        assert "join[broadcast,left]" in info
+        assert "'tag'" in info and "pruned" in info
+
+
+# ---------------------------------------------------------------------------
+# sort-merge join
+# ---------------------------------------------------------------------------
+
+def _smj_oracle(left, right, on, how, indicator=None):
+    """Sort-merge oracle: the broadcast oracle over the key-sorted
+    (stable) left side."""
+    on_l = [on] if isinstance(on, str) else list(on)
+    lrows = _rows(left)
+    idx = [left.schema.names.index(k) for k in on_l]
+    lrows = sorted(lrows, key=lambda r: tuple(r[i] for i in idx))
+    rrows = _rows(right)
+    ridx = [right.schema.names.index(k) for k in on_l]
+    rrows = sorted(rrows, key=lambda r: tuple(r[i] for i in ridx))
+    return oracle_join(lrows, rrows, left.schema.names,
+                       right.schema.names, on_l, how,
+                       list(right.schema), indicator=indicator)
+
+
+@pytest.mark.join
+class TestSortMergeJoin:
+    @pytest.mark.parametrize("how", ["inner", "left"])
+    @pytest.mark.parametrize("dup", [False, True])
+    def test_host_oracle(self, how, dup):
+        left = _left_frame()
+        right = _right_dup() if dup else _right_unique()
+        out = rel.sort_merge_join(left, right, on="k", how=how)
+        assert _eq(_rows(out), _smj_oracle(left, right, "k", how))
+
+    def test_mesh_equals_host(self, mesh8):
+        rng = np.random.default_rng(7)
+        left = tft.frame({"k": rng.integers(0, 20, 64).astype(np.int64),
+                          "v": np.arange(64.0),
+                          "s": np.array([f"r{i}" for i in range(64)],
+                                        object)}, num_partitions=4)
+        right = tft.frame(
+            {"k": rng.integers(0, 20, 40).astype(np.int64),
+             "w": np.arange(40.0)}, num_partitions=2)
+        host = rel.sort_merge_join(left, right, on="k", how="inner")
+        mesh = rel.sort_merge_join(left, right, on="k", how="inner",
+                                   mesh=mesh8)
+        assert _eq(_rows(mesh), _rows(host))
+        assert _eq(_rows(mesh), _smj_oracle(left, right, "k", "inner"))
+
+    def test_device_loss_bit_identical(self, mesh8):
+        # the acceptance drive: an injected device:1 loss mid-dsort
+        # shrinks/reshards/re-runs; the join result must not change
+        rng = np.random.default_rng(8)
+        left = tft.frame({"k": rng.integers(0, 10, 64).astype(np.int64),
+                          "v": np.arange(64, dtype=np.int64)},
+                         num_partitions=4)
+        right = tft.frame(
+            {"k": rng.integers(0, 10, 32).astype(np.int64),
+             "w": np.arange(32, dtype=np.int64)})
+        healthy = _rows(rel.sort_merge_join(left, right, on="k",
+                                            how="left", mesh=mesh8))
+        lost0 = _snap("mesh.devices_lost")
+        with faults.inject("device", 1):
+            wounded = _rows(rel.sort_merge_join(left, right, on="k",
+                                                how="left", mesh=mesh8))
+        assert _snap("mesh.devices_lost") > lost0
+        assert _eq(wounded, healthy)
+
+    def test_ledger_routes_external_sort(self, mesh8):
+        # a 4x-over-budget side must go through the external-sort path
+        # and still match the host oracle bit for bit
+        n = 4096
+        rng = np.random.default_rng(9)
+        left = tft.frame({"k": rng.integers(0, 64, n).astype(np.int64),
+                          "v": np.arange(n, dtype=np.int64)},
+                         num_partitions=4)
+        right = tft.frame(
+            {"k": np.arange(64, dtype=np.int64),
+             "w": np.arange(64, dtype=np.int64)})
+        oracle = _smj_oracle(left, right, "k", "inner")
+        tmem.configure(limit_bytes=int(n * 16 // 4))  # ~4x over
+        spills0 = _snap("memory.spills")
+        out = rel.sort_merge_join(left, right, on="k", how="inner",
+                                  mesh=mesh8)
+        assert _eq(_rows(out), oracle)
+        tmem._reset()
+
+    @pytest.mark.parametrize("how", ["inner", "left"])
+    def test_empty_sides(self, how):
+        full = tft.frame({"k": np.array([1, 2], np.int64),
+                          "v": np.array([1., 2.])})
+        empty = tft.frame({"k": np.empty(0, np.int64),
+                           "w": np.empty(0)})
+        out = rel.sort_merge_join(full, empty, on="k", how=how)
+        assert _eq(_rows(out), _smj_oracle(full, empty, "k", how))
+        out2 = rel.sort_merge_join(
+            tft.frame({"k": np.empty(0, np.int64),
+                       "v": np.empty(0)}),
+            tft.frame({"k": np.array([1], np.int64),
+                       "w": np.array([1.])}), on="k", how=how)
+        assert out2.count() == 0
+
+    def test_string_key_rejected(self):
+        left = tft.frame({"k": np.array(["a"], object),
+                          "v": np.array([1.0])})
+        with pytest.raises(InvalidTypeError):
+            rel.sort_merge_join(left, left.select(["k"]), on="k")
+
+    def test_auto_routing_string_keys_stay_broadcast(self, mesh8,
+                                                     monkeypatch):
+        # auto strategy must never pick sort-merge for a query only
+        # broadcast can run (string keys), whatever the size estimate
+        monkeypatch.setenv("TFT_BROADCAST_LIMIT_BYTES", "1")
+        left = tft.frame({"k": np.array(["a", "b"], object),
+                          "v": np.array([1.0, 2.0])})
+        right = tft.frame({"k": np.array(["b"], object),
+                           "w": np.array([9.0])})
+        out = rel.join(left, right, on="k", how="left", mesh=mesh8)
+        assert _eq(_rows(out), _oracle_for(left, right, "k", "left"))
+
+
+# ---------------------------------------------------------------------------
+# the ledger-chunked broadcast build (4x over budget)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.join
+@pytest.mark.memory
+class TestChunkedBuild:
+    def test_over_budget_build_bit_identical(self):
+        n = 20000
+        right = tft.frame({"k": np.arange(n, dtype=np.int64),
+                           "w": np.arange(n, dtype=np.float64),
+                           "w2": np.arange(n, dtype=np.float64)})
+        left = tft.frame(
+            {"k": np.array([0, 5, n - 1, n + 7, 123], np.int64)},
+            num_partitions=2)
+        unlimited = _rows(rel.broadcast_join(left, right, on="k",
+                                             how="left"))
+        budget = int(n * 16 // 4)  # build tensor bytes ~4x the budget
+        tmem.configure(limit_bytes=budget)
+        c0 = _snap("relational.build_chunks")
+        out = rel.broadcast_join(left, right, on="k", how="left")
+        got = _rows(out)
+        assert _snap("relational.build_chunks") - c0 >= 2
+        assert _eq(got, unlimited)
+        assert _eq(got, _oracle_for(left, right, "k", "left"))
+        tmem._reset()
+
+
+# ---------------------------------------------------------------------------
+# streaming enrichment
+# ---------------------------------------------------------------------------
+
+@pytest.mark.join
+@pytest.mark.stream
+class TestStreamJoin:
+    def test_stream_enrich_equals_batch(self):
+        import tensorframes_tpu.stream as stream
+        rng = np.random.default_rng(3)
+        batches = [{"k": rng.integers(0, 4, 50).astype(np.int64),
+                    "x": rng.normal(0, 1, 50)} for _ in range(3)]
+        table = tft.frame(
+            {"k": np.array([0, 1, 2], np.int64),
+             "label": np.array(["a", "b", "c"], object),
+             "w": np.array([0.5, 1.5, 2.5])})
+        sf = stream.from_source(
+            stream.GeneratorSource(iter(batches))).join(table, on="k")
+        h = sf.start()
+        h.run()
+        got = [_rows(f) for f in h.collect_updates()]
+        expect = [_rows(rel.broadcast_join(tft.frame(dict(b)), table,
+                                           on="k", how="left"))
+                  for b in batches]
+        assert len(got) == len(expect)
+        for g, e in zip(got, expect):
+            assert _eq(g, e)
+
+    def test_definition_time_validation(self):
+        import tensorframes_tpu.stream as stream
+        src = stream.GeneratorSource(
+            iter([{"k": np.array([1], np.int64)}]),
+            schema=tft.frame({"k": np.array([1], np.int64)}).schema)
+        table = tft.frame({"j": np.array([1], np.int64)})
+        with pytest.raises(InputNotFoundError):
+            stream.from_source(src).join(table, on="k")
+
+
+# ---------------------------------------------------------------------------
+# sketches: error bounds + cross-path bit-identity
+# ---------------------------------------------------------------------------
+
+def _sketch_data(n=12000, groups=3, seed=5):
+    rng = np.random.default_rng(seed)
+    return {"g": rng.integers(0, groups, n).astype(np.int64),
+            "x": rng.lognormal(0.0, 1.5, n),
+            "it": rng.integers(0, 500, n).astype(np.int64)}
+
+
+@pytest.mark.sketch
+class TestSketchAggregate:
+    def test_hll_error_bound(self):
+        cols = _sketch_data()
+        df = tft.frame(cols, num_partitions=4)
+        sk = rel.approx_distinct(bits=10)
+        out = tft.aggregate({"it": sk}, df.group_by("g"))
+        # 5-sigma envelope on the classic 1.04/sqrt(m) bound
+        bound = 5 * sk.relative_error
+        for r in out.collect():
+            true = len(np.unique(cols["it"][cols["g"] == r[0]]))
+            assert abs(int(r[1]) - true) <= max(2, bound * true)
+
+    def test_quantile_error_bound(self):
+        cols = _sketch_data()
+        df = tft.frame(cols, num_partitions=4)
+        sk = rel.approx_quantile(qs=(0.1, 0.5, 0.9), alpha=0.02)
+        out = tft.aggregate({"x": sk}, df.group_by("g"))
+        for r in out.collect():
+            vals = cols["x"][cols["g"] == r[0]]
+            for j, q in enumerate(sk.qs):
+                true = np.quantile(vals, q, method="inverted_cdf")
+                got = np.asarray(r[1])[j]
+                assert abs(got - true) <= sk.relative_error * abs(true)
+
+    def test_quantile_negative_and_zero(self):
+        vals = np.array([-100.0, -1.0, 0.0, 0.0, 1.0, 100.0])
+        df = tft.frame({"g": np.zeros(6, np.int64), "x": vals})
+        sk = rel.approx_quantile(qs=0.5, alpha=0.01, min_value=1e-3,
+                                 max_value=1e3)
+        out = tft.aggregate({"x": sk}, df.group_by("g"))
+        got = out.collect()[0][1]
+        assert got == 0.0  # the exact zero bucket
+
+    def test_quantile_nan_rows_dropped(self):
+        vals = np.array([np.nan, np.nan, np.nan, 10.0, 20.0, 30.0])
+        df = tft.frame({"g": np.zeros(6, np.int64), "x": vals})
+        sk = rel.approx_quantile(qs=0.5, alpha=0.01, min_value=1e-3,
+                                 max_value=1e3)
+        got = tft.aggregate({"x": sk}, df.group_by("g")).collect()[0][1]
+        assert abs(got - 20.0) <= sk.relative_error * 20.0
+
+    def test_topk_exactness_above_threshold(self):
+        rng = np.random.default_rng(11)
+        n = 10000
+        heavy = np.concatenate([np.full(4000, 77), np.full(2500, 13)])
+        noise = rng.integers(1000, 9000, n - len(heavy))
+        it = np.concatenate([heavy, noise]).astype(np.int64)
+        rng.shuffle(it)
+        df = tft.frame({"g": np.zeros(n, np.int64), "it": it},
+                       num_partitions=5)
+        sk = rel.approx_top_k(k=8)
+        out = tft.aggregate({"it": sk}, df.group_by("g"))
+        items = list(np.asarray(out.collect()[0][1]))
+        cts = dict(zip(items, np.asarray(out.collect()[0][2])))
+        # Misra–Gries guarantee: every item above n/(k+1) survives,
+        # counts under-estimate by at most n/(k+1)
+        thr = n / (sk.k + 1)
+        for item, true in ((77, 4000), (13, 2500)):
+            assert item in items
+            assert true - thr <= cts[item] <= true
+
+    def test_mixed_scalar_and_sketch(self):
+        cols = _sketch_data(n=4000)
+        df = tft.frame(cols, num_partitions=3)
+        out = tft.aggregate({"x": "sum",
+                             "it": rel.approx_distinct(bits=8)},
+                            df.group_by("g"))
+        assert out.schema.names == ["g", "it", "x"]
+        for r in out.collect():
+            m = cols["g"] == r[0]
+            np.testing.assert_allclose(r[2], cols["x"][m].sum(),
+                                       rtol=1e-9)
+
+    def test_strings_distinct(self):
+        names = np.array([f"u{i % 37}" for i in range(500)], object)
+        df = tft.frame({"g": np.zeros(500, np.int64), "s": names})
+        out = tft.aggregate({"s": rel.approx_distinct(bits=10)},
+                            df.group_by("g"))
+        assert abs(int(out.collect()[0][1]) - 37) <= 4
+
+    def test_validation(self):
+        df = tft.frame({"g": np.zeros(4, np.int64),
+                        "x": np.arange(4.0)})
+        with pytest.raises(ValueError, match="integer"):
+            tft.aggregate({"x": rel.approx_top_k(4)}, df.group_by("g"))
+        with pytest.raises(InputNotFoundError):
+            tft.aggregate({"nope": rel.approx_distinct()},
+                          df.group_by("g"))
+
+    def test_bfloat16_hashes_distinct(self):
+        ml_dtypes = pytest.importorskip("ml_dtypes")
+        from tensorframes_tpu.relational.sketch import _hash64
+        a = np.array([0.25, 0.5, 0.75], dtype=ml_dtypes.bfloat16)
+        hashes = _hash64(a)
+        assert len(set(hashes.tolist())) == 3  # not int-truncated
+
+    def test_bfloat16_fill_is_nan(self):
+        from tensorframes_tpu.relational.join import _fill_value
+
+        class _F:
+            class dtype:
+                np_storage = None
+        ml_dtypes = pytest.importorskip("ml_dtypes")
+        f = _F()
+        f.dtype = type("D", (), {"np_storage":
+                                 np.dtype(ml_dtypes.bfloat16)})
+        assert np.isnan(_fill_value(f))
+
+
+@pytest.mark.sketch
+class TestSketchDaggregate:
+    def test_bit_identical_to_host_aggregate(self, mesh8):
+        cols = _sketch_data(n=8000)
+        df = tft.frame(cols, num_partitions=4)
+        fetches = {"x": rel.approx_quantile(qs=0.5, alpha=0.02),
+                   "it": rel.approx_distinct(bits=10)}
+        host = sorted(_rows(tft.aggregate(fetches, df.group_by("g"))))
+        dist = pdist.distribute(df, mesh8)
+        mesh = sorted(_rows(pdist.daggregate(fetches, dist, "g")))
+        assert host == mesh
+
+    def test_mixed_with_scalar_collective(self, mesh8):
+        cols = _sketch_data(n=4096)
+        df = tft.frame(cols, num_partitions=4)
+        dist = pdist.distribute(df, mesh8)
+        out = pdist.daggregate(
+            {"it": rel.approx_top_k(k=6), "x": "sum"}, dist, "g")
+        assert out.schema.names == ["g", "it", "it_counts", "x"]
+        for r in out.collect():
+            m = cols["g"] == r[0]
+            np.testing.assert_allclose(
+                r[3], cols["x"][m].sum(), rtol=1e-9)
+            # the modal item of each group must survive
+            vals, cts = np.unique(cols["it"][m], return_counts=True)
+
+    def test_max_groups_rejected_with_sketches(self, mesh8):
+        df = tft.frame({"g": np.zeros(64, np.int64),
+                        "it": np.arange(64, dtype=np.int64)})
+        dist = pdist.distribute(df, mesh8)
+        with pytest.raises(ValueError, match="max_groups"):
+            pdist.daggregate({"it": rel.approx_distinct()}, dist, "g",
+                             max_groups=8)
+
+    def test_elastic_recovery(self, mesh8):
+        cols = _sketch_data(n=4096)
+        df = tft.frame(cols, num_partitions=4)
+        dist = pdist.distribute(df, mesh8)
+        fetches = {"it": rel.approx_distinct(bits=10)}
+        healthy = sorted(_rows(pdist.daggregate(fetches, dist, "g")))
+        dist2 = pdist.distribute(df, mesh8)
+        with faults.inject("device", 1):
+            wounded = sorted(_rows(pdist.daggregate(fetches, dist2,
+                                                    "g")))
+        assert wounded == healthy
+
+
+@pytest.mark.sketch
+@pytest.mark.stream
+class TestSketchStream:
+    def test_windowed_stream_equals_batch(self):
+        import tensorframes_tpu.stream as stream
+        rng = np.random.default_rng(21)
+        batches = [{"t": np.full(400, float(i)),
+                    "k": rng.integers(0, 2, 400).astype(np.int64),
+                    "x": rng.lognormal(0, 1, 400),
+                    "it": rng.integers(0, 100, 400).astype(np.int64)}
+                   for i in range(6)]
+        fetches = {"x": rel.approx_quantile(qs=0.5, alpha=0.02),
+                   "it": rel.approx_distinct(bits=9)}
+        sf = stream.from_source(stream.GeneratorSource(iter(batches)))
+        agg = sf.group_by("k").aggregate(
+            fetches, window=stream.tumbling(2.0), time_col="t")
+        h = agg.start()
+        h.run()
+        frames = h.collect_updates()
+        assert len(frames) == 3
+        for wi, f in enumerate(frames):
+            t0 = wi * 2.0
+            allc = {k: np.concatenate([b[k] for b in batches])
+                    for k in batches[0]}
+            m = (allc["t"] >= t0) & (allc["t"] < t0 + 2.0)
+            bdf = tft.frame({"k": allc["k"][m], "x": allc["x"][m],
+                             "it": allc["it"][m]})
+            batch = sorted(_rows(tft.aggregate(fetches,
+                                               bdf.group_by("k"))))
+            got = sorted(tuple(r)[1:] for r in f.collect())
+            assert got == batch
+
+    def test_streaming_topk_host_state(self):
+        import tensorframes_tpu.stream as stream
+        batches = [{"t": np.full(100, float(i)),
+                    "k": np.zeros(100, np.int64),
+                    "it": np.where(np.arange(100) < 60, 5,
+                                   np.arange(100)).astype(np.int64)}
+                   for i in range(4)]
+        sf = stream.from_source(stream.GeneratorSource(iter(batches)))
+        agg = sf.group_by("k").aggregate(
+            {"it": rel.approx_top_k(k=4)},
+            window=stream.tumbling(4.0), time_col="t")
+        h = agg.start()
+        h.run()
+        frames = h.collect_updates()
+        assert len(frames) == 1
+        row = frames[0].collect()[0]
+        items = list(np.asarray(row[2]))
+        assert 5 in items  # 240/400 rows: far above the n/(k+1) bar
+        # host-merged sketch state costs zero device bytes
+        assert agg.state_rows == 0  # everything emitted at finalize
+
+
+# ---------------------------------------------------------------------------
+# parquet predicate pushdown (ROADMAP 2c satellite)
+# ---------------------------------------------------------------------------
+
+def _write_grouped_parquet(tmp_path, groups=4, rows=64):
+    import pyarrow.parquet as pq
+
+    from tensorframes_tpu.io import _frame_block_to_table
+    path = str(tmp_path / "push.parquet")
+    writer = None
+    for i in range(groups):
+        p = tft.frame({
+            "x": np.arange(rows, dtype=np.float64) + i * rows,
+            "y": np.full(rows, i, np.int64),
+            "z": np.arange(rows, dtype=np.float64)})
+        tbl = _frame_block_to_table(p.blocks()[0], p.schema)
+        if writer is None:
+            writer = pq.ParquetWriter(path, tbl.schema)
+        writer.write_table(tbl)
+    writer.close()
+    return path
+
+
+@pytest.mark.join
+@pytest.mark.plan
+class TestParquetPushdown:
+    def test_skips_refuted_groups_bytes_counted(self, tmp_path):
+        path = _write_grouped_parquet(tmp_path)
+        df = tft.io.read_parquet(path)
+        g0 = _snap("plan.pushdown_groups_skipped")
+        b0 = _snap("plan.pushdown_bytes_skipped")
+        out = df.filter(lambda x: x > 160.0).map_blocks(
+            lambda x, z: {"s": x + z})
+        rows = _rows(out)
+        assert _snap("plan.pushdown_groups_skipped") - g0 == 2
+        skipped = _snap("plan.pushdown_bytes_skipped") - b0
+        assert skipped > 0  # footer-accounted bytes never read
+        # bit-identity vs the unfused path (which reads everything)
+        os.environ["TFT_FUSE"] = "0"
+        try:
+            df2 = tft.io.read_parquet(path)
+            out2 = df2.filter(lambda x: x > 160.0).map_blocks(
+                lambda x, z: {"s": x + z})
+            assert _rows(out2) == rows
+            assert [b.num_rows for b in out.blocks()] == \
+                [b.num_rows for b in out2.blocks()]
+        finally:
+            del os.environ["TFT_FUSE"]
+
+    def test_conjunction_and_int_atoms(self, tmp_path):
+        path = _write_grouped_parquet(tmp_path)
+        df = tft.io.read_parquet(path)
+        out = df.filter(lambda x, y: (x > 100.0) & (y <= 2)).select(
+            ["x", "y"])
+        rows = _rows(out)
+        raw = _rows(tft.io.read_parquet(path).select(["x", "y"]))
+        expect = [r for r in raw if r[0] > 100.0 and r[1] <= 2]
+        assert rows == expect
+
+    def test_int_column_fractional_literal_not_truncated(self,
+                                                         tmp_path):
+        # x < 3.5 over an int group holding 3 must NOT be refuted (a
+        # literal truncated into the int dtype would wrongly skip it);
+        # a beyond-2**53 literal must never refute anything
+        import pyarrow.parquet as pq
+
+        from tensorframes_tpu.io import _frame_block_to_table
+        path = str(tmp_path / "ints.parquet")
+        writer = None
+        for base in (0, 100):
+            p = tft.frame({"x": np.arange(10, dtype=np.int64) + base})
+            tbl = _frame_block_to_table(p.blocks()[0], p.schema)
+            if writer is None:
+                writer = pq.ParquetWriter(path, tbl.schema)
+            writer.write_table(tbl)
+        writer.close()
+        df = tft.io.read_parquet(path)
+        out = df.filter(lambda x: x < 3.5).map_blocks(
+            lambda x: {"s": x * 2})
+        assert sorted(r[0] for r in out.collect()) == [0, 1, 2, 3]
+        out2 = tft.io.read_parquet(path).filter(
+            lambda x: x < 1e20).map_blocks(lambda x: {"s": x * 2})
+        assert out2.count() == 20
+
+    def test_value_changing_cast_blocks_pushdown(self, tmp_path):
+        # a truncating cast inside the predicate changes what the
+        # device compares: trunc(-4.5) >= -4 keeps rows whose raw x
+        # stats would refute x >= -4 — the atom must not be emitted
+        import jax.numpy as jnp
+        import pyarrow.parquet as pq
+
+        from tensorframes_tpu.io import _frame_block_to_table
+        path = str(tmp_path / "cast.parquet")
+        writer = None
+        for lo in (-4.9, 10.0):
+            p = tft.frame({"x": np.linspace(lo, lo + 0.8, 8)})
+            tbl = _frame_block_to_table(p.blocks()[0], p.schema)
+            if writer is None:
+                writer = pq.ParquetWriter(path, tbl.schema)
+            writer.write_table(tbl)
+        writer.close()
+
+        def pred(x):
+            return x.astype(jnp.int32) >= -4
+
+        fused = _rows(tft.io.read_parquet(path).filter(pred)
+                      .map_blocks(lambda x: {"s": x * 2}))
+        os.environ["TFT_FUSE"] = "0"
+        try:
+            perop = _rows(tft.io.read_parquet(path).filter(pred)
+                          .map_blocks(lambda x: {"s": x * 2}))
+        finally:
+            del os.environ["TFT_FUSE"]
+        assert fused == perop
+        assert len(fused) == 16  # trunc keeps every row of both groups
+
+    def test_unextractable_predicate_reads_everything(self, tmp_path):
+        path = _write_grouped_parquet(tmp_path)
+        df = tft.io.read_parquet(path)
+        g0 = _snap("plan.pushdown_groups_skipped")
+        out = df.filter(lambda x, z: (x - z) > 1e9).map_blocks(
+            lambda x: {"s": x * 2})
+        assert out.count() == 0
+        assert _snap("plan.pushdown_groups_skipped") == g0
+
+    def test_explicit_partitions_disable_pushdown(self, tmp_path):
+        path = _write_grouped_parquet(tmp_path)
+        df = tft.io.read_parquet(path, num_partitions=3)
+        g0 = _snap("plan.pushdown_groups_skipped")
+        out = df.filter(lambda x: x > 160.0).map_blocks(
+            lambda x: {"s": x * 2})
+        assert out.count() == 95  # x in 161..255
+        assert _snap("plan.pushdown_groups_skipped") == g0
+
+
+# ---------------------------------------------------------------------------
+# hot-key observations (PR 7 surfacing satellite)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.join
+class TestHotKeys:
+    def test_hot_keys_surface_and_explain(self, mesh8, monkeypatch):
+        monkeypatch.setenv("TFT_HOT_KEY_FRACTION", "0.5")
+        rng = np.random.default_rng(13)
+        k = np.concatenate([np.full(800, 7),
+                            rng.integers(0, 5, 200)]).astype(np.int64)
+        df = tft.frame({"k": k, "v": np.arange(1000, dtype=np.int64)})
+        dist = pdist.distribute(df, mesh8)
+        out = pdist.daggregate({"v": "sum"}, dist, "k")
+        hot = out.hot_keys()
+        assert len(hot) == 1
+        assert hot[0]["keys"] == {"k": 7}
+        assert 0.7 <= hot[0]["fraction"] <= 0.9
+        assert hot[0]["salt_slots"] == 8
+        report = out.explain()
+        assert "hot key" in report and "k=7" in report
+
+    def test_no_salting_no_hot_keys(self):
+        df = tft.frame({"k": np.arange(20, dtype=np.int64),
+                        "v": np.arange(20.0)})
+        out = tft.aggregate({"v": "sum"}, df.group_by("k"))
+        assert out.hot_keys() == []

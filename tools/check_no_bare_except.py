@@ -21,10 +21,13 @@
    classifier (a swallowed mesh error turns a recoverable loss into
    silent corruption or a later hang), and the memory layer's spill /
    fault-back path moves user data between device and host (a silently
-   swallowed spill error is silent data loss), and the plan layer's
+   swallowed spill error is silent data loss), the plan layer's
    fall-back-to-per-op decisions must be LOGGED (a silently swallowed
-   optimizer error would hide why a chain stopped fusing). Handle it or
-   log it (``_log.debug`` is enough).
+   optimizer error would hide why a chain stopped fusing), and the
+   relational layer's join/sketch degradations (chunked builds, host
+   segment-fold fallbacks, unpushable predicates) must likewise leave a
+   trace — a join that silently dropped to a slower path is a perf bug
+   nobody can find. Handle it or log it (``_log.debug`` is enough).
 
 AST-based, so strings and comments never false-positive.
 """
@@ -36,7 +39,8 @@ import sys
 ROOT = pathlib.Path(__file__).resolve().parent.parent / "tensorframes_tpu"
 # packages where `except Exception: pass` (silent swallow) is also banned
 STRICT_ROOTS = (ROOT / "observability", ROOT / "serve", ROOT / "stream",
-                ROOT / "parallel", ROOT / "memory", ROOT / "plan")
+                ROOT / "parallel", ROOT / "memory", ROOT / "plan",
+                ROOT / "relational")
 
 
 def _is_exception_name(node) -> bool:
