@@ -105,6 +105,16 @@ if [ "${1:-}" = "--join" ]; then
     -m 'join or sketch' "$@"
 fi
 
+# --preempt: run only the preemption/cancellation/elastic-growth lane
+# (tests/test_preempt.py + growth tests: checkpointed park/resume
+# bit-identity, scheduler cancel races, priority preemption, mesh
+# admit/churn) — fast, CPU-only (8 virtual devices), no native build
+if [ "${1:-}" = "--preempt" ]; then
+  shift
+  echo "== preempt lane (pytest -m preempt, CPU) =="
+  exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m preempt "$@"
+fi
+
 # --timing: run only the wall-clock-sensitive deadline tests, serially
 # (they flake under concurrent suite load; TFT_TIMING_MARGIN widens
 # their assertion bounds further on badly oversubscribed boxes)

@@ -283,12 +283,31 @@ def _validate_reduce(comp: Computation, schema: Schema,
 # ---------------------------------------------------------------------------
 
 def _stream_thunk(df: TensorFrame, ex, run_block, submit_block,
-                  drain_block):
+                  drain_block, tag: Optional[str] = None):
     """The lazy forcing every streaming op shares: blocks through the
-    bounded in-flight window, drained FIFO (``docs/pipeline.md``)."""
+    bounded in-flight window, drained FIFO (``docs/pipeline.md``).
+    ``tag`` is the stream's stable identity for preemption checkpoints
+    (op + computation input/output names + the input frame's plan
+    string — identical across a park and its resume, distinct between
+    ops); ``None`` (the safe default for any future call site that
+    forgets one) makes the stream preemptible WITHOUT checkpointing."""
     return lambda: _pipeline.run_pipelined(
         df.blocks(), run_block, submit_block, drain_block,
-        depth=_pipeline.stream_depth(ex))
+        depth=_pipeline.stream_depth(ex), tag=tag)
+
+
+def _stream_tag(op: str, comp, plan: str) -> str:
+    """The checkpoint identity of one op stream: the op, the
+    computation's input/output names, and the output frame's plan
+    string. Two DIFFERENT sibling streams in one query must never
+    share a tag + block count (a resumed checkpoint restores only into
+    its own stream — ``engine/preempt.py``); computations whose
+    in/out names coincide but whose bodies differ are not
+    distinguished here, which is covered by the deterministic forcing
+    order of a thunk re-run plus the discard-on-first-mismatch
+    semantics of the checkpoint."""
+    return (f"{op}[{','.join(comp.input_names)}->"
+            f"{','.join(comp.output_names)}]{plan}")
 
 
 def _drain_with(finish):
@@ -380,11 +399,14 @@ def map_blocks(fetches: Fetches, df: TensorFrame, trim: bool = False,
         return _pipeline.submit(ex, comp, arrays, pad_ok=not trim)
 
     rows_h, bytes_h = _memory.propagate_hints(df, out_schema)
+    plan_s = f"map_blocks({df._plan})"
     out = TensorFrame(out_schema,
                       _stream_thunk(df, ex, run_block, submit_block,
-                                    _drain_with(finish_block)),
+                                    _drain_with(finish_block),
+                                    tag=_stream_tag("map_blocks", comp,
+                                                    plan_s)),
                       df.num_partitions,
-                      plan=f"map_blocks({df._plan})",
+                      plan=plan_s,
                       rows_hint=None if trim else rows_h,
                       bytes_hint=None if trim else bytes_h)
     if executor is None:
@@ -497,11 +519,14 @@ def map_rows(fetches: Fetches, df: TensorFrame,
         return _pipeline.submit(ex, vcomp, arrays)
 
     rows_h, bytes_h = _memory.propagate_hints(df, out_schema)
+    plan_s = f"map_rows({df._plan})"
     out = TensorFrame(out_schema,
                       _stream_thunk(df, ex, run_block, submit_block,
-                                    _drain_with(attach_outputs)),
+                                    _drain_with(attach_outputs),
+                                    tag=_stream_tag("map_rows", comp,
+                                                    plan_s)),
                       df.num_partitions,
-                      plan=f"map_rows({df._plan})",
+                      plan=plan_s,
                       rows_hint=rows_h, bytes_hint=bytes_h)
     if executor is None:
         from ..plan.nodes import MapRowsNode, attach, node_for
@@ -621,11 +646,14 @@ def filter_rows(predicate: Fetches, df: TensorFrame,
 
     # the hint is an UPPER bound: a filter keeps at most its input
     rows_h, bytes_h = _memory.propagate_hints(df, df.schema)
+    plan_s = f"filter_rows({df._plan})"
     out = TensorFrame(df.schema,
                       _stream_thunk(df, ex, run_block, submit_block,
-                                    _drain_with(apply_mask)),
+                                    _drain_with(apply_mask),
+                                    tag=_stream_tag("filter_rows", comp,
+                                                    plan_s)),
                       df.num_partitions,
-                      plan=f"filter_rows({df._plan})",
+                      plan=plan_s,
                       rows_hint=rows_h, bytes_hint=bytes_h)
     if executor is None:
         from ..plan.nodes import FilterNode, attach, node_for
@@ -666,7 +694,8 @@ def reduce_blocks(fetches: Fetches, df: TensorFrame,
             lambda b: _pipeline.submit(ex, comp, block_arrays(b),
                                        pad_ok=False),
             lambda p, b: p.drain(),
-            depth=_pipeline.stream_depth(ex))
+            depth=_pipeline.stream_depth(ex),
+            tag=_stream_tag("reduce_blocks", comp, f"({df._plan})"))
     if not partials:
         raise ValueError("reduce_blocks on an empty frame")
     if len(partials) == 1:

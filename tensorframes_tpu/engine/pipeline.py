@@ -30,6 +30,16 @@ existing retry / OOM-split / pad-fallback machinery
 path exactly: the ops' unchanged per-block function runs in a plain loop,
 bit-identical to the pre-pipeline engine.
 
+Preemption composition (``docs/serving.md``): when the serving layer
+activates a :class:`~.preempt.PreemptionScope` around a forcing, the
+stream polls it between submits — a cancel raises a classified
+``QueryCancelled`` at the boundary; a preempt drains the in-flight
+window, parks the drained prefix as a ``QueryCheckpoint``
+(``memory/checkpoint.py``), and raises ``QueryPreempted`` for the
+scheduler to re-queue. On resume the parked outputs restore and only
+the remaining blocks re-dispatch (``pipeline.resumed_blocks``). With no
+scope active the cost is one contextvar read per stream.
+
 Multi-query composition: when the serving layer installs a
 :class:`SlotPool` (``docs/serving.md``), every pipelined stream leases
 one pool slot per in-flight block, bounding TOTAL cross-query block
@@ -61,6 +71,7 @@ from ..observability import events as _obs
 from ..resilience import check_deadline, env_int
 from ..utils.logging import get_logger
 from ..utils.tracing import counters, gauge, span
+from . import preempt as _preempt
 
 __all__ = ["DEFAULT_DEPTH", "pipeline_depth", "stream_depth", "submit",
            "run_pipelined", "ReadyResult", "PipelinedExecutor",
@@ -181,7 +192,8 @@ def run_pipelined(blocks: Sequence[B],
                   serial_fn: Callable[[B], R],
                   submit_fn: Callable[[B], object],
                   drain_fn: Callable[[object, B], R],
-                  depth: Optional[int] = None) -> List[R]:
+                  depth: Optional[int] = None,
+                  tag: Optional[str] = None) -> List[R]:
     """Run a block stream through a bounded in-flight window, in order.
 
     ``serial_fn(b)`` is the unchanged serial per-block function — used
@@ -190,16 +202,42 @@ def run_pipelined(blocks: Sequence[B],
     ``submit_fn(b)`` starts a block (returns a pending with ``drain()``,
     or any finished value the paired ``drain_fn`` recognizes);
     ``drain_fn(pending, b)`` completes it. Drains are strictly FIFO:
-    results come back in block order.
+    results come back in block order. ``tag`` names the logical stream
+    for preemption checkpoints (``engine/preempt.py``): a checkpoint
+    parked here only ever restores into a stream with the SAME tag and
+    block count — a resume whose execution path changed (fused plan
+    fell back per-op, say) discards and re-runs instead. Untagged
+    streams (``None``) are still preemptible but never checkpoint:
+    with no stable identity, a full re-run is the only safe resume.
     """
     blocks = list(blocks)
     d = pipeline_depth(depth)
     trace = _obs.current_trace()
-    if d <= 1 or len(blocks) <= 1:
-        if trace is None:
+    scope = _preempt.current_scope()
+    start = 0
+    restored: Optional[List[R]] = None
+    if scope is not None:
+        if tag is not None:
+            # disambiguate same-tag sibling streams within one run
+            # attempt: the Nth same-tag stream parked only ever
+            # restores into the Nth same-tag stream of the resume
+            tag = f"{tag}#{scope.stream_ordinal(tag)}"
+        # resume: a parked checkpoint restores the drained prefix and
+        # the stream re-dispatches only the remaining blocks
+        restored = _preempt.resume_stream(scope, len(blocks), tag)
+        if restored:
+            start = len(restored)
+    if d <= 1 or len(blocks) - start <= 1:
+        if trace is None and scope is None:
             return [serial_fn(b) for b in blocks]
-        out0: List[R] = []
-        for i, b in enumerate(blocks):
+        out0: List[R] = list(restored or ())
+        for i in range(start, len(blocks)):
+            b = blocks[i]
+            if scope is not None and _preempt.boundary(scope, i > start):
+                _preempt.park(scope, out0, len(blocks), tag)  # raises
+            if trace is None:
+                out0.append(serial_fn(b))
+                continue
             rows, nbytes = _obs.block_meta(b)
             t0 = trace.clock()
             r = serial_fn(b)
@@ -210,7 +248,7 @@ def run_pipelined(blocks: Sequence[B],
             out0.append(r)
         return out0
 
-    out: List[R] = []
+    out: List[R] = list(restored or ())
     # window entries: (pending, block, index, submit_end_ts, leased)
     window: "deque" = deque()
     pool = _slot_pool  # snapshot: a mid-stream swap must not mismatch
@@ -261,7 +299,14 @@ def run_pipelined(blocks: Sequence[B],
         return True
 
     try:
-        for i, b in enumerate(blocks):
+        for i in range(start, len(blocks)):
+            b = blocks[i]
+            if scope is not None and _preempt.boundary(scope, i > start):
+                # preempt: finish what is in flight (never kill a
+                # dispatched block), park the drained prefix, raise
+                while window:
+                    drain_one()
+                _preempt.park(scope, out, len(blocks), tag)  # raises
             leased = lease_slot()
             # everything between the lease and the window.append is
             # guarded: a failure anywhere here (submit, or even a trace

@@ -32,6 +32,7 @@ import contextlib
 import threading
 from typing import Any, Mapping, Optional
 
+from .checkpoint import QueryCheckpoint
 from .estimate import (blocks_estimate, frame_estimate, propagate_hints,
                        schema_row_bytes)
 from .external_sort import external_sort
@@ -46,7 +47,7 @@ __all__ = [
     "external_sort", "frame_estimate", "propagate_hints",
     "blocks_estimate", "schema_row_bytes", "array_nbytes",
     "host_value", "value_nbytes", "is_device_value", "to_pinned_host",
-    "note_frame_cache", "forget_frame_cache",
+    "note_frame_cache", "forget_frame_cache", "QueryCheckpoint",
 ]
 
 _lock = threading.Lock()

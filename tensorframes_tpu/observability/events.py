@@ -264,6 +264,7 @@ class QueryTrace:
             "compile_seconds": 0.0, "dispatches": 0,
             "mesh_dispatches": 0, "collectives": 0,
             "mesh_shrinks": 0, "rebalances": 0,
+            "mesh_grows": 0, "preempts": 0, "resumed_blocks": 0,
             "spills": 0, "spill_bytes": 0, "faults": 0,
             "proactive_splits": 0, "external_sort_runs": 0,
             "events": 0, "dropped": self.dropped,
@@ -313,6 +314,12 @@ class QueryTrace:
                 s["collectives"] += 1
             elif ev.etype == "mesh_shrink":
                 s["mesh_shrinks"] += 1
+            elif ev.etype == "mesh_grow":
+                s["mesh_grows"] += 1
+            elif ev.etype == "preempt_park":
+                s["preempts"] += 1
+            elif ev.etype == "resume":
+                s["resumed_blocks"] += int(a.get("blocks") or 0)
             elif ev.etype == "rebalance":
                 s["rebalances"] += 1
             elif ev.etype == "spill":

@@ -127,6 +127,15 @@ def render(trace: "_events.QueryTrace") -> str:
                     f"shrunk {a.get('devices_before')} -> "
                     f"{a.get('devices_after')} device(s), "
                     f"{a.get('reshard_rows')} row(s) re-sharded")
+    if s["mesh_grows"]:
+        for ev in list(trace.events):
+            if ev.etype == "mesh_grow":
+                a = ev.args or {}
+                lines.append(
+                    f"  elastic  : device(s) {a.get('devices')} "
+                    f"re-admitted (probe + warm-up) — mesh grown "
+                    f"{a.get('devices_before')} -> "
+                    f"{a.get('devices_after')} device(s)")
     if s["rebalances"]:
         for ev in list(trace.events):
             if ev.etype == "rebalance":
@@ -134,6 +143,11 @@ def render(trace: "_events.QueryTrace") -> str:
                 lines.append(
                     f"  rebalance: skew {a.get('ratio')} — per-shard "
                     f"rows {a.get('before')} -> {a.get('after')}")
+    if s["preempts"] or s["resumed_blocks"]:
+        lines.append(
+            f"  preempt  : parked {s['preempts']} time(s); "
+            f"{s['resumed_blocks']} block(s) restored from checkpoint "
+            f"instead of re-dispatched (docs/serving.md)")
     if s["hbm"] is not None:
         h = s["hbm"]
         lines.append(f"  memory   : peak HBM {_fmt_bytes(h['peak'])} "

@@ -14,6 +14,7 @@ from .distributed import (
     DistributedFrame, daggregate, dfilter, distribute, dmap_blocks,
     dreduce_blocks, dsort)
 from .collectives import COMBINERS
+from .elastic import admit_devices, grow_mesh, probe_device
 from .ring import ring_attention, ring_allreduce
 from .cluster import cluster_mesh, distribute_local, initialize
 
@@ -22,6 +23,7 @@ __all__ = [
     "DistributedFrame", "daggregate", "dfilter", "distribute",
     "dmap_blocks", "dreduce_blocks", "dsort",
     "COMBINERS",
+    "admit_devices", "grow_mesh", "probe_device",
     "ring_attention", "ring_allreduce",
     "cluster_mesh", "distribute_local", "initialize",
 ]

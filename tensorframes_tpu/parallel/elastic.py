@@ -41,6 +41,18 @@ through — the dispatch boundary of ``dmap_blocks`` / ``dfilter`` /
   the frame (rendered by ``DistributedFrame.explain()``) and as a
   ``rebalance`` trace event.
 
+- **Elastic growth** (:func:`admit_devices` — the inverse of shrink):
+  recovered or newly arrived devices rejoin a mesh after passing a
+  probe + warm-up dispatch (:func:`probe_device`, bounded by
+  ``TFT_ADMIT_PROBE_TIMEOUT_S``); resident frames re-shard onto the
+  grown mesh order-preservingly (bit-identical for row-local ops), and
+  an old→grown upgrade registry migrates every OTHER frame still on
+  the old mesh at its next dispatch boundary — which is how stream
+  pumps and the serve scheduler pick up a grown mesh at the next
+  batch/query boundary without restarting. Skew penalties recorded
+  against the returning layout are cleared; a shrink→grow→shrink churn
+  loop converges with zero lost or duplicated rows.
+
 - **Hot-key salting** (:func:`plan_key_salt` / :func:`fold_salted`):
   ``daggregate``'s monoid host-key path splits any key holding more than
   ``TFT_HOT_KEY_FRACTION`` of the rows across ``num_data_shards`` salt
@@ -48,10 +60,13 @@ through — the dispatch boundary of ``dmap_blocks`` / ``dfilter`` /
   largest segment a single scatter lane ever sees.
 
 Counters (always on): ``mesh.devices_lost``, ``mesh.shrinks``,
-``mesh.reshard_rows``, ``mesh.rebalances``, ``mesh.salted_keys`` — also
+``mesh.reshard_rows``, ``mesh.rebalances``, ``mesh.salted_keys``,
+``mesh.grows``, ``mesh.devices_admitted``,
+``mesh.admit_probe_failures``, ``mesh.grow_migrations`` — also
 exported as ``tft_mesh_*`` series on the metrics endpoint. Trace events
 (when a query trace is active): ``mesh_shrink`` (one per lost device,
-carrying its id), ``rebalance``, ``key_salt``.
+carrying its id), ``rebalance``, ``key_salt``, ``mesh_grow``,
+``mesh_grow_pickup``, ``admit_probe_failed``.
 
 Zero-cost-when-healthy: with no fault armed and no skew pending,
 :func:`elastic_call` adds one env read, one fault-site check, and one
@@ -67,6 +82,8 @@ import contextlib
 import re
 import statistics
 import threading
+import time
+import weakref
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -84,7 +101,8 @@ from .mesh import DeviceMesh
 
 __all__ = ["elastic_call", "enabled", "bypass", "lost_device_ids",
            "shrink_mesh", "reshard", "note_dispatch", "salt_fraction",
-           "plan_key_salt", "fold_salted"]
+           "plan_key_salt", "fold_salted",
+           "probe_device", "grow_mesh", "admit_devices"]
 
 _log = get_logger("parallel.elastic")
 
@@ -127,6 +145,7 @@ def elastic_call(op: str, dist, run: Callable):
     """
     if _bypassed:
         return run(dist)
+    dist = _maybe_grow(op, dist)
     dist = _maybe_rebalance(op, dist)
     rebalance = getattr(dist, "_rebalance", None)
     result = None
@@ -195,28 +214,34 @@ def lost_device_ids(exc: BaseException, mesh: DeviceMesh) -> List[int]:
     return [0]
 
 
+def _data_mesh(mesh: DeviceMesh, devices: Sequence,
+               action: str) -> DeviceMesh:
+    """A new mesh with ``mesh``'s axis layout over ``devices`` on the
+    DATA axis, wherever it sits — every other axis must be size 1 (the
+    shared data-only guard of shrink and grow)."""
+    if mesh.num_devices != mesh.num_data_shards:
+        raise ValueError(
+            f"elastic {action} needs a data-only mesh (non-data axes "
+            f"all size 1); {mesh!r} has {mesh.num_devices} devices "
+            f"over {mesh.num_data_shards} data shards")
+    data_pos = mesh.axis_names.index(mesh.data_axis)
+    shape = tuple(len(devices) if i == data_pos else 1
+                  for i in range(len(mesh.axis_names)))
+    arr = np.array(list(devices)).reshape(shape)
+    return DeviceMesh(Mesh(arr, mesh.axis_names), data_axis=mesh.data_axis)
+
+
 def shrink_mesh(mesh: DeviceMesh, lost: Sequence[int]) -> DeviceMesh:
     """A new data mesh over ``mesh``'s devices minus ``lost`` (flat
     indices). Only data-only meshes (every non-data axis of size 1) can
     shrink rectangularly; others raise."""
-    if mesh.num_devices != mesh.num_data_shards:
-        raise ValueError(
-            f"elastic shrink needs a data-only mesh (non-data axes all "
-            f"size 1); {mesh!r} has {mesh.num_devices} devices over "
-            f"{mesh.num_data_shards} data shards")
     gone = set(lost)
     survivors = [d for i, d in enumerate(mesh.mesh.devices.flat)
                  if i not in gone]
     if not survivors:
         raise ValueError(f"all {mesh.num_devices} devices of {mesh!r} "
                          f"reported lost; nothing to shrink to")
-    # the survivors go on the DATA axis, wherever it sits — every other
-    # axis is size 1 (the data-only guard above)
-    data_pos = mesh.axis_names.index(mesh.data_axis)
-    shape = tuple(len(survivors) if i == data_pos else 1
-                  for i in range(len(mesh.axis_names)))
-    arr = np.array(survivors).reshape(shape)
-    return DeviceMesh(Mesh(arr, mesh.axis_names), data_axis=mesh.data_axis)
+    return _data_mesh(mesh, survivors, "shrink")
 
 
 def reshard(dist, mesh: DeviceMesh,
@@ -279,6 +304,15 @@ def _recover(exc: BaseException, dist, op: str):
     mesh = dist.mesh
     lost = lost_device_ids(exc, mesh)
     new_mesh = shrink_mesh(mesh, lost)  # raises for non-data meshes
+    lost_ids = {int(getattr(mesh.mesh.devices.flat[i], "id", i))
+                for i in lost}
+    # a grow upgrade that would re-admit the just-lost device(s) must
+    # die with them, or the next op would migrate straight back onto a
+    # dead chip and loop shrink->grow->shrink against it
+    _forget_upgrades_containing(lost_ids)
+    # …and the ids join the lost pool: admit_devices' default candidate
+    # set, so recovery-driven growth targets genuinely lost chips first
+    _lost_pool.update(lost_ids)
     # 1-axis data mesh: flat device index == data shard index, so the
     # lost shards' valid rows are exactly the data that must round-trip
     per_shard = dist.per_shard_valid()
@@ -301,6 +335,249 @@ def _recover(exc: BaseException, dist, op: str):
         op, type(exc).__name__, lost, mesh.num_data_shards,
         new_mesh.num_data_shards, moved)
     return new_dist
+
+
+# ---------------------------------------------------------------------------
+# elastic mesh GROWTH (the inverse of shrink: re-admit recovered devices)
+# ---------------------------------------------------------------------------
+
+# old DeviceMesh INSTANCE (by id, held weakly) -> the grown DeviceMesh
+# every frame still living on that mesh object should migrate to.
+# Checked at the elastic_call dispatch boundary (_maybe_grow), which is
+# exactly how stream pumps and the serve scheduler pick up a grown mesh
+# at their next batch/query boundary without holding a mesh reference
+# themselves. Keyed by object identity, NOT by device set: a fresh mesh
+# a user later builds over the same devices (deliberately excluding the
+# admitted ones) must never be captured by an old upgrade.
+_upgrade_lock = threading.Lock()
+_upgrades: Dict[int, Tuple["weakref.ref", DeviceMesh]] = {}
+# flat ids of devices dropped by elastic shrinks and not yet
+# re-admitted: the default candidate set of admit_devices (the
+# recovered-chip case), so growth never grabs another live mesh's
+# healthy devices while genuinely lost ones exist
+_lost_pool: set = set()
+
+
+def _forget_upgrades_containing(device_ids: set) -> None:
+    """Drop grow upgrades whose TARGET mesh includes any of these
+    (just-lost) devices."""
+    if not _upgrades:
+        return
+    with _upgrade_lock:
+        for k, (ref, m) in list(_upgrades.items()):
+            if ref() is None or device_ids & set(_mesh_key(m)):
+                _upgrades.pop(k, None)
+
+
+def _start_probe(dev):
+    """Launch one device probe (tiny transfer + warm-up compiled
+    dispatch) on a daemon thread; returns ``(thread, result_dict)``.
+    A probe wedged inside an unkillable ``device_put`` leaks its
+    daemon thread — the price of never wedging admission itself."""
+    result: Dict[str, object] = {}
+
+    def _probe():
+        try:
+            x = jax.device_put(np.arange(4, dtype=np.int32), dev)
+            jax.block_until_ready(x)
+            # warm-up dispatch: compile + execute on the candidate
+            y = jax.jit(lambda a: a + 1)(x)
+            jax.block_until_ready(y)
+            result["ok"] = bool(int(np.asarray(y)[0]) == 1)
+        except Exception as e:  # noqa: BLE001 - probing for health
+            result["err"] = e
+
+    th = threading.Thread(target=_probe, daemon=True,
+                          name="tft-admit-probe")
+    th.start()
+    return th, result
+
+
+def _probe_verdict(dev, th, result, timeout_s: float) -> bool:
+    """Judge a launched probe AFTER its join: alive = hung, error =
+    unhealthy, else the computed check."""
+    if th.is_alive():
+        _log.warning("admit probe of %r timed out after %.1fs; not "
+                     "admitting it", dev, timeout_s)
+        return False
+    err = result.get("err")
+    if err is not None:
+        _log.warning("admit probe of %r failed (%s: %s); not admitting "
+                     "it", dev, type(err).__name__, err)
+        return False
+    return bool(result.get("ok"))
+
+
+def probe_device(dev, timeout_s: Optional[float] = None) -> bool:
+    """The trust gate before re-admission: a tiny transfer AND a
+    warm-up compiled dispatch must complete within
+    ``TFT_ADMIT_PROBE_TIMEOUT_S`` (default 5s). A device that can hold
+    bytes but not compute — a half-recovered chip — must not rejoin;
+    neither may one that hangs (the probe runs on a daemon thread so a
+    wedged transfer cannot wedge admission — a hung probe's thread
+    leaks until the process exits, which is the documented cost)."""
+    if timeout_s is None:
+        timeout_s = env_float("TFT_ADMIT_PROBE_TIMEOUT_S", 5.0)
+    th, result = _start_probe(dev)
+    th.join(timeout=max(float(timeout_s), 0.0))
+    return _probe_verdict(dev, th, result, timeout_s)
+
+
+def grow_mesh(mesh: DeviceMesh, devices: Sequence) -> DeviceMesh:
+    """The inverse of :func:`shrink_mesh`: a new data mesh over
+    ``mesh``'s devices plus ``devices`` (appended on the data axis;
+    already-member devices are ignored). Only data-only meshes grow
+    rectangularly; others raise."""
+    current = list(mesh.mesh.devices.flat)
+    fresh = [d for d in devices if d not in current]
+    if not fresh:
+        return mesh
+    return _data_mesh(mesh, current + fresh, "grow")
+
+
+def admit_devices(target, devices: Optional[Sequence] = None,
+                  probe: bool = True):
+    """Re-admit recovered (or newly arrived) devices into a mesh.
+
+    ``target`` is a :class:`~.distributed.DistributedFrame` (returns the
+    frame re-sharded over the grown mesh — order-preserving, so
+    row-local results stay bit-identical) or a :class:`~.mesh.DeviceMesh`
+    (returns the grown mesh). ``devices`` defaults to the devices this
+    process LOST to elastic shrinks and has not re-admitted (the
+    recovered-chip case); with none recorded, it widens to every
+    visible non-member (with an advisory log — in a multi-mesh process
+    pass ``devices=`` explicitly so another mesh's devices are not
+    absorbed). Each candidate must pass :func:`probe_device` (transfer
+    + warm-up dispatch) before it is trusted; failures are skipped and
+    counted (``mesh.admit_probe_failures``), never fatal.
+
+    Side effects beyond the returned value:
+
+    - the old→grown mapping is registered so every OTHER frame still on
+      the old mesh migrates at its next op (``elastic_call``) — stream
+      pumps and the serve scheduler pick the grown mesh up at their next
+      batch/query boundary with no restart;
+    - persistent-skew penalties recorded against the returning layout
+      are cleared (a device that was a straggler before it died gets a
+      fresh start);
+    - ``mesh.grows`` / ``mesh.devices_admitted`` count it, a
+      ``mesh_grow`` event lands in the active query trace, and
+      ``mesh.active_devices`` updates.
+
+    No candidates (or none passing the probe) returns ``target``
+    unchanged.
+    """
+    dist = None
+    mesh = target
+    if not isinstance(target, DeviceMesh):
+        dist, mesh = target, target.mesh
+    current = list(mesh.mesh.devices.flat)
+    if devices is None:
+        devices = [d for d in jax.devices() if d not in current]
+        # prefer devices this process actually LOST (the recovered-chip
+        # case): when any exist, never grab another live mesh's healthy
+        # devices by default — pass devices= explicitly to widen
+        recovered = [d for d in devices
+                     if int(getattr(d, "id", -1)) in _lost_pool]
+        if recovered:
+            devices = recovered
+        elif devices:
+            _log.info(
+                "admit_devices: no recorded lost devices; defaulting "
+                "to every visible non-member (%d candidate(s)) — in a "
+                "multi-mesh process pass devices= explicitly so "
+                "another mesh's devices are not absorbed",
+                len(devices))
+    else:
+        devices = [d for d in devices if d not in current]
+    if probe and devices:
+        # probes are independent: launch them all, judge them against
+        # ONE shared deadline — N half-recovered candidates cost one
+        # timeout, not N stacked ones
+        timeout_s = env_float("TFT_ADMIT_PROBE_TIMEOUT_S", 5.0)
+        launched = [(d, *_start_probe(d)) for d in devices]
+        give_up = time.monotonic() + max(float(timeout_s), 0.0)
+        admitted = []
+        for d, th, result in launched:
+            th.join(timeout=max(0.0, give_up - time.monotonic()))
+            if _probe_verdict(d, th, result, timeout_s):
+                admitted.append(d)
+            else:
+                counters.inc("mesh.admit_probe_failures")
+                _obs.add_event("admit_probe_failed",
+                               device=int(getattr(d, "id", -1)))
+    else:
+        admitted = list(devices)
+    if not admitted:
+        if devices:
+            _log.warning("admit_devices: none of the %d candidate "
+                         "device(s) passed the probe; mesh unchanged",
+                         len(devices))
+        return target
+    new_mesh = grow_mesh(mesh, admitted)
+    with _tracker_lock:
+        # un-do persistent-skew penalties for the returning layout: a
+        # streak recorded before the device left must not trigger a
+        # rebalance against data it no longer describes
+        _tracker.pop(_mesh_key(mesh), None)
+        _tracker.pop(_mesh_key(new_mesh), None)
+    with _upgrade_lock:
+        # compress chains: anything already upgrading TO this mesh
+        # OBJECT now points at the grown one; prune dead refs while
+        # here
+        for k, (ref, m) in list(_upgrades.items()):
+            if ref() is None:
+                _upgrades.pop(k, None)
+            elif m is mesh:
+                _upgrades[k] = (ref, new_mesh)
+        _upgrades[id(mesh)] = (weakref.ref(mesh), new_mesh)
+    _lost_pool.difference_update(
+        int(getattr(d, "id", -1)) for d in admitted)
+    counters.inc("mesh.grows")
+    counters.inc("mesh.devices_admitted", len(admitted))
+    gauge("mesh.active_devices", new_mesh.num_devices)
+    _obs.add_event("mesh_grow",
+                   devices=[int(getattr(d, "id", -1)) for d in admitted],
+                   devices_before=mesh.num_devices,
+                   devices_after=new_mesh.num_devices)
+    _log.info("mesh grown %d -> %d device(s): admitted %s (probe + "
+              "warm-up passed); frames on the old mesh migrate at "
+              "their next dispatch", mesh.num_devices,
+              new_mesh.num_devices,
+              [int(getattr(d, "id", -1)) for d in admitted])
+    if dist is None:
+        return new_mesh
+    return reshard(dist, new_mesh)
+
+
+def _maybe_grow(op: str, dist):
+    """Migrate a frame whose mesh OBJECT has a registered grow upgrade
+    onto the grown mesh (order-preserving reshard) before the op
+    dispatches. Identity-keyed: only frames sharing the upgraded mesh
+    instance migrate — a user-built fresh mesh over the same devices is
+    never captured. The healthy-path cost is one dict truthiness
+    check."""
+    if not _upgrades:
+        return dist
+    with _upgrade_lock:
+        ent = _upgrades.get(id(dist.mesh))
+        new_mesh = ent[1] if ent is not None \
+            and ent[0]() is dist.mesh else None
+    if new_mesh is None:
+        return dist
+    try:
+        out = reshard(dist, new_mesh)
+    except Exception as e:  # noqa: BLE001 - growth is opportunistic
+        _log.warning(
+            "%s: could not migrate the frame onto the grown mesh (%s: "
+            "%s); running on %r", op, type(e).__name__, e, dist.mesh)
+        return dist
+    counters.inc("mesh.grow_migrations")
+    _obs.add_event("mesh_grow_pickup", name=op,
+                   devices_after=new_mesh.num_devices)
+    _log.info("%s: frame migrated onto the grown %d-device mesh at its "
+              "dispatch boundary", op, new_mesh.num_devices)
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -514,6 +791,17 @@ _MESH_FAMILIES = (
      "Skew-adaptive repartitions applied."),
     ("mesh.salted_keys", "tft_mesh_salted_keys_total",
      "Hot key groups salted across shards by daggregate."),
+    ("mesh.grows", "tft_mesh_grows_total",
+     "Mesh grow events (recovered/new devices re-admitted after probe "
+     "+ warm-up — the inverse of shrink)."),
+    ("mesh.devices_admitted", "tft_mesh_devices_admitted_total",
+     "Devices re-admitted into meshes by elastic growth."),
+    ("mesh.admit_probe_failures", "tft_mesh_admit_probe_failures_total",
+     "Candidate devices that failed the admission probe (transfer + "
+     "warm-up dispatch) and were NOT admitted."),
+    ("mesh.grow_migrations", "tft_mesh_grow_migrations_total",
+     "Frames migrated onto a grown mesh at their next dispatch "
+     "boundary."),
     ("mesh.dispatches", "tft_mesh_dispatches_total",
      "Compiled mesh-op program dispatches (a fused distributed plan "
      "counts ONE for its whole chain — docs/plan.md)."),

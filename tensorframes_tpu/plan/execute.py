@@ -310,6 +310,17 @@ def _run(plan: ExecPlan, leaf_blocks) -> List:
             return pending
         return finish(b, pending.drain())
 
-    return _pipeline.run_pipelined(leaf_blocks, serial_fn, submit_fn,
-                                   drain_fn,
-                                   depth=_pipeline.stream_depth(ex0))
+    return _pipeline.run_pipelined(
+        leaf_blocks, serial_fn, submit_fn, drain_fn,
+        depth=_pipeline.stream_depth(ex0),
+        # stream identity for preemption checkpoints: a resume whose
+        # forcing no longer takes the fused path (e.g. after an OOM
+        # fallback) must discard, not restore these FINAL per-block
+        # results into a per-op stream of the same length — and two
+        # sibling plans in one query must not collide, so the tag
+        # carries the leaf identity (scan path / source plan), the op
+        # kinds, the read columns, and the output schema
+        tag=(f"plan[{plan.leaf.describe()};"
+             f"{','.join(o.kind for o in plan.ops)};"
+             f"{sorted(plan.leaf_required)}]"
+             f"({plan.final_schema.names})"))

@@ -254,10 +254,8 @@ class ParquetScanNode(PlanNode):
 
     def refuted_groups(self, atoms) -> List[int]:
         """Row-group indices (0-based within this scan's range) whose
-        footer stats PROVE every row fails some pushdown atom. Only
-        meaningful for 1:1 group->partition scans
-        (``num_partitions is None``)."""
-        if not atoms or self.num_partitions is not None:
+        footer stats PROVE every row fails some pushdown atom."""
+        if not atoms:
             return []
         from .. import dtypes as _dt
         from .predicates import refutes
@@ -295,11 +293,16 @@ class ParquetScanNode(PlanNode):
         cache when it exists, a pruned read otherwise.
 
         ``atoms`` (pushdown predicates, :mod:`.predicates`) skip whole
-        row groups whose footer statistics refute them: the skipped
-        group's partition becomes a typed 0-row block — bit-identical
+        row groups whose footer statistics refute them — bit-identical
         downstream, because every skipped row was about to fail the
         filter anyway (``plan.pushdown_groups_skipped`` /
-        ``plan.pushdown_bytes_skipped`` count what was never read)."""
+        ``plan.pushdown_bytes_skipped`` count what was never read). On
+        1:1 group->partition scans (``num_partitions is None``) a
+        skipped group's partition becomes a typed 0-row block;
+        explicitly re-partitioned scans remap the surviving groups'
+        rows onto the exact partition spans the unpushed read would
+        have produced (skipped rows simply absent from their spans —
+        the filter was about to drop them)."""
         frame = self.frame_ref() if self.frame_ref is not None else None
         if frame is not None and getattr(frame, "_cache", None):
             return frame._cache
@@ -324,8 +327,8 @@ class ParquetScanNode(PlanNode):
         _log.info("parquet pushdown: skipped %d/%d row group(s) "
                   "(~%d B) of %s", len(skip), self.row_group_limit,
                   skipped_bytes, self.path)
-        # read surviving groups in contiguous runs, splice typed
-        # empties at skipped positions (group->partition is 1:1 here)
+        # read surviving groups in contiguous runs; skipped positions
+        # stay None
         blocks: List = [None] * self.row_group_limit
         run_start = None
         for gi in range(self.row_group_limit + 1):
@@ -341,8 +344,50 @@ class ParquetScanNode(PlanNode):
                 for k, b in enumerate(got):
                     blocks[run_start + k] = b
                 run_start = None
+        if self.num_partitions is not None:
+            return self._remap_partitions(blocks, want, stats)
+        # group->partition is 1:1: splice typed empties at skipped spots
         empty = self._empty_block(want)
         return [b if b is not None else empty for b in blocks]
+
+    def _remap_partitions(self, gblocks: List, names: Sequence[str],
+                          stats) -> List:
+        """Surviving per-group blocks -> the ``num_partitions`` blocks
+        of an explicitly re-partitioned scan. Partition spans are cut
+        over the TOTAL row count (footer group sizes, refuted groups
+        included) with the same ``_split_even`` the unpushed read uses,
+        so partition count and each surviving row's partition match the
+        unpushed path exactly; refuted groups' rows are simply absent
+        from their spans."""
+        from ..frame import Block, _split_even
+        group_rows = [int(st[0]) for st in stats]
+        offsets = np.concatenate([[0], np.cumsum(group_rows)])
+        total = int(offsets[-1])
+        sel_schema = self.schema.select(list(names))
+        spans = _split_even(total, self.num_partitions)
+        out: List = []
+        for a, b in spans:
+            pieces: List = []
+            for gi, blk in enumerate(gblocks):
+                if blk is None:
+                    continue  # refuted: its rows were about to fail
+                ga, gb = int(offsets[gi]), int(offsets[gi + 1])
+                lo, hi = max(a, ga), min(b, gb)
+                if lo >= hi:
+                    continue
+                if lo == ga and hi == gb:
+                    pieces.append(blk)
+                    continue
+                s0, s1 = lo - ga, hi - ga
+                pieces.append(Block(
+                    {k: (v[s0:s1] if isinstance(v, np.ndarray)
+                         else list(v[s0:s1]))
+                     for k, v in blk.columns.items()}, s1 - s0))
+            if pieces:
+                out.append(Block.concat(pieces, sel_schema))
+            else:
+                out.append(self._empty_block(names))
+        return out
 
 
 class MapBlocksNode(PlanNode):

@@ -511,8 +511,11 @@ def _scan_atoms(leaf, ops):
     fetch-name collisions are rejected — so a leaf-named column still
     carries the leaf's values at every later filter). Sound for
     whole-group skipping regardless of earlier filters: a group whose
-    every row fails the predicate contributes nothing downstream."""
-    if leaf.kind != "parquet" or leaf.num_partitions is not None:
+    every row fails the predicate contributes nothing downstream.
+    Explicitly re-partitioned scans (``num_partitions=``) push down
+    too: the scan node remaps surviving group rows onto the partition
+    spans the unpushed read would have produced (``docs/plan.md``)."""
+    if leaf.kind != "parquet":
         return ()
     from .predicates import extract_atoms
     leaf_cols = set(leaf.schema.names)

@@ -35,6 +35,7 @@ documented in ``docs/resilience.md``.
 """
 
 from .classify import (AdmissionDeadline, DeviceLost, OverQuota,
+                       QueryCancelled, QueryInterrupted, QueryPreempted,
                        QueueFull, ServeRejected, error_kind,
                        is_device_lost, is_oom, is_permanent, is_transient)
 from .faults import InjectedFault, inject
@@ -51,6 +52,7 @@ __all__ = [
     "error_kind",
     "ServeRejected", "QueueFull", "OverQuota", "AdmissionDeadline",
     "DeviceLost",
+    "QueryInterrupted", "QueryPreempted", "QueryCancelled",
     "env_bool", "env_float", "env_int",
     "faults", "inject", "InjectedFault",
 ]

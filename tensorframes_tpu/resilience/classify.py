@@ -30,6 +30,7 @@ __all__ = ["is_transient", "is_oom", "is_permanent", "is_device_lost",
            "error_kind",
            "ServeRejected", "QueueFull", "OverQuota", "AdmissionDeadline",
            "DeviceLost",
+           "QueryInterrupted", "QueryPreempted", "QueryCancelled",
            "TRANSIENT_MARKERS", "OOM_MARKERS", "DEVICE_LOST_MARKERS"]
 
 
@@ -43,6 +44,38 @@ class DeviceLost(RuntimeError):
     """
 
     kind = "device_lost"
+
+
+class QueryInterrupted(RuntimeError):
+    """An operator- or scheduler-driven interruption of a running query
+    (``serve/`` preemption and cancellation, ``engine/preempt.py``).
+
+    NOT transient: retrying would re-run work the scheduler just asked
+    to stop. The scheduler — not the retry loop — owns what happens
+    next (re-queue a preempted query's checkpoint for resume; fail a
+    cancelled one's future). Classified by ``kind``.
+    """
+
+    kind = "interrupted"
+    retryable = False
+
+
+class QueryPreempted(QueryInterrupted):
+    """A running query was preempted at a block boundary: its in-flight
+    blocks drained, its completed block outputs parked as a
+    :class:`~..memory.checkpoint.QueryCheckpoint`, and the query
+    re-queued — resume re-dispatches only the remaining blocks,
+    bit-identical to an uninterrupted run (``docs/serving.md``)."""
+
+    kind = "preempted"
+
+
+class QueryCancelled(QueryInterrupted):
+    """A query was cancelled (``QueryScheduler.cancel``): queued queries
+    never run; running ones stop at the next block boundary and their
+    checkpoint is freed. Surfaces on the query's future."""
+
+    kind = "cancelled"
 
 
 class ServeRejected(RuntimeError):
@@ -151,6 +184,11 @@ def is_transient(exc: BaseException) -> bool:
 
     if isinstance(exc, InjectedFault):
         return exc.transient
+    if isinstance(exc, QueryInterrupted):
+        # checked BEFORE the message markers: "CANCELLED" is a transient
+        # PJRT status word, but a scheduler cancellation/preemption must
+        # never spin a retry loop against the scheduler's own decision
+        return False
     if isinstance(exc, ServeRejected):
         return exc.retryable  # queue drains / bucket refills; sheds don't
     if is_device_lost(exc):
@@ -177,6 +215,8 @@ def error_kind(exc: BaseException) -> str:
     ``transient`` / ``permanent``. Exported on retry/giveup trace
     events and in server stats so dashboards never re-derive the
     classification."""
+    if isinstance(exc, QueryInterrupted):
+        return exc.kind  # preempted / cancelled
     if isinstance(exc, ServeRejected):
         return exc.kind
     if is_device_lost(exc):

@@ -32,6 +32,11 @@ dmap         ``parallel.distributed.dmap_blocks`` mesh dispatch
 batch        ``stream.runtime.StreamHandle`` per-batch processing
 device       ``parallel.elastic.elastic_call`` mesh-op dispatch boundary
              (device-loss shaped: the elastic layer shrinks the mesh)
+preempt      ``engine.preempt.boundary`` pipelined block boundary — NOT
+             raised out of the query: the active preemption scope
+             converts the fault into a preempt request, so
+             ``TFT_FAULTS=preempt:N`` deterministically parks a running
+             query at its next N block boundaries (``docs/serving.md``)
 ========== ===========================================================
 
 Counting is deterministic (a lock-guarded integer per site, decremented

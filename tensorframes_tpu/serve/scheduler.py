@@ -44,6 +44,19 @@ watermarks (observability.device), and the bounded pipeline window
   different tenants share one compiled program
   (``serve.compile_cache.hits``).
 
+- **Preemption & cancellation** (``docs/serving.md``):
+  :meth:`QueryScheduler.cancel` stops a queued query immediately and a
+  running one at its next block boundary (classified
+  :class:`~..resilience.QueryCancelled` on the future). When a
+  higher-weight tenant submits while every execution slot is busy, the
+  lowest-weight running query that has run for at least
+  ``TFT_PREEMPT_AFTER_MS`` parks at its next block boundary — its
+  completed block outputs checkpoint off-device through the memory
+  ledger (``memory/checkpoint.py``) and it re-queues at the FRONT of
+  its tenant's queue; resume re-dispatches only the remaining blocks,
+  bit-identical to an uninterrupted run. ``TFT_FAULTS=preempt:N``
+  drives the park/resume path deterministically.
+
 ``workers=0`` builds a *manually driven* scheduler — no threads;
 :meth:`QueryScheduler.step` executes exactly one scheduling decision
 synchronously. Tests and benchmarks use it for deterministic ordering.
@@ -54,7 +67,8 @@ Env knobs (all ``TFT_SERVE_*``; see ``docs/serving.md``):
 ``TFT_SERVE_HBM_FRACTION`` (0.9), ``TFT_SERVE_HBM_LIMIT_BYTES``,
 ``TFT_SERVE_ADMISSION_WAIT_S`` (5), ``TFT_SERVE_ADMISSION_POLL_S``
 (0.02), ``TFT_SERVE_SHARED_CACHE`` (1), ``TFT_SERVE_DEADLINE_S``,
-``TFT_SERVE_COMPILE_CACHE`` (512).
+``TFT_SERVE_COMPILE_CACHE`` (512), ``TFT_SERVE_PREEMPT`` (1),
+``TFT_PREEMPT_AFTER_MS`` (100).
 """
 
 from __future__ import annotations
@@ -68,10 +82,12 @@ from typing import Any, Callable, Dict, List, Mapping, Optional, Tuple
 
 from ..engine import executor as _executor
 from ..engine import pipeline as _pipeline
+from ..engine import preempt as _preempt
 from ..observability import device as _obs_device
 from ..observability import events as _obs
 from ..resilience import (AdmissionDeadline, DeadlineExceeded, OverQuota,
-                          QueueFull, ServeRejected, deadline as _deadline,
+                          QueryCancelled, QueryPreempted, QueueFull,
+                          ServeRejected, deadline as _deadline,
                           env_bool, env_float, env_int, error_kind)
 from ..utils.logging import get_logger
 from ..utils.tracing import counters, gauge, histograms
@@ -84,7 +100,7 @@ __all__ = ["TenantQuota", "SubmittedQuery", "QueryScheduler",
 _log = get_logger("serve.scheduler")
 
 _OUTCOMES = ("submitted", "admitted", "rejected", "over_quota", "shed",
-             "completed", "failed")
+             "completed", "failed", "preempted", "cancelled")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -156,16 +172,20 @@ class SubmittedQuery:
 
     ``result(timeout)`` blocks until the scheduler completes the query,
     returning the forced frame — or raising the classified error
-    (``DeadlineExceeded``, ``AdmissionDeadline``, or whatever the
-    execution raised). ``state`` is one of ``queued`` / ``running`` /
-    ``done`` / ``failed`` / ``shed`` (admission) / ``rejected``
-    (never ran: scheduler shut down).
+    (``DeadlineExceeded``, ``AdmissionDeadline``, ``QueryCancelled``,
+    or whatever the execution raised). ``state`` is one of ``queued`` /
+    ``running`` / ``done`` / ``failed`` / ``shed`` (admission) /
+    ``rejected`` (never ran: scheduler shut down) / ``cancelled``.
+    A preempted query goes back to ``queued`` with its checkpoint
+    (``preemptions`` counts how often) — preemption is not a terminal
+    state; the future resolves when the resumed run finishes.
     """
 
     __slots__ = ("query_id", "tenant", "est_rows", "est_bytes",
                  "est_stream_bytes", "deadline_at", "submitted_at",
-                 "started_at", "finished_at", "state", "_thunk",
-                 "_event", "_result", "_error")
+                 "started_at", "finished_at", "state", "preemptions",
+                 "_thunk", "_event", "_result", "_error", "_scope",
+                 "_checkpoint", "_cancel_requested")
 
     def __init__(self, query_id: str, tenant: str, thunk: Callable[[], Any],
                  est_rows: Optional[float], est_bytes: Optional[int],
@@ -183,10 +203,17 @@ class SubmittedQuery:
         self.started_at: Optional[float] = None
         self.finished_at: Optional[float] = None
         self.state = "queued"
+        self.preemptions = 0
         self._thunk = thunk
         self._event = threading.Event()
         self._result: Any = None
         self._error: Optional[BaseException] = None
+        # preemption plumbing: the live scope while running, the parked
+        # checkpoint between a preempt and its resume, and the
+        # cancel-before-start flag (docs/serving.md)
+        self._scope = None
+        self._checkpoint = None
+        self._cancel_requested = False
 
     def done(self) -> bool:
         return self._event.is_set()
@@ -206,11 +233,19 @@ class SubmittedQuery:
 
     def _complete(self, result: Any = None,
                   error: Optional[BaseException] = None) -> None:
+        if self._event.is_set():
+            return  # exactly one terminal state, even under races
+        cp, self._checkpoint = self._checkpoint, None
+        if cp is not None:
+            cp.free()  # no terminal state keeps parked buffers alive
+        self._scope = None
         self.finished_at = time.monotonic()
         self._result = result
         self._error = error
         if error is None:
             self.state = "done"
+        elif isinstance(error, QueryCancelled):
+            self.state = "cancelled"
         elif isinstance(error, AdmissionDeadline):
             self.state = "shed"
         elif isinstance(error, ServeRejected):
@@ -280,14 +315,21 @@ class QueryScheduler:
                  slots: Optional[int] = None,
                  admission: bool = True,
                  shared_cache: Optional[bool] = None,
+                 preemption: Optional[bool] = None,
                  name: str = "serve"):
         self.name = name
         self._cond = threading.Condition()
         self._tenants: Dict[str, _Tenant] = {}
+        # every live (queued or running) query by id: cancel() and the
+        # priority preemptor need to find them; entries leave on any
+        # terminal state
+        self._queries: Dict[str, SubmittedQuery] = {}
         self._vtime = 0.0
         self._qid = itertools.count(1)
         self._open = True
         self._admission = admission
+        self._preemption = (preemption if preemption is not None
+                            else env_bool("TFT_SERVE_PREEMPT", True))
         self.workers = (workers if workers is not None
                         else env_int("TFT_SERVE_WORKERS", 2))
         if self.workers < 0:
@@ -352,6 +394,7 @@ class QueryScheduler:
             for t in self._tenants.values():
                 while t.queue:
                     q = t.queue.popleft()
+                    self._queries.pop(q.query_id, None)
                     t.counts["rejected"] += 1
                     counters.inc("serve.rejected")
                     orphans.append(q)
@@ -493,6 +536,7 @@ class QueryScheduler:
                 est_stream_bytes=est_stream)
             was_empty = not t.queue
             t.queue.append(q)
+            self._queries[q.query_id] = q
             if was_empty:
                 # re-activation: an idle tenant must not cash in the
                 # passes it never used (stride scheduling)
@@ -500,8 +544,98 @@ class QueryScheduler:
             t.counts["submitted"] += 1
             counters.inc("serve.submitted")
             gauge("serve.queue_depth", self._queued_locked())
+            self._maybe_preempt_locked(t)
             self._cond.notify()
         return q
+
+    # -- preemption & cancellation -----------------------------------------
+    def _maybe_preempt_locked(self, arriving: _Tenant) -> None:
+        """Priority preemption on arrival (``docs/serving.md``): when a
+        higher-weight tenant submits and every execution slot is busy,
+        the lowest-weight running query that has run for at least
+        ``TFT_PREEMPT_AFTER_MS`` is asked to park at its next block
+        boundary. Called with the scheduler lock held."""
+        if not self._preemption or not self._open:
+            return
+        # busy-ness is the INFLIGHT count, not the scoped-running list
+        # below: a worker stuck in the HBM admission wait has no scope
+        # yet but is every bit as busy — and this early return keeps
+        # the uncontended submit path O(tenants), not O(live queries)
+        if self._inflight_locked() < max(1, self.workers):
+            return  # a free worker will pick the arrival up anyway
+        # capture (query, scope) pairs: _complete/_requeue null
+        # q._scope outside this lock, and dereferencing it twice could
+        # hit None mid-way — requesting preempt on a captured scope
+        # whose query just finished is a harmless no-op instead
+        running = [(q, sc) for q in self._queries.values()
+                   for sc in (q._scope,)
+                   if q.state == "running" and sc is not None
+                   and not sc.preempt_requested
+                   and not sc.cancel_requested]
+        after_s = env_float("TFT_PREEMPT_AFTER_MS", 100.0) / 1000.0
+        now = time.monotonic()
+        victims = [(q, sc) for q, sc in running
+                   if self._tenants[q.tenant].weight < arriving.weight
+                   and q.started_at is not None
+                   and now - q.started_at >= max(after_s, 0.0)]
+        if not victims:
+            return
+        victim, vscope = min(victims, key=lambda p: (
+            self._tenants[p[0].tenant].weight, p[0].started_at))
+        vscope.request_preempt(
+            f"preempted by tenant {arriving.name!r} "
+            f"(weight {arriving.weight:g} > "
+            f"{self._tenants[victim.tenant].weight:g})")
+        counters.inc("serve.preempt_requests")
+        # no add_event here: this runs on the SUBMITTER's thread, whose
+        # active trace (if any) is not the victim's — the victim-side
+        # park records the request (with its reason naming the
+        # preemptor) into the right query's trace at the boundary
+        _log.info("query %s (tenant %r, weight %g) asked to preempt for "
+                  "arriving tenant %r (weight %g)", victim.query_id,
+                  victim.tenant, self._tenants[victim.tenant].weight,
+                  arriving.name, arriving.weight)
+
+    def cancel(self, query_id: str) -> bool:
+        """Cancel a query by id. A queued query never runs (its future
+        fails with a classified :class:`~..resilience.QueryCancelled`
+        immediately); a running one stops at its next block boundary
+        and frees any checkpoint. Returns False when the query is
+        unknown or already terminal — a second ``cancel`` of the same
+        query is a no-op, not an error."""
+        with self._cond:
+            q = self._queries.get(query_id)
+            if q is None or q.done():
+                return False
+            t = self._tenants.get(q.tenant)
+            queued = t is not None and q in t.queue
+            if queued:
+                t.queue.remove(q)
+                self._queries.pop(query_id, None)
+                t.counts["cancelled"] += 1
+                gauge("serve.queue_depth", self._queued_locked())
+            else:
+                # between queue-pop and run, or running: the flag stops
+                # it before the thunk / at the next block boundary
+                q._cancel_requested = True
+                sc = q._scope
+                if sc is not None:
+                    sc.request_cancel(f"cancel({query_id})")
+            self._cond.notify_all()
+        counters.inc("serve.cancel_requests")
+        # like the preempt request above, the victim-side boundary
+        # records the `cancel` event into the victim's own trace
+        if queued:
+            counters.inc("serve.cancelled")
+            q._complete(error=QueryCancelled(
+                f"query {query_id} (tenant {q.tenant!r}) cancelled "
+                f"while queued; it never ran"))
+        return True
+
+    def query(self, query_id: str) -> Optional[SubmittedQuery]:
+        """The live (queued or running) query with this id, else None."""
+        with self._cond:
+            return self._queries.get(query_id)
 
     # -- selection ---------------------------------------------------------
     def _queued_locked(self) -> int:
@@ -562,6 +696,12 @@ class QueryScheduler:
         q.state = "running"
         queue_wait = q.started_at - q.submitted_at
         try:
+            if q._cancel_requested:
+                # cancelled in the gap between queue-pop and run: it
+                # must not execute (the caller was told it would not)
+                raise QueryCancelled(
+                    f"query {q.query_id} (tenant {q.tenant!r}) "
+                    f"cancelled before it started")
             # shed what already missed its deadline while queued: running
             # it would spend capacity on a result nobody can use
             if q.deadline_at is not None and \
@@ -577,12 +717,27 @@ class QueryScheduler:
             remaining = None
             if q.deadline_at is not None:
                 remaining = max(q.deadline_at - time.monotonic(), 1e-3)
+            # the preemption token: cancel()/priority arrivals flip it;
+            # the pipelined engine polls it at block boundaries. A
+            # resumed query carries its parked checkpoint back in.
+            # Publication and the cancel-flag seed happen under the
+            # scheduler lock: a cancel() that landed during the
+            # admission wait (flag set, no scope yet) must reach this
+            # scope, and one arriving after sees q._scope non-None —
+            # no window where a cancel can vanish.
+            scope = _preempt.PreemptionScope(q.query_id,
+                                             checkpoint=q._checkpoint)
+            with self._cond:
+                q._scope = scope
+                if q._cancel_requested:
+                    scope.request_cancel(f"cancel({q.query_id})")
             with _obs.query_trace("serve", tenant=q.tenant,
                                   query=q.query_id) as tr:
                 if tr is not None:
                     tr.add("sched_start", name=q.query_id,
-                           tenant=q.tenant, queue_wait_s=queue_wait)
-                with _deadline(remaining):
+                           tenant=q.tenant, queue_wait_s=queue_wait,
+                           resumed=q.preemptions > 0)
+                with _deadline(remaining), _preempt.activate(scope):
                     try:
                         result = q._thunk()
                     except Exception as e:
@@ -600,6 +755,9 @@ class QueryScheduler:
                             "(%s); retrying once on the shrunken mesh",
                             q.query_id, q.tenant, e)
                         result = q._thunk()
+        except QueryPreempted:
+            self._requeue_preempted(q, t)
+            return
         except BaseException as e:
             if isinstance(e, (KeyboardInterrupt, SystemExit)):
                 self._finish(q, t, error=e)
@@ -607,6 +765,43 @@ class QueryScheduler:
             self._finish(q, t, error=e)
             return
         self._finish(q, t, result=result)
+
+    def _requeue_preempted(self, q: SubmittedQuery, t: _Tenant) -> None:
+        """A preempted query parks, it does not fail: carry the
+        checkpoint, put it back at the FRONT of its tenant's queue (it
+        already waited its turn), and let the fair scheduler resume it.
+        Its deadline keeps running while parked."""
+        scope = q._scope
+        if scope is not None and scope.checkpoint is not None:
+            q._checkpoint = scope.checkpoint
+        q._scope = None
+        with self._cond:
+            if not self._open:
+                # lost the race with close(): fail like any orphan
+                self._queries.pop(q.query_id, None)
+                t.inflight -= 1
+                t.counts["rejected"] += 1
+                gauge("serve.inflight", self._inflight_locked())
+                self._cond.notify_all()
+                q._complete(error=ServeRejected(
+                    f"scheduler {self.name!r} shut down while query "
+                    f"{q.query_id} was parked"))
+                counters.inc("serve.rejected")
+                return
+            q.preemptions += 1
+            q.state = "queued"
+            q.started_at = None
+            t.inflight -= 1
+            t.counts["preempted"] += 1
+            t.queue.appendleft(q)
+            gauge("serve.queue_depth", self._queued_locked())
+            gauge("serve.inflight", self._inflight_locked())
+            self._cond.notify_all()
+        counters.inc("serve.preemptions")
+        cp = q._checkpoint
+        _log.info("query %s (tenant %r) parked (%d block(s) "
+                  "checkpointed); re-queued at the front", q.query_id,
+                  q.tenant, cp.parked_blocks if cp is not None else 0)
 
     def _admit(self, q: SubmittedQuery) -> None:
         """HBM admission: wait (bounded) for headroom, else shed.
@@ -637,6 +832,12 @@ class QueryScheduler:
             give_up_at = min(give_up_at, q.deadline_at)
         waited = False
         while True:
+            if q._cancel_requested:
+                # don't spend the admission-wait budget on a query
+                # whose caller was already told it will not run
+                raise QueryCancelled(
+                    f"query {q.query_id} (tenant {q.tenant!r}) "
+                    f"cancelled while waiting for admission")
             headroom = self._hbm_headroom()
             if headroom is None or need <= headroom:
                 if waited:
@@ -689,7 +890,9 @@ class QueryScheduler:
             key = "completed"
         else:
             outcome = error_kind(error)
-            if isinstance(error, AdmissionDeadline):
+            if isinstance(error, QueryCancelled):
+                key = "cancelled"
+            elif isinstance(error, AdmissionDeadline):
                 key = "shed"
             elif isinstance(error, ServeRejected):
                 key = "rejected"
@@ -699,6 +902,7 @@ class QueryScheduler:
                            tenant=t.name, outcome=outcome)
         counters.inc(f"serve.{key}")
         with self._cond:
+            self._queries.pop(q.query_id, None)
             t.inflight -= 1
             t.counts[key] += 1
             gauge("serve.inflight", self._inflight_locked())

@@ -136,6 +136,11 @@ class ServerStats:
                 f"    rejected {s['rejected']} (queue full) · "
                 f"over_quota {s['over_quota']} · shed {s['shed']} "
                 f"(admission)")
+            if s.get("preempted") or s.get("cancelled"):
+                lines.append(
+                    f"    preempted {s.get('preempted', 0)} "
+                    f"(checkpointed + resumed) · "
+                    f"cancelled {s.get('cancelled', 0)}")
         cc = self.compile_cache()
         if cc is not None:
             lines.append(
@@ -182,13 +187,30 @@ def _provider_lines(scheduler) -> List[str]:
 
     lines.append("# HELP tft_serve_queries_total Scheduler outcomes per "
                  "tenant (submitted/admitted/rejected/over_quota/shed/"
-                 "completed/failed).")
+                 "completed/failed/preempted/cancelled).")
     lines.append("# TYPE tft_serve_queries_total counter")
     for name, s in snap.items():
         for key in _OUTCOMES:
             lines.append(
                 f'tft_serve_queries_total{{tenant="{_escape(name)}",'
                 f'outcome="{key}"}} {s[key]}')
+    snap_c = tracing.counters.snapshot()
+    for fam, key, help_s in (
+            ("tft_serve_preemptions_total", "serve.preemptions",
+             "Running queries parked at a block boundary with a "
+             "resumable checkpoint (docs/serving.md)."),
+            ("tft_serve_cancelled_total", "serve.cancelled",
+             "Queries cancelled (queued or at a block boundary)."),
+            ("tft_serve_resumed_blocks_total", "pipeline.resumed_blocks",
+             "Blocks restored from preemption checkpoints instead of "
+             "re-dispatched."),
+            ("tft_serve_checkpoint_discards_total",
+             "serve.checkpoint_discards",
+             "Preemption checkpoints discarded on resume (plan changed "
+             "under the query; re-ran from scratch).")):
+        lines.append(f"# HELP {fam} {help_s}")
+        lines.append(f"# TYPE {fam} counter")
+        lines.append(f"{fam} {snap_c.get(key, 0)}")
     cc = scheduler.compile_cache
     if cc is not None:
         st = cc.stats()

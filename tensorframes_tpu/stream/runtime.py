@@ -16,7 +16,14 @@ deterministic ``batch`` fault site) is **skipped and counted**
 (``stream.batches_skipped``, a ``batch_skip`` trace event with the
 classified kind) and the stream keeps running. A poisoned batch can
 never kill the stream; ``TFT_STREAM_FAIL_FAST=1`` flips skipping off
-for debugging (the classified error raises out of ``step()``).
+for debugging (the classified error raises out of ``step()``). Two
+classes of error are never counted as poisoned data: a ``device_lost``
+is structural (the elastic layer shrank the mesh; the batch retries
+once on the survivors — and when a recovered device is re-admitted,
+``parallel.elastic.admit_devices``, the pump picks up the grown mesh at
+its next batch's dispatch boundary automatically), and a
+``preempted``/``cancelled`` interruption is the operator stopping work
+(it raises out of ``step()`` instead of incrementing the skip counter).
 
 **Backpressure & multi-tenant composition**: bounded sources
 (``QueueSource``) push back on producers; inside a batch, the engine's
@@ -51,8 +58,9 @@ from ..engine import pipeline as _pipeline
 from ..frame import TensorFrame
 from ..observability import events as _obs
 from ..observability import metrics as _metrics
-from ..resilience import (check_deadline, default_policy, env_bool,
-                          env_int, error_kind, faults)
+from ..resilience import (QueryInterrupted, check_deadline,
+                          default_policy, env_bool, env_int, error_kind,
+                          faults)
 from ..utils.logging import get_logger
 from ..utils.tracing import counters, gauge, span
 
@@ -317,7 +325,10 @@ class StreamHandle:
                     # state untouched.
                     outputs = (self._agg.ingest(df)
                                if self._agg is not None else [df])
-        except (KeyboardInterrupt, SystemExit):
+        except (KeyboardInterrupt, SystemExit, QueryInterrupted):
+            # a cancel/preempt is the OPERATOR stopping work, not
+            # poisoned data: counting it as a skipped batch would hide a
+            # deliberate interruption inside the data-quality counter
             raise
         except Exception as e:
             kind = error_kind(e)

@@ -106,6 +106,12 @@ def pytest_configure(config):
                    "through aggregate/daggregate/windowed streams "
                    "(run-tests.sh --join runs this lane too)")
     config.addinivalue_line(
+        "markers", "preempt: preemption/cancellation/elastic-growth "
+                   "suite — checkpointed park/resume bit-identity, "
+                   "scheduler cancel races, priority preemption, device "
+                   "re-admission + shrink/grow churn (run-tests.sh "
+                   "--preempt runs this lane standalone)")
+    config.addinivalue_line(
         "markers", "timing: wall-clock-sensitive deadline assertions — "
                    "margins are widened for loaded machines "
                    "(TFT_TIMING_MARGIN multiplies the bounds; "

@@ -40,9 +40,13 @@ def _clean():
     counters.reset()
     faults.reset()
     elastic._tracker.clear()
+    elastic._upgrades.clear()
+    elastic._lost_pool.clear()
     yield
     faults.reset()
     elastic._tracker.clear()
+    elastic._upgrades.clear()
+    elastic._lost_pool.clear()
     tracing.disable()
 
 
@@ -573,3 +577,211 @@ class TestReshard:
         assert getattr(out, "_rebalance", None) is None
         assert [r["z"] for r in out.collect_frame().collect()] == \
             [i + 1 for i in range(80)]
+
+
+# ---------------------------------------------------------------------------
+# elastic mesh GROWTH: probe + admit + migrate + churn (the PR 13 half;
+# also in the --preempt lane)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.preempt
+class TestElasticGrowth:
+    def test_admit_devices_grows_and_stays_bit_identical(self):
+        mesh6 = par.local_mesh(6)
+        dist = par.distribute(_int_frame(), mesh6)
+        healthy = [r["z"] for r in par.dmap_blocks(
+            lambda x: {"z": x * 2}, dist).collect_frame().collect()]
+        tracing.enable()
+        try:
+            grown = par.admit_devices(dist)
+        finally:
+            tracing.disable()
+        assert grown.mesh.num_devices == 8
+        got = [r["z"] for r in par.dmap_blocks(
+            lambda x: {"z": x * 2}, grown).collect_frame().collect()]
+        assert got == healthy
+        assert counters.get("mesh.grows") == 1
+        assert counters.get("mesh.devices_admitted") == 2
+
+    def test_grow_mesh_is_inverse_of_shrink(self, mesh8):
+        small = elastic.shrink_mesh(mesh8, [3])
+        lost = mesh8.mesh.devices.flat[3]
+        back = elastic.grow_mesh(small, [lost])
+        assert back.num_devices == 8
+        assert lost in list(back.mesh.devices.flat)
+        # idempotent: already-member devices are ignored
+        assert elastic.grow_mesh(back, [lost]) is back
+
+    def test_other_frames_migrate_at_next_dispatch(self):
+        mesh6 = par.local_mesh(6)
+        a = par.distribute(_int_frame(), mesh6)
+        b = par.distribute(_int_frame(), mesh6)  # same mesh, untouched
+        par.admit_devices(a)
+        out = par.dmap_blocks(lambda x: {"z": x + 1}, b)
+        assert out.mesh.num_devices == 8
+        assert counters.get("mesh.grow_migrations") == 1
+        assert [r["z"] for r in out.collect_frame().collect()] == \
+            [i + 1 for i in range(40)]
+
+    def test_fresh_user_mesh_not_captured_by_old_upgrade(self):
+        # the upgrade registry is keyed by mesh OBJECT identity: a
+        # fresh mesh a user later builds over the same devices
+        # (deliberately excluding the admitted ones) must keep its
+        # layout
+        mesh6 = par.local_mesh(6)
+        a = par.distribute(_int_frame(), mesh6)
+        par.admit_devices(a)
+        fresh6 = par.local_mesh(6)
+        b = par.distribute(_int_frame(), fresh6)
+        out = par.dmap_blocks(lambda x: {"z": x + 1}, b)
+        assert out.mesh.num_devices == 6  # not migrated
+        assert counters.get("mesh.grow_migrations") == 0
+
+    def test_default_candidates_prefer_lost_devices(self):
+        # with a genuinely lost device in the pool, the default
+        # candidate set is exactly the recovered chips — another live
+        # mesh's healthy devices (6, 7 here) are not absorbed
+        mesh6 = par.local_mesh(6)
+        dist = par.distribute(_int_frame(), mesh6)
+        with faults.inject(
+                "device", 1,
+                message="DEVICE_LOST: injected: device 2 is lost"):
+            out = par.dmap_blocks(lambda x: {"z": x + 1}, dist)
+        assert out.mesh.num_devices == 5
+        regrown = par.admit_devices(
+            par.distribute(_int_frame(), out.mesh))
+        assert regrown.mesh.num_devices == 6
+        ids = sorted(int(d.id) for d in regrown.mesh.mesh.devices.flat)
+        assert ids == [0, 1, 2, 3, 4, 5]
+        assert counters.get("mesh.devices_admitted") == 1
+
+    def test_admit_on_mesh_returns_grown_mesh(self):
+        mesh6 = par.local_mesh(6)
+        grown = par.admit_devices(mesh6)
+        assert isinstance(grown, par.DeviceMesh)
+        assert grown.num_devices == 8
+
+    def test_no_candidates_is_a_no_op(self, mesh8):
+        dist = par.distribute(_int_frame(), mesh8)
+        assert par.admit_devices(dist) is dist
+        assert counters.get("mesh.grows") == 0
+
+    def test_failed_probe_is_not_admitted(self):
+        mesh6 = par.local_mesh(6)
+
+        class DeadChip:
+            id = 99
+
+            def __repr__(self):
+                return "DeadChip(99)"
+
+        assert elastic.probe_device(DeadChip()) is False
+        grown = par.admit_devices(mesh6, devices=[DeadChip()])
+        assert grown is mesh6  # unchanged: nothing passed the probe
+        assert counters.get("mesh.admit_probe_failures") == 1
+        assert counters.get("mesh.grows") == 0
+
+    @pytest.mark.timing
+    def test_admit_probe_timeout_bounded(self, monkeypatch):
+        from conftest import timing_margin
+        real_put = jax.device_put
+
+        def hung_put(x, device=None, **kw):
+            time.sleep(1.5)
+            return real_put(x)
+
+        monkeypatch.setattr(jax, "device_put", hung_put)
+        t0 = time.monotonic()
+        ok = elastic.probe_device(jax.devices()[0], timeout_s=0.2)
+        elapsed = time.monotonic() - t0
+        assert ok is False
+        assert elapsed <= timing_margin(5.0), \
+            f"probe timeout took {elapsed:.2f}s"
+
+    def test_admit_clears_stale_skew_penalties(self):
+        mesh6 = par.local_mesh(6)
+        mesh8_full = par.local_mesh(8)
+        # penalties recorded against BOTH the shrunken layout and the
+        # full layout the devices are returning to must clear
+        for _ in range(3):
+            elastic.note_dispatch(mesh6, "dmap_blocks",
+                                  [0.001] * 5 + [0.01])
+            elastic.note_dispatch(mesh8_full, "dmap_blocks",
+                                  [0.001] * 7 + [0.01])
+        assert elastic._tracker
+        par.admit_devices(mesh6)
+        assert elastic._mesh_key(mesh6) not in elastic._tracker
+        assert elastic._mesh_key(mesh8_full) not in elastic._tracker
+
+    def test_shrink_forgets_upgrades_onto_lost_devices(self, mesh8):
+        # grow registered mesh6 -> mesh8; a loss of a re-admitted
+        # device must drop that upgrade or the next op would migrate
+        # straight back onto the dead chip
+        mesh6 = par.local_mesh(6)
+        par.admit_devices(mesh6)
+        assert elastic._upgrades
+        dist = par.distribute(_int_frame(), mesh8)
+        with faults.inject(
+                "device", 1,
+                message="DEVICE_LOST: injected: device 6 is lost"):
+            par.dmap_blocks(lambda x: {"z": x + 1}, dist)
+        assert not elastic._upgrades
+
+    def test_grow_event_in_trace_and_report(self):
+        mesh6 = par.local_mesh(6)
+        dist = par.distribute(_int_frame(), mesh6)
+        tracing.enable()
+        try:
+            with obs_events.query_trace("test_grow"):
+                grown = par.admit_devices(dist)
+            t = obs_events.last_query()
+        finally:
+            tracing.disable()
+        assert grown.mesh.num_devices == 8
+        grows = [ev for ev in t.events if ev.etype == "mesh_grow"]
+        assert len(grows) == 1
+        assert grows[0].args["devices_before"] == 6
+        assert grows[0].args["devices_after"] == 8
+        assert t.summary()["mesh_grows"] == 1
+        assert "re-admitted" in t.report()
+
+    def test_churn_shrink_grow_shrink_zero_lost_rows(self, mesh8):
+        # the acceptance loop: the full d-op suite through a
+        # shrink -> grow -> shrink churn, integer results bit-identical
+        # to the healthy mesh, zero lost or duplicated rows
+        df = _int_frame(80)
+        healthy = par.distribute(df, mesh8)
+        h_map = [r["z"] for r in par.dmap_blocks(
+            lambda x: {"z": x * 2}, healthy).collect_frame().collect()]
+        h_filter = [r["x"] for r in par.dfilter(
+            lambda x: x % 3 == 0, healthy).collect_frame().collect()]
+        h_sort = [r["x"] for r in par.dsort(
+            "x", healthy, descending=True).collect_frame().collect()]
+        h_red = int(par.dreduce_blocks({"x": "sum"}, healthy)["x"])
+        h_agg = par.daggregate({"x": "sum"}, healthy, "k").collect()
+
+        dist = par.distribute(df, mesh8)
+        # churn round 1: lose a device mid-op, then re-admit it
+        with faults.inject("device", 1):
+            out = par.dmap_blocks(lambda x: {"z": x * 2}, dist)
+        assert out.mesh.num_devices == 7
+        assert [r["z"] for r in out.collect_frame().collect()] == h_map
+        dist = par.admit_devices(par.distribute(df, out.mesh))
+        assert dist.mesh.num_devices == 8
+        # full d-op suite on the regrown mesh
+        assert [r["x"] for r in par.dfilter(
+            lambda x: x % 3 == 0,
+            dist).collect_frame().collect()] == h_filter
+        assert [r["x"] for r in par.dsort(
+            "x", dist,
+            descending=True).collect_frame().collect()] == h_sort
+        assert int(par.dreduce_blocks({"x": "sum"}, dist)["x"]) == h_red
+        assert par.daggregate({"x": "sum"}, dist, "k").collect() == h_agg
+        # churn round 2: lose another device on the regrown mesh
+        with faults.inject("device", 1):
+            out2 = par.dmap_blocks(lambda x: {"z": x * 2}, dist)
+        assert out2.mesh.num_devices == 7
+        got = [r["z"] for r in out2.collect_frame().collect()]
+        assert got == h_map  # zero lost, zero duplicated
+        assert counters.get("mesh.grows") >= 1
+        assert counters.get("mesh.devices_lost") == 2

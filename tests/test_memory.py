@@ -102,17 +102,19 @@ class TestLedger:
         m.release(hold)
         m.release(tok)
 
+    @pytest.mark.timing
     def test_impossible_request_overflows_without_stalling(self, mem,
                                                            monkeypatch):
         import time
         # nbytes > limit can never fit: reserve must overflow-admit
-        # immediately, not burn the whole admission-wait budget
+        # immediately, not burn the whole admission-wait budget (the
+        # wall-clock bound makes this timing-marked: PR 13 audit)
         monkeypatch.setenv("TFT_MEM_ADMIT_WAIT_S", "5.0")
         m = mem(1000)
         over = _delta("memory.overflow_admissions")
         t0 = time.monotonic()
         tok = m.reserve(2000, op="t")
-        assert time.monotonic() - t0 < 1.0
+        assert time.monotonic() - t0 < timing_margin(1.0)
         assert tok == 2000
         assert over() == 1
         m.release(tok)

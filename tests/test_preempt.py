@@ -1,0 +1,548 @@
+"""Preemptible queries: checkpointed park/resume, cancellation, and the
+serving scheduler's priority preemption
+(``tensorframes_tpu/engine/preempt.py``, ``memory/checkpoint.py``,
+``serve/scheduler.py``).
+
+The acceptance spine: a query preempted at a block boundary (driven
+deterministically by ``TFT_FAULTS=preempt:N``, the same way ``device:1``
+drives elastic recovery) parks its completed block outputs as a
+checkpoint, resumes re-dispatching ONLY the remaining blocks (the
+pipeline counters prove it), and collects a result bit-identical to an
+uninterrupted run. Cancellation settles queued and running queries to
+exactly one terminal state with slot accounting balanced.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import tensorframes_tpu as tft
+from tensorframes_tpu import memory as tmem
+from tensorframes_tpu import resilience as rz
+from tensorframes_tpu.engine import preempt as pp
+from tensorframes_tpu.memory.checkpoint import QueryCheckpoint
+from tensorframes_tpu.observability import events as obs_events
+from tensorframes_tpu.resilience import (QueryCancelled, QueryPreempted,
+                                         faults)
+from tensorframes_tpu.serve.scheduler import QueryScheduler, TenantQuota
+from tensorframes_tpu.utils import tracing
+from tensorframes_tpu.utils.tracing import counters
+
+from conftest import timing_margin
+
+pytestmark = pytest.mark.preempt
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    counters.reset()
+    faults.reset()
+    yield
+    faults.reset()
+    tracing.disable()
+
+
+def _chain(n=40, parts=8, mul=2.0):
+    return tft.frame({"x": np.arange(float(n))},
+                     num_partitions=parts).map_rows(
+        lambda x: {"y": x * mul})
+
+
+def _ys(frame):
+    return [r["y"] for r in frame.collect()]
+
+
+# ---------------------------------------------------------------------------
+# classification + fault site
+# ---------------------------------------------------------------------------
+
+class TestClassification:
+    def test_kinds_and_transience(self):
+        p = QueryPreempted("parked")
+        c = QueryCancelled("stopped")
+        assert rz.error_kind(p) == "preempted"
+        assert rz.error_kind(c) == "cancelled"
+        assert not rz.is_transient(p)
+        assert not rz.is_transient(c)
+        # "CANCELLED" is a transient PJRT status word; the CLASS must
+        # win over the marker scan even if the message contains it
+        assert not rz.is_transient(QueryCancelled("CANCELLED by user"))
+
+    def test_tft_faults_env_arms_preempt_site(self, monkeypatch):
+        monkeypatch.setenv("TFT_FAULTS", "preempt:2")
+        monkeypatch.setattr(faults._state, "_armed_env", False)
+        assert faults.active("preempt") == 2
+
+    def test_interrupted_never_retried_by_policy(self):
+        calls = {"n": 0}
+
+        def work():
+            calls["n"] += 1
+            raise QueryPreempted("park me")
+
+        with pytest.raises(QueryPreempted):
+            rz.default_policy().call(work, op="test")
+        assert calls["n"] == 1  # no retry of a scheduler decision
+
+
+# ---------------------------------------------------------------------------
+# engine: park at a boundary, resume only the remaining blocks
+# ---------------------------------------------------------------------------
+
+class TestEngineParkResume:
+    def test_windowed_park_resume_bit_identical(self):
+        df = _chain(40, 8)
+        sc = pp.PreemptionScope("q")
+        faults.arm("preempt", 1)
+        with pytest.raises(QueryPreempted):
+            with pp.activate(sc):
+                df.blocks()
+        parked = counters.get("pipeline.parked_blocks")
+        assert parked >= 1
+        assert sc.checkpoint is not None and not sc.checkpoint.empty
+        sub0 = counters.get("pipeline.submitted")
+        with pp.activate(sc):
+            out = df.blocks()
+        # resume re-dispatched ONLY the remaining blocks
+        assert counters.get("pipeline.resumed_blocks") == parked
+        assert counters.get("pipeline.submitted") - sub0 == 8 - parked
+        assert _ys(df) == _ys(_chain(40, 8))
+        assert len(out) == 8
+
+    def test_serial_depth1_park_resume(self, monkeypatch):
+        monkeypatch.setenv("TFT_PIPELINE_DEPTH", "1")
+        df = _chain(40, 8, mul=3.0)
+        sc = pp.PreemptionScope("q")
+        faults.arm("preempt", 1)
+        with pytest.raises(QueryPreempted):
+            with pp.activate(sc):
+                df.blocks()
+        parked = counters.get("pipeline.parked_blocks")
+        assert parked >= 1
+        with pp.activate(sc):
+            df.blocks()
+        assert counters.get("pipeline.resumed_blocks") == parked
+        assert _ys(df) == _ys(_chain(40, 8, mul=3.0))
+
+    def test_per_op_path_park_resume(self, monkeypatch):
+        monkeypatch.setenv("TFT_FUSE", "0")
+        df = _chain(40, 8, mul=5.0)
+        sc = pp.PreemptionScope("q")
+        faults.arm("preempt", 1)
+        with pytest.raises(QueryPreempted):
+            with pp.activate(sc):
+                df.blocks()
+        with pp.activate(sc):
+            df.blocks()
+        monkeypatch.delenv("TFT_FUSE")
+        assert _ys(df) == _ys(_chain(40, 8, mul=5.0))
+
+    def test_repeated_preemption_converges(self):
+        # budget > needed: every injected preemption must park at a
+        # strictly later cursor, so N preemptions never livelock
+        df = _chain(40, 8, mul=7.0)
+        sc = pp.PreemptionScope("q")
+        faults.arm("preempt", 3)
+        parks = 0
+        for _ in range(10):
+            try:
+                with pp.activate(sc):
+                    df.blocks()
+                break
+            except QueryPreempted:
+                parks += 1
+        else:
+            pytest.fail("preemption did not converge")
+        assert parks == 3
+        assert _ys(df) == _ys(_chain(40, 8, mul=7.0))
+
+    def test_cancel_raises_at_boundary_and_frees_checkpoint(self):
+        df = _chain(40, 8)
+        sc = pp.PreemptionScope("q")
+        faults.arm("preempt", 1)
+        with pytest.raises(QueryPreempted):
+            with pp.activate(sc):
+                df.blocks()
+        assert not sc.checkpoint.empty
+        sc.request_cancel("user")
+        with pytest.raises(QueryCancelled):
+            with pp.activate(sc):
+                df.blocks()
+        assert sc.checkpoint.empty  # a cancelled query never resumes
+
+    def test_preempt_event_and_summary(self):
+        df = _chain(40, 8)
+        sc = pp.PreemptionScope("q")
+        faults.arm("preempt", 1)
+        tracing.enable()
+        try:
+            with pytest.raises(QueryPreempted):
+                with pp.activate(sc):
+                    df.blocks()
+            t = obs_events.last_query()
+            assert t.count("preempt_park") == 1
+            assert t.summary()["preempts"] == 1
+            with pp.activate(sc):
+                df.blocks()
+            t2 = obs_events.last_query()
+            assert t2.summary()["resumed_blocks"] >= 1
+        finally:
+            tracing.disable()
+
+
+# ---------------------------------------------------------------------------
+# the checkpoint itself
+# ---------------------------------------------------------------------------
+
+class TestQueryCheckpoint:
+    def test_block_and_dict_round_trip(self):
+        from tensorframes_tpu.frame import Block
+        b = Block({"x": np.arange(5.0),
+                   "s": np.array(["a", "b", "c", "d", "e"], object)}, 5)
+        d = {"y": np.arange(3, dtype=np.int64)}
+        cp = QueryCheckpoint("q")
+        cp.park_stream([b, d], total=4)
+        out = cp.resume_stream(4)
+        assert isinstance(out[0], Block)
+        np.testing.assert_array_equal(out[0].columns["x"], b.columns["x"])
+        assert list(out[0].columns["s"]) == ["a", "b", "c", "d", "e"]
+        np.testing.assert_array_equal(out[1]["y"], d["y"])
+        assert cp.empty
+
+    def test_device_arrays_spill_and_fault_back_bitwise(self):
+        import jax
+        tmem.configure(limit_bytes=1 << 30)
+        try:
+            a = jax.device_put(np.arange(1000, dtype=np.float32))
+            cp = QueryCheckpoint("q")
+            moved = cp.park_stream([a], total=1)
+            assert moved == 4000
+            assert counters.get("memory.spills") == 1
+            out = cp.resume_stream(1)
+            np.testing.assert_array_equal(
+                np.asarray(out[0]), np.arange(1000, dtype=np.float32))
+            assert counters.get("memory.faults") == 1
+        finally:
+            tmem._reset()
+
+    def test_mismatched_stream_discards(self):
+        cp = QueryCheckpoint("q")
+        cp.park_stream([{"x": np.arange(2)}], total=4)
+        assert cp.resume_stream(6) is None  # plan changed: discard
+        assert counters.get("serve.checkpoint_discards") == 1
+        assert cp.empty
+
+    def test_mismatched_tag_discards(self):
+        # same block count but a DIFFERENT execution path (a fused
+        # plan that fell back per-op between park and resume) must
+        # discard, never restore the wrong stream's outputs
+        cp = QueryCheckpoint("q")
+        cp.park_stream([{"x": np.arange(2)}], total=4, tag="plan[2ops]")
+        assert cp.resume_stream(4, tag="map_rows(source)") is None
+        assert counters.get("serve.checkpoint_discards") == 1
+        assert cp.empty
+
+    def test_free_drops_parked_state(self):
+        cp = QueryCheckpoint("q")
+        cp.park_stream([{"x": np.arange(2)}], total=4)
+        cp.free()
+        assert cp.empty and cp.resume_stream(4) is None
+
+
+# ---------------------------------------------------------------------------
+# scheduler: preempt/resume, cancel, races
+# ---------------------------------------------------------------------------
+
+class TestSchedulerPreemption:
+    def test_fault_driven_preempt_requeues_and_resumes(self):
+        with QueryScheduler(workers=0, name="tp") as s:
+            df = _chain(40, 8)
+            q = s.submit(df, tenant="whale")
+            faults.arm("preempt", 1)
+            assert s.step() is True
+            assert q.state == "queued" and q.preemptions == 1
+            assert q._checkpoint is not None
+            assert not q.done()  # preemption is not a terminal state
+            sub0 = counters.get("pipeline.submitted")
+            parked = counters.get("pipeline.parked_blocks")
+            assert s.step() is True
+            res = q.result(timeout=10)
+            assert q.state == "done"
+            assert counters.get("serve.preemptions") == 1
+            assert counters.get("pipeline.resumed_blocks") == parked
+            assert counters.get("pipeline.submitted") - sub0 == 8 - parked
+            assert _ys(res) == _ys(_chain(40, 8))
+            assert s.snapshot()["whale"]["preempted"] == 1
+            assert s.snapshot()["whale"]["completed"] == 1
+
+    def test_cancel_queued_never_runs(self):
+        with QueryScheduler(workers=0, name="tc") as s:
+            q = s.submit(_chain(), tenant="t")
+            assert s.cancel(q.query_id) is True
+            with pytest.raises(QueryCancelled):
+                q.result(timeout=2)
+            assert q.state == "cancelled"
+            assert s.step() is False  # nothing left to run
+            assert s.cancel(q.query_id) is False  # double-cancel: no-op
+            snap = s.snapshot()["t"]
+            assert snap["cancelled"] == 1 and snap["completed"] == 0
+            assert snap["queued"] == 0 and snap["inflight"] == 0
+
+    def test_cancel_running_settles_once(self):
+        with QueryScheduler(workers=0, name="tr") as s:
+            df = tft.frame({"x": np.arange(2000.0)},
+                           num_partitions=32).map_rows(
+                lambda x: {"y": x * 2}).map_rows(lambda y: {"z": y + 1})
+            q = s.submit(df, tenant="t")
+            th = threading.Thread(target=s.step)
+            th.start()
+            for _ in range(2000):
+                if q.state != "queued":
+                    break
+                time.sleep(0.005)
+            assert s.cancel(q.query_id) is True
+            th.join(timeout=30)
+            assert not th.is_alive()
+            with pytest.raises(QueryCancelled):
+                q.result(timeout=10)
+            # exactly one terminal state, accounting balanced
+            assert q.state == "cancelled"
+            assert q._checkpoint is None
+            snap = s.snapshot()["t"]
+            assert snap["inflight"] == 0 and snap["queued"] == 0
+            assert s.query(q.query_id) is None
+            # every pipeline slot is back in the pool
+            for _ in range(s.slot_pool.slots):
+                assert s.slot_pool.try_acquire()
+            for _ in range(s.slot_pool.slots):
+                s.slot_pool.release()
+
+    def test_double_cancel_running_is_idempotent(self):
+        with QueryScheduler(workers=0, name="td") as s:
+            df = _chain(400, 16)
+            q = s.submit(df, tenant="t")
+            th = threading.Thread(target=s.step)
+            th.start()
+            for _ in range(2000):
+                if q.state != "queued":
+                    break
+                time.sleep(0.005)
+            first = s.cancel(q.query_id)
+            second = s.cancel(q.query_id)
+            th.join(timeout=30)
+            assert first is True
+            # the second call either raced the terminal transition
+            # (False) or re-flagged a still-running query (True) — but
+            # the query settles exactly once either way
+            assert second in (True, False)
+            assert q.state == "cancelled"
+            assert s.snapshot()["t"]["cancelled"] == 1
+
+    def test_preempt_racing_natural_completion(self):
+        # a preempt request that lands with only already-dispatched
+        # work left parks an almost-complete prefix; the resumed run
+        # restores it and finishes — never two terminal states, never
+        # a lost result. Driven 3x with requests at random points.
+        rng = np.random.default_rng(7)
+        for trial in range(3):
+            with QueryScheduler(workers=0, name=f"race{trial}") as s:
+                df = _chain(200, 16, mul=float(trial + 2))
+                q = s.submit(df, tenant="t")
+                done = threading.Event()
+
+                def drive():
+                    while s.step():
+                        pass
+                    done.set()
+
+                th = threading.Thread(target=drive)
+                th.start()
+                # fire a preempt request at a random moment mid-run
+                time.sleep(float(rng.uniform(0.0, 0.05)))
+                live = s.query(q.query_id)
+                if live is not None and live._scope is not None:
+                    live._scope.request_preempt("race test")
+                # the drive loop exits when the queue empties; a parked
+                # query re-queues, so keep stepping until terminal
+                th.join(timeout=30)
+                while not q.done() and s.step():
+                    pass
+                res = q.result(timeout=10)
+                assert q.state == "done"
+                assert _ys(res) == _ys(_chain(200, 16,
+                                              mul=float(trial + 2)))
+                snap = s.snapshot()["t"]
+                assert snap["inflight"] == 0 and snap["queued"] == 0
+
+    def test_priority_arrival_preempts_lowest_weight_whale(
+            self, monkeypatch):
+        monkeypatch.setenv("TFT_PREEMPT_AFTER_MS", "0")
+        with QueryScheduler(
+                quotas={"whale": TenantQuota(weight=1.0),
+                        "vip": TenantQuota(weight=8.0)},
+                workers=0, name="pp") as s:
+            whale_df = tft.frame({"x": np.arange(20_000.0)},
+                                 num_partitions=24).map_rows(
+                lambda x: {"y": x * 2}).map_rows(lambda y: {"z": y + 1})
+            wq = s.submit(whale_df, tenant="whale")
+            stepped = threading.Event()
+
+            def run_whale():
+                s.step()
+                stepped.set()
+
+            th = threading.Thread(target=run_whale)
+            th.start()
+            for _ in range(2000):
+                if wq.state == "running":
+                    break
+                time.sleep(0.002)
+            assert wq.state == "running", "whale never started"
+            vq = s.submit(tft.frame({"x": np.arange(8.0)}).map_rows(
+                lambda x: {"y": x + 1}), tenant="vip")
+            assert stepped.wait(30), "whale neither parked nor finished"
+            th.join(timeout=5)
+            assert wq.preemptions >= 1, \
+                "arrival of a higher-weight tenant did not preempt"
+            assert counters.get("serve.preempt_requests") >= 1
+            # the fair pick serves the vip FIRST, then resumes the whale
+            assert s.step() is True
+            assert vq.result(timeout=10) is not None
+            while not wq.done():
+                assert s.step() is True
+            assert _ys(wq.result(timeout=10)) == _ys(whale_df)
+            snap = s.snapshot()
+            assert snap["whale"]["preempted"] >= 1
+            assert snap["whale"]["completed"] == 1
+            assert snap["vip"]["completed"] == 1
+
+    @pytest.mark.timing
+    def test_cancel_aborts_admission_wait(self, monkeypatch):
+        # review regression: a cancel landing while the query waits for
+        # HBM admission (no scope exists yet) must not be lost — the
+        # wait aborts and the query settles cancelled, not "done"
+        monkeypatch.setenv("TFT_SERVE_ADMISSION_WAIT_S", "30")
+        with QueryScheduler(workers=0, name="aw") as s:
+            monkeypatch.setattr(s, "_hbm_headroom", lambda: 0)
+            q = s.submit(_chain(), tenant="t", est_bytes=10_000)
+            th = threading.Thread(target=s.step)
+            th.start()
+            for _ in range(2000):
+                if q.state == "running":
+                    break
+                time.sleep(0.002)
+            assert s.cancel(q.query_id) is True
+            th.join(timeout=timing_margin(10.0))
+            assert not th.is_alive(), "admission wait ignored the cancel"
+            with pytest.raises(QueryCancelled):
+                q.result(timeout=5)
+            assert q.state == "cancelled"
+
+    def test_anonymous_stream_preempts_without_checkpoint(self):
+        # an ad-hoc PipelinedExecutor.map stream has no stable identity
+        # to resume into: it must yield WITHOUT parking (two anonymous
+        # streams of equal length must never restore each other)
+        from tensorframes_tpu.engine.executor import default_executor
+        from tensorframes_tpu.engine.pipeline import PipelinedExecutor
+        from tensorframes_tpu.engine.ops import _map_computation
+        from tensorframes_tpu.schema import Schema
+        df = tft.frame({"x": np.arange(24.0)}, num_partitions=6)
+        schema = Schema.of(x="double")
+        comp = _map_computation(lambda x: {"y": x * 2}, schema,
+                                block_level=True)
+        arrays = [{"x": b.columns["x"]} for b in df.blocks()]
+        pex = PipelinedExecutor(default_executor(), depth=3)
+        sc = pp.PreemptionScope("anon")
+        faults.arm("preempt", 1)
+        with pytest.raises(QueryPreempted):
+            with pp.activate(sc):
+                pex.map(arrays, comp)
+        assert sc.checkpoint is None or sc.checkpoint.empty
+        with pp.activate(sc):
+            out = pex.map(arrays, comp)  # full re-run, nothing restored
+        assert counters.get("pipeline.resumed_blocks") == 0
+        np.testing.assert_array_equal(
+            np.concatenate([o["y"] for o in out]),
+            np.arange(24.0) * 2)
+
+    def test_preemption_disabled_by_env(self, monkeypatch):
+        monkeypatch.setenv("TFT_SERVE_PREEMPT", "0")
+        with QueryScheduler(workers=0, name="off") as s:
+            assert s._preemption is False
+
+    def test_metrics_families_exported(self):
+        from tensorframes_tpu.observability.metrics import metrics_text
+        with QueryScheduler(workers=0, name="tm") as s:
+            q = s.submit(_chain(), tenant="t")
+            faults.arm("preempt", 1)
+            s.step()
+            s.step()
+            q.result(timeout=10)
+            text = metrics_text()
+        assert "tft_serve_preemptions_total 1" in text
+        assert "tft_serve_resumed_blocks_total" in text
+        assert 'outcome="preempted"}' in text
+
+    @pytest.mark.timing
+    def test_cancel_latency_bounded(self):
+        # the preempt-latency bound: a cancel lands at the next block
+        # boundary, not at the end of the whale
+        with QueryScheduler(workers=0, name="tl") as s:
+            df = tft.frame({"x": np.arange(50_000.0)},
+                           num_partitions=64).map_rows(
+                lambda x: {"y": x * 2}).map_rows(lambda y: {"z": y * 3})
+            q = s.submit(df, tenant="t")
+            th = threading.Thread(target=s.step)
+            th.start()
+            for _ in range(4000):
+                if q.state != "queued":
+                    break
+                time.sleep(0.002)
+            t0 = time.monotonic()
+            s.cancel(q.query_id)
+            assert q._event.wait(timing_margin(15.0)), \
+                "cancel did not settle within its margin"
+            assert time.monotonic() - t0 <= timing_margin(15.0)
+            th.join(timeout=10)
+            assert q.state == "cancelled"
+
+    def test_close_fails_parked_query_and_frees_checkpoint(self):
+        s = QueryScheduler(workers=0, name="tz")
+        try:
+            q = s.submit(_chain(40, 8), tenant="t")
+            faults.arm("preempt", 1)
+            s.step()
+            assert q.preemptions == 1 and not q.done()
+            cp = q._checkpoint
+            assert cp is not None and not cp.empty
+        finally:
+            s.close()
+        with pytest.raises(rz.ServeRejected):
+            q.result(timeout=2)
+        assert q.state == "rejected"
+        assert q._checkpoint is None and cp.empty  # freed on terminal
+
+
+# ---------------------------------------------------------------------------
+# streams: interruption is control flow, not poisoned data
+# ---------------------------------------------------------------------------
+
+class TestStreamInterruption:
+    def test_cancel_propagates_not_skip_counted(self):
+        from tensorframes_tpu import stream as tstream
+        src = tstream.GeneratorSource(
+            ({"x": np.arange(4.0) + i} for i in range(100)))
+        handle = tstream.StreamingFrame(src).map_rows(
+            lambda x: {"y": x * 2}).start()
+        assert handle.step(timeout=1.0) is True  # healthy batch first
+        sc = pp.PreemptionScope("op")
+        sc.request_cancel("operator stop")
+        with pytest.raises(QueryCancelled):
+            with pp.activate(sc):
+                handle.step(timeout=1.0)
+        m = handle.metrics()
+        assert m["batches_skipped"] == 0  # not counted as poisoned
+        assert m["batches"] == 1
+        handle.stop()

@@ -796,14 +796,50 @@ class TestParquetPushdown:
         assert out.count() == 0
         assert _snap("plan.pushdown_groups_skipped") == g0
 
-    def test_explicit_partitions_disable_pushdown(self, tmp_path):
+    def test_explicit_partitions_push_down_and_remap(self, tmp_path):
+        # the recorded PR 12 follow-on, closed in PR 13: an explicitly
+        # re-partitioned scan refutes per row group and remaps the
+        # surviving rows onto the partition spans the unpushed read
+        # would have produced — bit-identical incl. block boundaries
         path = _write_grouped_parquet(tmp_path)
-        df = tft.io.read_parquet(path, num_partitions=3)
-        g0 = _snap("plan.pushdown_groups_skipped")
-        out = df.filter(lambda x: x > 160.0).map_blocks(
+        for parts in (3, 5, 7):
+            df = tft.io.read_parquet(path, num_partitions=parts)
+            g0 = _snap("plan.pushdown_groups_skipped")
+            out = df.filter(lambda x: x > 160.0).map_blocks(
+                lambda x: {"s": x * 2})
+            rows = _rows(out)
+            assert out.count() == 95  # x in 161..255
+            assert _snap("plan.pushdown_groups_skipped") - g0 == 2
+            os.environ["TFT_FUSE"] = "0"
+            try:
+                out2 = tft.io.read_parquet(
+                    path, num_partitions=parts).filter(
+                    lambda x: x > 160.0).map_blocks(
+                    lambda x: {"s": x * 2})
+                assert _rows(out2) == rows
+                assert [b.num_rows for b in out.blocks()] == \
+                    [b.num_rows for b in out2.blocks()]
+            finally:
+                del os.environ["TFT_FUSE"]
+
+    def test_more_partitions_than_rows_remap(self, tmp_path):
+        # degenerate split: _split_even caps partitions at the TOTAL
+        # row count (refuted groups included), matching the unpushed
+        # partition structure exactly
+        path = _write_grouped_parquet(tmp_path, groups=2, rows=4)
+        df = tft.io.read_parquet(path, num_partitions=6)
+        out = df.filter(lambda x: x >= 4.0).map_blocks(
             lambda x: {"s": x * 2})
-        assert out.count() == 95  # x in 161..255
-        assert _snap("plan.pushdown_groups_skipped") == g0
+        rows = _rows(out)
+        os.environ["TFT_FUSE"] = "0"
+        try:
+            out2 = tft.io.read_parquet(path, num_partitions=6).filter(
+                lambda x: x >= 4.0).map_blocks(lambda x: {"s": x * 2})
+            assert _rows(out2) == rows
+            assert [b.num_rows for b in out.blocks()] == \
+                [b.num_rows for b in out2.blocks()]
+        finally:
+            del os.environ["TFT_FUSE"]
 
 
 # ---------------------------------------------------------------------------

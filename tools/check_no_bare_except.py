@@ -27,7 +27,10 @@
    relational layer's join/sketch degradations (chunked builds, host
    segment-fold fallbacks, unpushable predicates) must likewise leave a
    trace — a join that silently dropped to a slower path is a perf bug
-   nobody can find. Handle it or log it (``_log.debug`` is enough).
+   nobody can find — and the engine layer now carries the preemption
+   token path (``engine/preempt.py``): a silently swallowed error
+   between a park and its resume is a lost checkpoint, i.e. silently
+   re-run work. Handle it or log it (``_log.debug`` is enough).
 
 AST-based, so strings and comments never false-positive.
 """
@@ -40,7 +43,7 @@ ROOT = pathlib.Path(__file__).resolve().parent.parent / "tensorframes_tpu"
 # packages where `except Exception: pass` (silent swallow) is also banned
 STRICT_ROOTS = (ROOT / "observability", ROOT / "serve", ROOT / "stream",
                 ROOT / "parallel", ROOT / "memory", ROOT / "plan",
-                ROOT / "relational")
+                ROOT / "relational", ROOT / "engine")
 
 
 def _is_exception_name(node) -> bool:
