@@ -115,6 +115,17 @@ if [ "${1:-}" = "--preempt" ]; then
   exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m preempt "$@"
 fi
 
+# --adaptive: run only the adaptive-execution lane
+# (tests/test_adaptive.py: feedback-driven block re-bucketing vs the
+# static layout, filter re-ordering/re-plans, result-cache hits +
+# invalidation, adaptive stream batches, preempt-aware admission) —
+# fast, CPU-only, no native build needed
+if [ "${1:-}" = "--adaptive" ]; then
+  shift
+  echo "== adaptive lane (pytest -m adaptive, CPU) =="
+  exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m adaptive "$@"
+fi
+
 # --timing: run only the wall-clock-sensitive deadline tests, serially
 # (they flake under concurrent suite load; TFT_TIMING_MARGIN widens
 # their assertion bounds further on badly oversubscribed boxes)

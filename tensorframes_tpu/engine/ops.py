@@ -355,7 +355,11 @@ def map_blocks(fetches: Fetches, df: TensorFrame, trim: bool = False,
     """Transform a frame block-by-block, appending (or, with ``trim``,
     replacing with) the computation's outputs. Lazy."""
     ex = executor or default_executor()
-    comp = _map_computation(fetches, df.schema, block_level=True)
+    # the canonical computation is cached per fetches object (weakly):
+    # repeated chains over the same fetches share one comp — and with
+    # it every downstream jit/program cache AND the plan-fingerprint
+    # result cache's op identity (docs/adaptive.md)
+    comp = cached_map_computation(fetches, df.schema, block_level=True)
     out_schema = _validate_map(comp, df.schema, block_level=True, trim=trim)
     in_names = comp.input_names
     fetch_names = comp.output_names
@@ -435,7 +439,9 @@ def map_rows(fetches: Fetches, df: TensorFrame,
     # executor is safe: streams of odd-sized blocks (and ragged group
     # sizes) share O(log) compile signatures instead of one per size
     ex = executor or default_padding_executor()
-    comp = _map_computation(fetches, df.schema, block_level=False)
+    # cached per fetches object, like map_blocks/filter_rows: the
+    # canonical comp is what the result-cache fingerprint interns
+    comp = cached_map_computation(fetches, df.schema, block_level=False)
     out_schema = _validate_map(comp, df.schema, block_level=False, trim=False)
     in_names = comp.input_names
     fetch_names = comp.output_names

@@ -75,7 +75,8 @@ from . import preempt as _preempt
 
 __all__ = ["DEFAULT_DEPTH", "pipeline_depth", "stream_depth", "submit",
            "run_pipelined", "ReadyResult", "PipelinedExecutor",
-           "SlotPool", "install_slot_pool", "current_slot_pool"]
+           "SlotPool", "install_slot_pool", "current_slot_pool",
+           "last_occupancy"]
 
 _log = get_logger("engine.pipeline")
 
@@ -122,6 +123,18 @@ class SlotPool:
 
 
 _slot_pool: Optional[SlotPool] = None
+
+# mean in-flight window occupancy of the most recently COMPLETED
+# stream in this process (best-effort: concurrent streams overwrite
+# each other; None before any stream and after a serial/depth-1 run).
+# The adaptive planner's stream-feedback records read it right after
+# their own forcing's stream completes (docs/adaptive.md), where the
+# most-recent stream IS that forcing's on the uncontended path.
+_last_occupancy: Optional[float] = None
+
+
+def last_occupancy() -> Optional[float]:
+    return _last_occupancy
 
 
 def install_slot_pool(pool: Optional[SlotPool]) -> Optional[SlotPool]:
@@ -210,6 +223,7 @@ def run_pipelined(blocks: Sequence[B],
     streams (``None``) are still preemptible but never checkpoint:
     with no stable identity, a full re-run is the only safe resume.
     """
+    global _last_occupancy
     blocks = list(blocks)
     d = pipeline_depth(depth)
     trace = _obs.current_trace()
@@ -228,6 +242,7 @@ def run_pipelined(blocks: Sequence[B],
         if restored:
             start = len(restored)
     if d <= 1 or len(blocks) - start <= 1:
+        _last_occupancy = None  # a serial run has no window to measure
         if trace is None and scope is None:
             return [serial_fn(b) for b in blocks]
         out0: List[R] = list(restored or ())
@@ -252,6 +267,8 @@ def run_pipelined(blocks: Sequence[B],
     # window entries: (pending, block, index, submit_end_ts, leased)
     window: "deque" = deque()
     pool = _slot_pool  # snapshot: a mid-stream swap must not mismatch
+    occ_sum = 0
+    occ_n = 0
 
     def drain_one() -> None:
         pending, b, i, t_sub, leased = window.popleft()
@@ -326,6 +343,8 @@ def run_pipelined(blocks: Sequence[B],
                 raise
             window.append((pending, b, i, t1, leased))
             counters.inc("pipeline.submitted")
+            occ_sum += len(window)
+            occ_n += 1
             gauge("pipeline.occupancy", len(window))
             if trace is not None:
                 trace.add("block_submit", name=f"submit b{i}", ts=t0,
@@ -343,6 +362,8 @@ def run_pipelined(blocks: Sequence[B],
             entry = window.popleft()
             if entry[4]:
                 pool.release()
+        if occ_n:
+            _last_occupancy = occ_sum / occ_n
     return out
 
 

@@ -231,6 +231,10 @@ class TensorFrame:
         # plan's rendering for explain() after a fused forcing.
         self._plan_node = None
         self._plan_info = None
+        # bumped by uncache(): the plan-fingerprint result cache
+        # (docs/adaptive.md) keys on it, so an explicit re-force can
+        # never be served a stale interned result
+        self._version = 0
 
     # -- construction ------------------------------------------------------
     @staticmethod
@@ -289,6 +293,24 @@ class TensorFrame:
     # -- evaluation --------------------------------------------------------
     def blocks(self) -> List[Block]:
         if self._cache is None:
+            if self._plan_node is not None:
+                # plan-fingerprint result cache (docs/adaptive.md): a
+                # repeated hot query — same sources at the same
+                # versions, same canonical computations — costs zero
+                # dispatches. Misses, one-off chains, and
+                # TFT_RESULT_CACHE=0 fall through to the forcing below.
+                from .plan import adaptive as _adaptive
+                hit = _adaptive.cached_result(self)
+                if hit is not None:
+                    self._cache = hit
+                    self._plan_info = [
+                        "  result   : served from the plan-fingerprint "
+                        f"result cache — {len(hit)} block(s), zero "
+                        "dispatches (TFT_RESULT_CACHE=1, "
+                        "docs/adaptive.md)"]
+                    from . import memory as _memory
+                    _memory.note_frame_cache(self)
+                    return self._cache
             # forcing IS the query: open a correlated trace (no-op with
             # tracing off; a forcing nested inside another query joins
             # the ambient trace and yields None here)
@@ -308,6 +330,12 @@ class TensorFrame:
                     else self._thunk()
             if t is not None:
                 self._trace = t
+            if self._plan_node is not None:
+                # two-touch admission: interned only when this exact
+                # fingerprint repeats (hot dashboards), never for
+                # one-off chains or per-batch streaming frames
+                from .plan import adaptive as _adaptive
+                _adaptive.offer_result(self, self._cache)
             # under an active device budget the forced block cache joins
             # the host-side accounting (tft_memory_frame_cache_bytes);
             # one global read otherwise
@@ -320,6 +348,10 @@ class TensorFrame:
         the plan) and release it from the memory manager's host-side
         accounting. The inverse of :meth:`cache`."""
         self._cache = None
+        # re-version: interned results keyed on (or validated against)
+        # this frame can no longer hit — uncache() is an explicit
+        # request to re-run the plan (docs/adaptive.md)
+        self._version += 1
         from . import memory as _memory
         _memory.forget_frame_cache(self)
         return self

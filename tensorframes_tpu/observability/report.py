@@ -109,6 +109,33 @@ def render(trace: "_events.QueryTrace") -> str:
                 f"rows, see the per-device table above; persistent "
                 f"skew triggers re-partitioning, docs/resilience.md)")
     for ev in list(trace.events):
+        if ev.etype == "adaptive_layout":
+            a = ev.args or {}
+            lines.append(
+                f"  adaptive : {a.get('blocks')} leaf block(s) "
+                f"re-bucketed into {a.get('units')} (coalesced "
+                f"{a.get('coalesced', 0)}, split {a.get('splits', 0)}) "
+                f"— original boundaries restored (docs/adaptive.md)")
+        elif ev.etype == "replan":
+            a = ev.args or {}
+            lines.append(
+                f"  adaptive : mid-plan re-plan at block "
+                f"{a.get('at_block')} — observed selectivity deviated "
+                f"past TFT_REPLAN_RATIO; remaining filter stages "
+                f"re-ordered (docs/adaptive.md)")
+        elif ev.etype == "result_cache_hit":
+            a = ev.args or {}
+            lines.append(
+                f"  adaptive : result cache HIT — {a.get('blocks')} "
+                f"block(s) / {a.get('bytes')} B served with zero "
+                f"dispatches (docs/adaptive.md)")
+        elif ev.etype == "sched_admission_preempt":
+            a = ev.args or {}
+            lines.append(
+                f"  admission: preempted query {a.get('victim')} "
+                f"({a.get('victim_bytes')} B) to clear headroom "
+                f"instead of shedding (docs/serving.md)")
+    for ev in list(trace.events):
         if ev.etype == "fused_stage":
             a = ev.args or {}
             res = (f", {a.get('resident')} column(s) pass through "

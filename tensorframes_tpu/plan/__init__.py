@@ -28,9 +28,15 @@ per-op path's semantics:
 - plan nodes carry per-column row/byte estimates
   (:meth:`~.nodes.PlanNode.estimate`) that replace the whole-schema-ratio
   heuristics for UNFORCED frames (``memory.estimate.frame_estimate`` —
-  what serve admission, quotas, and proactive splits consume).
+  what serve admission, quotas, and proactive splits consume);
+- execution feeds measurement BACK into the plan (:mod:`.adaptive`):
+  feedback-gated block re-bucketing, observed-selectivity filter
+  re-ordering and mid-plan re-plans, and a plan-fingerprint result
+  cache that serves repeated hot queries with zero dispatches —
+  ``TFT_ADAPTIVE=0`` / ``TFT_RESULT_CACHE=0`` restore the static
+  engine bit-identically.
 
-See ``docs/plan.md``.
+See ``docs/plan.md`` and ``docs/adaptive.md``.
 """
 
 from __future__ import annotations
@@ -40,12 +46,13 @@ from .nodes import (FilterNode, MapBlocksNode, MapRowsNode, ParquetScanNode,
                     observed_selectivity, record_selectivity)
 from .optimize import enabled
 from .execute import maybe_run
+from . import adaptive
 
 __all__ = [
     "PlanNode", "SourceNode", "ParquetScanNode", "MapBlocksNode",
     "MapRowsNode", "FilterNode", "SelectNode", "attach", "node_for",
     "enabled", "maybe_run", "record_selectivity", "observed_selectivity",
-    "dist",
+    "adaptive", "dist",
 ]
 
 

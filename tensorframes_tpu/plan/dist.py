@@ -888,6 +888,16 @@ def _exec_frame(plan: _DPlan, d):
         # the same buffers would double-count resident bytes and make a
         # spill of either wrapper free nothing.
         cols = _register_result(cols, f"dfused@{id(plan):x}")
+    # adaptive feedback (docs/adaptive.md): fused mesh stages record
+    # their observed shard-stream shape like host plans do — unused for
+    # sizing today (mesh shards are fixed by the mesh, not the layout
+    # pass), but the record is what a future distributed block-sizing
+    # pass will gate on, and it keeps the feedback registry one surface
+    from .adaptive import record_stream_feedback
+    record_stream_feedback(
+        f"dplan[{','.join(o.kind for o in plan.ops)}]"
+        f"({plan.final_schema.names})",
+        blocks=S, rows=num_rows, wall_s=0.0)
     return D.DistributedFrame(d.mesh, plan.final_schema, cols, num_rows,
                               shard_valid=shard_valid)
 

@@ -30,6 +30,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from ..engine import preempt as _preempt
 from ..shape import Unknown
 from ..utils.logging import get_logger
 from ..utils.tracing import counters, span
@@ -77,7 +78,7 @@ def maybe_run(frame) -> Optional[List]:
                     return None
     try:
         with span("plan.execute"):
-            blocks = _run(plan, leaf_blocks)
+            blocks = _run(plan, leaf_blocks, frame)
     except Exception as e:
         from ..resilience import is_oom
         if is_oom(e):
@@ -177,11 +178,15 @@ def _final_block(plan: ExecPlan, env: Dict[str, object], n_rows: int):
 # stage execution
 # ---------------------------------------------------------------------------
 
-def _apply_stage_result(plan, st, env, out, n_rows):
+def _apply_stage_result(plan, st, env, out, n_rows, aux=None):
     """Merge a stage's outputs into a fresh env; apply the mask. Returns
-    ``(env, n_rows, short_circuit_block)`` — the block is non-None when
-    the mask dropped every row and the rest of the chain replays the
-    empty-block semantics."""
+    ``(env, n_rows, short_circuit_block, aux)`` — the block is non-None
+    when the mask dropped every row and the rest of the chain replays
+    the empty-block semantics. ``aux`` is the adaptive layout's
+    original-block-id row vector (``docs/adaptive.md``), masked
+    alongside the env so the final outputs can be re-split on the
+    original block boundaries; ``None`` (the static layout) is passed
+    through untouched."""
     new_env = {n: env[n] for n in st.passthrough}
     new_env.update({n: out[n] for n in st.outputs})
     if st.mask:
@@ -200,7 +205,9 @@ def _apply_stage_result(plan, st, env, out, n_rows):
             empty = {k: _mask_value(v, mask, np.empty(0, np.int64))
                      for k, v in new_env.items()}
             bb = _env_to_block(empty, st.boundary_schema, 0)
-            return None, 0, _empty_chain(plan.ops[st.op_end + 1:], bb)
+            if aux is not None:
+                aux = aux[:0]
+            return None, 0, _empty_chain(plan.ops[st.op_end + 1:], bb), aux
         # compare against the MASK length, not the stage-input row
         # count: a trim member inside the stage may have changed the
         # row count before the predicate ran
@@ -208,8 +215,10 @@ def _apply_stage_result(plan, st, env, out, n_rows):
             idx = np.flatnonzero(mask)
             new_env = {k: _mask_value(v, mask, idx)
                        for k, v in new_env.items()}
+            if aux is not None:
+                aux = aux[mask]
         n_rows = keep
-    return new_env, n_rows, None
+    return new_env, n_rows, None, aux
 
 
 def _stage_executor(st, first: bool = True):
@@ -229,18 +238,19 @@ def _stage_executor(st, first: bool = True):
 
 
 def _run_rest(plan: ExecPlan, env: Dict[str, object], n_rows: int,
-              start: int):
-    """Stages ``start..`` over an env, device-resident between stages."""
+              start: int, aux=None):
+    """Stages ``start..`` over an env, device-resident between stages.
+    Returns ``(final block, aux)``."""
     for si in range(start, len(plan.stages)):
         st = plan.stages[si]
         ex, pad_ok = _stage_executor(st, first=si == 0)
         out = ex.run(st.comp, {n: env[n] for n in st.inputs},
                      pad_ok=pad_ok, keep_device=True)
-        env, n_rows, short = _apply_stage_result(plan, st, env, out,
-                                                 n_rows)
+        env, n_rows, short, aux = _apply_stage_result(plan, st, env, out,
+                                                      n_rows, aux)
         if short is not None:
-            return short
-    return _final_block(plan, env, n_rows)
+            return short, aux
+    return _final_block(plan, env, n_rows), aux
 
 
 def _full_leaf_empty(plan: ExecPlan, b):
@@ -257,9 +267,21 @@ def _full_leaf_empty(plan: ExecPlan, b):
     return Block(cols, 0)
 
 
-def _run(plan: ExecPlan, leaf_blocks) -> List:
+def _plan_tag(plan: ExecPlan) -> str:
+    """Stable stream identity of a plan shape: preemption checkpoints
+    key on it, and the adaptive feedback registry uses it to correlate
+    repeated forcings of the same chain (``docs/adaptive.md``)."""
+    return (f"plan[{plan.leaf.describe()};"
+            f"{','.join(o.kind for o in plan.ops)};"
+            f"{sorted(plan.leaf_required)}]"
+            f"({plan.final_schema.names})")
+
+
+def _run(plan: ExecPlan, leaf_blocks, frame=None) -> List:
+    import time as _time
+
     from ..engine import pipeline as _pipeline
-    from ..frame import Block
+    from . import adaptive as _adaptive
     if not plan.stages:
         # pure projection over a pruned scan: no device work at all
         out = []
@@ -271,6 +293,36 @@ def _run(plan: ExecPlan, leaf_blocks) -> List:
                 env = {n: b.columns[n] for n in plan.leaf_required}
                 out.append(_final_block(plan, env, b.num_rows))
         return out
+    tag = _plan_tag(plan)
+    layout = None
+    if _adaptive.enabled() and plan.row_local_chain \
+            and _preempt.current_scope() is None and leaf_blocks:
+        # re-bucket the stream to TFT_PIPELINE_DEPTH full slots within
+        # ledger headroom; outputs are re-split on the original block
+        # boundaries, so the run stays bit-identical. Skipped under an
+        # active preemption scope (checkpoint tags pin the block count).
+        layout = _adaptive.choose_layout(
+            plan, leaf_blocks, _pipeline.pipeline_depth(None), tag)
+    t0 = _time.perf_counter()
+    if layout is not None:
+        out = _run_adaptive(plan, layout, frame)
+    else:
+        out = _run_static(plan, leaf_blocks, tag)
+    _adaptive.record_stream_feedback(
+        tag, blocks=len(leaf_blocks),
+        rows=sum(b.num_rows for b in leaf_blocks),
+        wall_s=_time.perf_counter() - t0,
+        occupancy=_pipeline.last_occupancy())
+    return out
+
+
+# ---------------------------------------------------------------------------
+# static layout (the pre-adaptive path, verbatim)
+# ---------------------------------------------------------------------------
+
+def _run_static(plan: ExecPlan, leaf_blocks, tag: str) -> List:
+    from ..engine import pipeline as _pipeline
+    from ..frame import Block
     # the FIRST stage pipelines through the executor's async
     # submit/drain halves like any per-op stream (multi-stage plans
     # drain device-resident outputs — keep_device — and complete the
@@ -282,12 +334,12 @@ def _run(plan: ExecPlan, leaf_blocks) -> List:
 
     def finish(b, out) -> Block:
         env = {n: b.columns[n] for n in st0.passthrough}
-        env, n_rows, short = _apply_stage_result(plan, st0, env, out,
-                                                 b.num_rows)
+        env, n_rows, short, _ = _apply_stage_result(plan, st0, env, out,
+                                                    b.num_rows)
         if short is not None:
             return short
         if multi:
-            return _run_rest(plan, env, n_rows, 1)
+            return _run_rest(plan, env, n_rows, 1)[0]
         return _final_block(plan, env, n_rows)
 
     def serial_fn(b):
@@ -320,7 +372,153 @@ def _run(plan: ExecPlan, leaf_blocks) -> List:
         # sibling plans in one query must not collide, so the tag
         # carries the leaf identity (scan path / source plan), the op
         # kinds, the read columns, and the output schema
-        tag=(f"plan[{plan.leaf.describe()};"
-             f"{','.join(o.kind for o in plan.ops)};"
-             f"{sorted(plan.leaf_required)}]"
-             f"({plan.final_schema.names})"))
+        tag=tag)
+
+
+# ---------------------------------------------------------------------------
+# adaptive layout (docs/adaptive.md): re-bucketed stream + restore
+# ---------------------------------------------------------------------------
+
+def _unit_fns(plan: ExecPlan):
+    """serial/submit/drain halves over layout units ``(block,
+    orig_ids, orig_list)``; results are ``(final block, surviving
+    orig_ids)`` pairs. Units are never empty (0-row originals are
+    excluded from the layout and replayed verbatim at restore)."""
+    st0 = plan.stages[0]
+    ex0, pad0 = _stage_executor(st0, first=True)
+    multi = len(plan.stages) > 1
+
+    def finish(b, ids, out):
+        env = {n: b.columns[n] for n in st0.passthrough}
+        env, n_rows, short, ids = _apply_stage_result(plan, st0, env,
+                                                      out, b.num_rows,
+                                                      ids)
+        if short is not None:
+            return short, ids
+        if multi:
+            return _run_rest(plan, env, n_rows, 1, ids)
+        return _final_block(plan, env, n_rows), ids
+
+    def serial_fn(unit):
+        b, ids, _ = unit
+        out = ex0.run(st0.comp, {n: b.columns[n] for n in st0.inputs},
+                      pad_ok=pad0, keep_device=multi)
+        return finish(b, ids, out)
+
+    def submit_fn(unit):
+        b, _, _ = unit
+        return ex0.submit(st0.comp,
+                          {n: b.columns[n] for n in st0.inputs},
+                          pad_ok=pad0, keep_device=multi)
+
+    def drain_fn(pending, unit):
+        if isinstance(pending, tuple):
+            return pending
+        return finish(unit[0], unit[1], pending.drain())
+
+    return serial_fn, submit_fn, drain_fn, ex0
+
+
+def _should_replan(plan: ExecPlan) -> bool:
+    """True when a filter's observed selectivity deviates from what
+    this plan priced it at by more than ``TFT_REPLAN_RATIO``."""
+    from . import adaptive as _adaptive
+    from .nodes import observed_selectivity
+    ratio = _adaptive.replan_ratio()
+    for i, sel0 in plan.priced_sel.items():
+        cur = observed_selectivity(plan.ops[i].comp)
+        if cur is None:
+            continue
+        a = max(sel0 if sel0 is not None else 1.0, 1e-6)
+        b = max(cur, 1e-6)
+        if max(a, b) / min(a, b) > ratio:
+            return True
+    return False
+
+
+def _run_adaptive(plan: ExecPlan, layout, frame) -> List:
+    from ..engine import pipeline as _pipeline
+    from ..observability.events import add_event
+    from ..utils.tracing import counters as _counters
+    serial_fn, submit_fn, drain_fn, ex0 = _unit_fns(plan)
+    units = layout.units
+    add_event("adaptive_layout", name=plan.leaf.describe(),
+              blocks=layout.n_orig, units=len(units),
+              coalesced=layout.coalesced_from, splits=layout.splits)
+    # probe the first unit serially: its observed selectivities are the
+    # re-plan trigger for the remaining stages (ROADMAP 2d) — a
+    # mid-plan boundary, not a new forcing
+    outs = [serial_fn(units[0])]
+    rest_plan = plan
+    if len(units) > 1 and frame is not None and _should_replan(plan):
+        try:
+            from .optimize import build_plan
+            new_plan = build_plan(frame)
+        except Exception as e:  # noqa: BLE001 - replan is best-effort
+            _log.debug("mid-plan replan failed (%s); keeping the "
+                       "current plan", e)
+            new_plan = None
+        # adopt the re-planned stages only when they are shape-safe
+        # (same read set, still row-local) AND actually different
+        if new_plan is not None and new_plan.row_local_chain \
+                and new_plan.leaf_required == plan.leaf_required \
+                and [id(o.comp) for o in new_plan.ops
+                     if o.kind != "select"] \
+                != [id(o.comp) for o in plan.ops if o.kind != "select"]:
+            rest_plan = new_plan
+            _counters.inc("plan.replans")
+            add_event("replan", name=plan.leaf.describe(),
+                      at_block=int(len(units[0][2])))
+            _log.info("mid-plan replan: observed selectivity deviated "
+                      "past TFT_REPLAN_RATIO; re-ordered the remaining "
+                      "filter stages")
+            serial_fn, submit_fn, drain_fn, ex0 = _unit_fns(rest_plan)
+    outs.extend(_pipeline.run_pipelined(
+        units[1:], serial_fn, submit_fn, drain_fn,
+        depth=_pipeline.stream_depth(ex0), tag=None))
+    return _restore_layout(rest_plan, layout, outs)
+
+
+def _slice_final(block, lo: int, hi: int):
+    from ..frame import Block
+    from .adaptive import _slice_cols
+    return Block(_slice_cols(block, list(block.columns), lo, hi),
+                 hi - lo)
+
+
+def _restore_layout(plan: ExecPlan, layout, outs) -> List:
+    """Re-split the adaptive units' outputs on the ORIGINAL block
+    boundaries (the ids vector survived every mask), splice the empty
+    originals' verbatim empty-chain replays back in — the result is
+    bit-identical to the static layout, boundaries included."""
+    per: List[List] = [[] for _ in range(layout.n_orig)]
+    for (blk, ids), (_, _, orig_list) in zip(outs, layout.units):
+        present = set()
+        if ids is not None and len(ids):
+            cuts = np.flatnonzero(np.diff(ids)) + 1
+            bounds = np.concatenate(([0], cuts, [len(ids)]))
+            for lo, hi in zip(bounds[:-1], bounds[1:]):
+                oid = int(ids[int(lo)])
+                present.add(oid)
+                per[oid].append(_slice_final(blk, int(lo), int(hi)))
+        for oid in orig_list:
+            if oid not in present:
+                # every row of this original was filtered out: a 0-row
+                # slice of the unit's (final-schema) output carries the
+                # exact dtypes/cell dims the static path produces
+                per[oid].append(_slice_final(blk, 0, 0))
+    from ..frame import Block
+    out: List = []
+    empties = dict(layout.empty_blocks)
+    for i in range(layout.n_orig):
+        if i in empties:
+            out.append(_empty_chain(plan.ops,
+                                    _full_leaf_empty(plan, empties[i])))
+        elif len(per[i]) == 1:
+            out.append(per[i][0])
+        else:
+            # split originals: stitch the sub-units' outputs back with
+            # the ONE canonical concat (frame.Block.concat), so shape
+            # unification and ragged handling can never drift from it
+            out.append(Block.concat(per[i], plan.final_schema))
+    return out

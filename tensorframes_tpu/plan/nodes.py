@@ -515,32 +515,70 @@ class JoinNode(PlanNode):
             return frame._cache
         return self._materialize(list(names))
 
+    def _rows_estimate(self, rows_l: Optional[float]) -> Optional[float]:
+        """Sketch-based output cardinality (docs/adaptive.md, the PR 12
+        follow-on): a broadcast BuildTable prices the per-probe-row
+        expansion from its unique-key count (``build_rows /
+        num_groups`` — exactly 1 for unique keys, so 1:1 left joins
+        stay exact); a sort-merge join over forced sides prices
+        ``|L|·|R| / max(V(L), V(R))`` with HLL ``approx_key_distinct``
+        probes. Anything unprobeable keeps the probe-side row count
+        (the prior upper-bound-ish heuristic)."""
+        if not rows_l:
+            return rows_l
+        build = self.build
+        if build is not None and build.num_groups:
+            avg_span = build.build_rows / build.num_groups
+            rows = rows_l * avg_span
+            return max(rows, rows_l) if self.how == "left" else rows
+        if self.right is not None:
+            rows_r, _ = self.right.estimate()
+            if rows_r:
+                from ..relational.join import approx_key_distinct
+                lf = (self.left.result_ref()
+                      if self.left.result_ref is not None else None) \
+                    or getattr(self.left, "frame", None)
+                rf = (self.right.result_ref()
+                      if self.right.result_ref is not None else None) \
+                    or getattr(self.right, "frame", None)
+                d_l = approx_key_distinct(lf, self.on) \
+                    if lf is not None else None
+                d_r = approx_key_distinct(rf, self.on) \
+                    if rf is not None else None
+                d = max([v for v in (d_l, d_r) if v] or [0.0])
+                if d >= 1.0:
+                    rows = rows_l * rows_r / d
+                    return max(rows, rows_l) if self.how == "left" \
+                        else rows
+        return rows_l
+
     def _estimate(self) -> Estimate:
         rows_l, cols_l = self.left.estimate()
+        rows = self._rows_estimate(rows_l)
         out: Dict[str, int] = {}
         if cols_l is not None:
-            out.update({n: b for n, b in cols_l.items()
+            # left columns replicate with the expansion factor
+            scale_l = (rows / rows_l) if rows_l and rows else 1.0
+            out.update({n: int(b * scale_l) for n, b in cols_l.items()
                         if n in self.schema})
         build = self.build
-        if build is not None and build.build_rows and rows_l:
-            scale = rows_l / build.build_rows
+        if build is not None and build.build_rows and rows:
+            scale = rows / build.build_rows
             for f in build.value_fields:
                 if f.name not in self.schema:
                     continue
                 if f.name in build.tensor_names:
                     nb = int(build._sorted_host[f.name].nbytes * scale)
                 else:
-                    nb = int(8 * rows_l)
+                    nb = int(8 * rows)
                 out[f.name] = nb
         elif self.right is not None:
             rows_r, cols_r = self.right.estimate()
-            if cols_r is not None and rows_r and rows_l:
+            if cols_r is not None and rows_r and rows:
                 for n, b in cols_r.items():
                     if n in self.schema and n not in out:
-                        out[n] = int(b * rows_l / rows_r)
-        # rows: the probe side's count — exact for 1:1 left joins, an
-        # estimate under duplicate build keys (documented heuristic)
-        return rows_l, (out or None)
+                        out[n] = int(b * rows / rows_r)
+        return rows, (out or None)
 
 
 def node_for(frame) -> PlanNode:

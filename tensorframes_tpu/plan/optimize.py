@@ -283,10 +283,12 @@ class ExecPlan:
     """The optimizer's output: leaf + stages + pruning decisions."""
 
     __slots__ = ("leaf", "ops", "stages", "final_schema", "leaf_required",
-                 "scan_names", "device_ops", "pruned", "scan_atoms")
+                 "scan_names", "device_ops", "pruned", "scan_atoms",
+                 "row_local_chain", "priced_sel", "reordered")
 
     def __init__(self, leaf, ops, stages, final_schema, leaf_required,
-                 scan_names, device_ops, pruned, scan_atoms=()):
+                 scan_names, device_ops, pruned, scan_atoms=(),
+                 row_local_chain=False, priced_sel=None, reordered=False):
         self.leaf = leaf
         self.ops = ops
         self.stages = stages
@@ -296,6 +298,15 @@ class ExecPlan:
         self.device_ops = device_ops
         self.pruned = pruned                # leaf columns NOT read
         self.scan_atoms = scan_atoms        # parquet pushdown predicates
+        # every device op provably row-local (vmapped map_rows, selects,
+        # atom-proven filter predicates): the adaptive block-sizing pass
+        # may legally re-bucket the leaf stream (docs/adaptive.md)
+        self.row_local_chain = row_local_chain
+        # selectivity each filter op was PRICED at when this plan was
+        # built (None = the keeps-everything upper bound); the executor
+        # compares observations against these to trigger a re-plan
+        self.priced_sel = priced_sel or {}
+        self.reordered = reordered  # filter run re-ordered by feedback
 
     def describe(self) -> List[str]:
         """``explain()``'s plan section: fused groups, pruned columns,
@@ -318,6 +329,11 @@ class ExecPlan:
             lines.append(
                 f"    pushdown: [{preds}] checked against row-group "
                 f"footer statistics (refuted groups never read)")
+        if self.reordered:
+            lines.append(
+                "    adaptive: conjunctive filters re-ordered by "
+                "observed selectivity (TFT_ADAPTIVE=1, "
+                "docs/adaptive.md)")
         for i, st in enumerate(self.stages):
             edge = ("host rows" if i == 0 else "device-resident")
             mask_s = " · mask applied host-side" if st.mask else ""
@@ -325,6 +341,53 @@ class ExecPlan:
                 f"    stage {i}: {st.label} -> 1 dispatch/block "
                 f"(in: {edge}){mask_s}")
         return lines
+
+
+def _atom_filter(comp) -> bool:
+    """True when the predicate's sole output is PROVEN a conjunction of
+    column-vs-literal comparisons (:mod:`.predicates`) — i.e. the
+    predicate is row-local: its mask row depends only on that row."""
+    from .predicates import extract_atoms
+    return bool(extract_atoms(comp))
+
+
+def _reorder_filters(ops):
+    """Adaptive re-planning (docs/adaptive.md): runs of ADJACENT
+    filters whose predicates are all atom-proven (row-local, so they
+    commute — same final row set, same order, same block boundaries)
+    re-order most-selective-first by observed selectivity, so later
+    filter dispatches see fewer rows. Unobserved predicates price at
+    the keeps-everything upper bound and keep their position
+    (stable sort). Returns ``(ops, reordered)``."""
+    from .adaptive import enabled as _adaptive_on
+    if not _adaptive_on():
+        return ops, False
+    out = list(ops)
+    changed = False
+    i = 0
+    while i < len(out):
+        if out[i].kind != "filter" or not _atom_filter(out[i].comp):
+            i += 1
+            continue
+        j = i
+        while j < len(out) and out[j].kind == "filter" \
+                and _atom_filter(out[j].comp):
+            j += 1
+        if j - i > 1:
+            run = out[i:j]
+            sels = [_n.observed_selectivity(o.comp) for o in run]
+            if any(s is not None for s in sels):
+                order = sorted(range(len(run)),
+                               key=lambda k: (sels[k] if sels[k]
+                                              is not None else 1.0, k))
+                if order != list(range(len(run))):
+                    out[i:j] = [run[k] for k in order]
+                    changed = True
+        i = j
+    if changed:
+        from ..utils.tracing import counters
+        counters.inc("plan.filter_reorders")
+    return out, changed
 
 
 def build_plan(frame) -> Optional[ExecPlan]:
@@ -353,6 +416,11 @@ def build_plan(frame) -> Optional[ExecPlan]:
         return None  # nothing to win; per-op semantics stay canonical
     if MASK in leaf.schema or any(MASK in o.schema for o in ops):
         return None
+    # adaptive re-plan (docs/adaptive.md): order observed-selective
+    # conjunctive filters first — on every forcing AND between stream
+    # batches, since each batch builds a fresh plan over the shared
+    # canonical computations carrying the observations
+    ops, reordered = _reorder_filters(ops)
 
     # legality: every device op must carry a proof, or the chain falls
     # back wholesale (all-or-nothing keeps error contracts identical)
@@ -498,9 +566,22 @@ def build_plan(frame) -> Optional[ExecPlan]:
             return None
     pruned = tuple(f.name for f in leaf.schema if f.name not in need) \
         if prunable_leaf else ()
+    # adaptive legality + priced selectivities (docs/adaptive.md): the
+    # block re-bucketing pass may only touch chains whose every device
+    # op is provably row-local — vmapped map_rows, selects, and
+    # atom-proven filter predicates (cross-row map_blocks statistics
+    # would change under coalescing); filters record the selectivity
+    # this plan priced them at, the re-plan trigger's baseline
+    row_local_chain = bool(stages) and all(
+        o.kind in ("map_rows", "select")
+        or (o.kind == "filter" and _atom_filter(o.comp))
+        for o in ops)
+    priced_sel = {i: _n.observed_selectivity(o.comp)
+                  for i, o in enumerate(ops) if o.kind == "filter"}
     return ExecPlan(leaf, list(ops), stages, final_schema, leaf_required,
                     frozenset(scan_names), device_ops, pruned,
-                    _scan_atoms(leaf, ops))
+                    _scan_atoms(leaf, ops), row_local_chain=row_local_chain,
+                    priced_sel=priced_sel, reordered=reordered)
 
 
 def _scan_atoms(leaf, ops):

@@ -25,10 +25,13 @@ watermarks (observability.device), and the bounded pipeline window
   (``observability.device.watermark()``; fraction
   ``TFT_SERVE_HBM_FRACTION`` of the allocator limit). A query that would
   cross the mark WAITS (bounded by ``TFT_SERVE_ADMISSION_WAIT_S`` and
-  its own deadline) and is then **shed** with a classified
-  :class:`~..resilience.AdmissionDeadline` — a policy rejection instead
-  of an OOM mid-flight. Backends that report no memory stats (CPU)
-  admit freely.
+  its own deadline); mid-wait the scheduler asks the largest
+  checkpointable running query to PARK (preempt-aware admission,
+  ``serve.admission_preempts``) so the arrival can fit, and only an
+  arrival preemption could not make room for is **shed** with a
+  classified :class:`~..resilience.AdmissionDeadline` — a policy
+  rejection instead of an OOM mid-flight. Backends that report no
+  memory stats (CPU) admit freely.
 - **Execution**: workers force the frame inside a
   :func:`~..observability.query_trace` carrying the tenant label (the
   frame's own forcing joins it, so block/retry/compile events correlate
@@ -804,7 +807,8 @@ class QueryScheduler:
                   q.tenant, cp.parked_blocks if cp is not None else 0)
 
     def _admit(self, q: SubmittedQuery) -> None:
-        """HBM admission: wait (bounded) for headroom, else shed.
+        """HBM admission: wait (bounded) for headroom, preempting a
+        checkpointable whale to clear it, else shed.
 
         Against a real backend watermark the whole-frame estimate is
         the enforceable footprint (pre-spill semantics). When the
@@ -815,6 +819,15 @@ class QueryScheduler:
         so the footprint compared is the streaming working set
         (~one block) — a larger-than-budget query is executable
         out-of-core and must not be shed for its total size.
+
+        Preempt-aware (the PR 13 follow-on, ``docs/serving.md``):
+        before falling through to shed, the wait asks the
+        largest-footprint running query to PARK at its next block
+        boundary — its checkpoint moves completed block outputs
+        off-device through the memory ledger, clearing headroom the
+        arrival can use, and the whale resumes later from where it
+        parked. An arrival is rejected only when preemption could not
+        free enough within the wait budget.
         """
         if not self._admission or not q.est_bytes:
             return
@@ -831,6 +844,7 @@ class QueryScheduler:
         if q.deadline_at is not None:
             give_up_at = min(give_up_at, q.deadline_at)
         waited = False
+        preempt_tried = False
         while True:
             if q._cancel_requested:
                 # don't spend the admission-wait budget on a query
@@ -843,18 +857,68 @@ class QueryScheduler:
                 if waited:
                     counters.inc("serve.admission_waits")
                 return
+            if not preempt_tried:
+                # one preemption attempt per admission: ask the whale
+                # to park, then keep polling while it checkpoints
+                preempt_tried = True
+                self._preempt_for_admission(q, need,
+                                            shortfall=need - headroom)
             if time.monotonic() >= give_up_at:
                 raise AdmissionDeadline(
                     f"query {q.query_id} (tenant {q.tenant!r}) shed: "
                     f"estimated footprint {need} B exceeds HBM "
                     f"headroom {headroom} B and admission could not "
-                    f"clear within its budget (classified "
-                    f"'deadline_admission')")
+                    f"clear within its budget — preemption could not "
+                    f"free enough (classified 'deadline_admission')")
             if not waited:
                 waited = True
                 _obs.add_event("sched_admission_wait", name=q.query_id,
                                tenant=q.tenant, est_bytes=need)
             time.sleep(max(poll, 0.001))
+
+    def _preempt_for_admission(self, q: SubmittedQuery, need: int,
+                               shortfall: int) -> bool:
+        """Ask the largest-footprint checkpointable running query to
+        park so ``q`` can admit (``docs/serving.md``). Returns whether
+        a preempt was requested; the park itself happens at the
+        victim's next block boundary. A victim whose known footprint
+        cannot plausibly cover ``shortfall`` is left alone — parking
+        it would cost a checkpoint + resume for zero headroom gain."""
+        if not self._preemption:
+            return False
+        with self._cond:
+            victims = [(v, sc) for v in self._queries.values()
+                       for sc in (v._scope,)
+                       if v is not q and v.state == "running"
+                       and sc is not None
+                       and not sc.preempt_requested
+                       and not sc.cancel_requested]
+        if not victims:
+            return False
+        victim, vscope = max(
+            victims, key=lambda p: (p[0].est_bytes or 0,
+                                    p[0].started_at or 0.0))
+        if victim.est_bytes is not None \
+                and victim.est_bytes < max(shortfall, 0):
+            _log.info(
+                "admission for query %s: not preempting — the largest "
+                "running query %s (est %d B) cannot cover the %d B "
+                "shortfall; the arrival will shed at its wait budget",
+                q.query_id, victim.query_id, victim.est_bytes,
+                shortfall)
+            return False
+        vscope.request_preempt(
+            f"parked to clear {need} B of admission headroom for "
+            f"query {q.query_id} (tenant {q.tenant!r})")
+        counters.inc("serve.admission_preempts")
+        _obs.add_event("sched_admission_preempt", name=q.query_id,
+                       tenant=q.tenant, victim=victim.query_id,
+                       victim_bytes=victim.est_bytes or 0)
+        _log.info("admission for query %s (tenant %r, %d B) preempting "
+                  "query %s (est %s B): parking the whale instead of "
+                  "shedding the arrival", q.query_id, q.tenant, need,
+                  victim.query_id, victim.est_bytes)
+        return True
 
     def _hbm_headroom(self) -> Optional[int]:
         """Bytes below the high-water mark, or None when unenforceable.

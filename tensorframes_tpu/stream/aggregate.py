@@ -778,14 +778,21 @@ class StreamingAggregation:
 
     # -- execution ---------------------------------------------------------
     def start(self, sink=None, on_update=None, name: Optional[str] = None,
-              max_buffered: Optional[int] = None):
+              max_buffered: Optional[int] = None, batch_rows=None):
         """A :class:`~.runtime.StreamHandle` pumping the upstream and
         folding each batch into this aggregation; emitted window frames
-        flow to ``collect_updates()`` / ``sink`` / ``on_update``."""
+        flow to ``collect_updates()`` / ``sink`` / ``on_update``.
+        ``batch_rows`` sizes batches (``docs/adaptive.md``): the fold
+        is a keyed monoid, so coalesced batches combine to the same
+        state as the per-block ones; with out-of-order event times the
+        per-merged-batch watermark can only ADMIT rows the per-block
+        cadence would have dropped late, never the reverse
+        (``docs/streaming.md``)."""
         from .runtime import StreamHandle
         return StreamHandle(self.upstream, aggregation=self, sink=sink,
                             on_update=on_update, name=name,
-                            max_buffered=max_buffered)
+                            max_buffered=max_buffered,
+                            batch_rows=batch_rows)
 
     def __repr__(self):
         w = (f"window={self.window.size}/{self.window.slide}"

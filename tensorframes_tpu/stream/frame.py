@@ -156,14 +156,21 @@ class StreamingFrame:
 
     # -- execution ---------------------------------------------------------
     def start(self, sink=None, on_update=None, name: Optional[str] = None,
-              max_buffered: Optional[int] = None):
+              max_buffered: Optional[int] = None, batch_rows=None):
         """Build a :class:`~.runtime.StreamHandle` pumping this stream's
         batches: each batch's resulting frame is buffered for
         ``collect_updates()`` and delivered to ``sink`` / ``on_update``.
-        See ``docs/streaming.md``."""
+        ``batch_rows`` sizes batches: ``"adaptive"`` coalesces
+        already-available source blocks toward a runtime-feedback row
+        target (``docs/adaptive.md``), an int pins a fixed target,
+        ``None`` keeps one source block per batch. Coalescing changes
+        batch BOUNDARIES — use it for row-local chains; a per-batch
+        cross-row ``map_blocks`` (``x - x.mean()``) sees the merged
+        batch (``docs/streaming.md``)."""
         from .runtime import StreamHandle
         return StreamHandle(self, sink=sink, on_update=on_update,
-                            name=name, max_buffered=max_buffered)
+                            name=name, max_buffered=max_buffered,
+                            batch_rows=batch_rows)
 
 
 class GroupedStream:

@@ -148,12 +148,31 @@ class StreamHandle:
     def __init__(self, sframe, aggregation=None, sink=None,
                  on_update: Optional[Callable[[TensorFrame], None]] = None,
                  name: Optional[str] = None,
-                 max_buffered: Optional[int] = None):
+                 max_buffered: Optional[int] = None,
+                 batch_rows=None):
         self._sframe = sframe
         self._agg = aggregation
         self._sink = sink
         self._on_update = on_update
         self.name = name or f"stream-{id(self) & 0xffff:x}"
+        # adaptive batch sizing (docs/adaptive.md): "adaptive" sizes
+        # batches from runtime feedback (AIMD over the measured batch
+        # wall inside the ledger ceiling), an int pins a fixed row
+        # target; None (the default) processes one source block per
+        # batch, bit-identical to every prior release. Both opt-in
+        # modes degrade to pass-through under TFT_ADAPTIVE=0.
+        self._batcher = None
+        self._fixed_rows: Optional[int] = None
+        if batch_rows == "adaptive":
+            from ..memory.estimate import schema_row_bytes
+            try:
+                rb = max(int(schema_row_bytes(sframe.source.schema)), 1)
+            except Exception:  # noqa: BLE001 - sizing hint only
+                rb = 8
+            from ..plan.adaptive import AdaptiveBatcher
+            self._batcher = AdaptiveBatcher(row_bytes=rb)
+        elif batch_rows is not None:
+            self._fixed_rows = max(int(batch_rows), 1)
         cap = (max_buffered if max_buffered is not None
                else env_int("TFT_STREAM_BUFFER", 1024))
         self._updates: "deque[TensorFrame]" = deque(maxlen=max(1, cap))
@@ -216,8 +235,65 @@ class StreamHandle:
             if self._sframe.source.done():
                 self._finalize()
             return False
+        if self._batcher is not None or self._fixed_rows is not None:
+            block = self._fill_batch(block)
+        processed_before = self._batches
         self._process(block)
+        if self._batcher is not None and self._last_batch_s is not None \
+                and self._batches > processed_before:
+            # only a batch that actually EXECUTED feeds the sizer: a
+            # poisoned/skipped batch leaves _last_batch_s at the prior
+            # batch's wall, and observing that pair would ratchet the
+            # target on work that never ran
+            self._batcher.observe(block.num_rows, self._last_batch_s)
         return True
+
+    def _batch_target(self, buffered_rows: int) -> bool:
+        """Keep filling the current batch? (docs/adaptive.md)"""
+        from ..plan import adaptive as _adaptive
+        if not _adaptive.enabled():
+            return False  # TFT_ADAPTIVE=0: one source block per batch
+        if self._fixed_rows is not None:
+            return buffered_rows < self._fixed_rows
+        return self._batcher.want_more(buffered_rows)
+
+    def _fill_batch(self, first):
+        """Coalesce already-available source blocks up to the row
+        target (never waits: a batch is whatever the source has NOW,
+        so latency is untouched). A poisoned poll mid-fill counts its
+        skip and the buffered rows still process."""
+        bufs = [first]
+        rows = first.num_rows
+        while self._batch_target(rows):
+            try:
+                nxt = self._sframe.source.poll(0.0)
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as e:
+                kind = error_kind(e)
+                counters.inc("stream.batches_skipped")
+                with self._lock:
+                    self._skipped += 1
+                _obs.add_event("batch_skip", name=self.name,
+                               site="source", error=type(e).__name__,
+                               kind=kind)
+                if env_bool("TFT_STREAM_FAIL_FAST", False):
+                    raise
+                _log.error(
+                    "stream %s: source rejected a batch mid-fill "
+                    "(%s: %s; classified %s); skipped — the buffered "
+                    "rows still process", self.name,
+                    type(e).__name__, e, kind)
+                break
+            if nxt is None:
+                break
+            bufs.append(nxt)
+            rows += nxt.num_rows
+        if len(bufs) == 1:
+            return first
+        from ..frame import Block
+        counters.inc("stream.batches_coalesced", len(bufs) - 1)
+        return Block.concat(bufs, self._sframe.source.schema)
 
     def run(self, max_batches: Optional[int] = None,
             timeout_s: Optional[float] = None,

@@ -112,6 +112,15 @@ def pytest_configure(config):
                    "re-admission + shrink/grow churn (run-tests.sh "
                    "--preempt runs this lane standalone)")
     config.addinivalue_line(
+        "markers", "adaptive: adaptive-execution suite — feedback-"
+                   "driven block re-bucketing, observed-selectivity "
+                   "filter re-ordering and mid-plan re-plans, the "
+                   "plan-fingerprint result cache, adaptive stream "
+                   "batch sizing, preempt-aware admission; every "
+                   "decision bit-identical vs TFT_ADAPTIVE=0 / "
+                   "TFT_RESULT_CACHE=0 (run-tests.sh --adaptive runs "
+                   "this lane standalone)")
+    config.addinivalue_line(
         "markers", "timing: wall-clock-sensitive deadline assertions — "
                    "margins are widened for loaded machines "
                    "(TFT_TIMING_MARGIN multiplies the bounds; "

@@ -1,0 +1,636 @@
+"""Adaptive-execution suite (tier-1; marker ``adaptive``;
+``run-tests.sh --adaptive``).
+
+The load-bearing contract: **every adaptive decision is bit-identical
+to the static path**. Each equivalence case runs the same chain under
+the default (``TFT_ADAPTIVE``/``TFT_RESULT_CACHE`` on — re-bucketed
+block layouts, filter re-ordering, mid-plan re-plans, result-cache
+hits) and under ``TFT_ADAPTIVE=0``/``TFT_RESULT_CACHE=0`` (the static
+layout), and compares blocks value-for-value, dtype-for-dtype, block
+boundaries included — across relational chains, streams, and source
+shapes. On top of that:
+
+- the block coalesce/split pass engages only after a measured forcing
+  (feedback-gated), only on provably row-local chains, and restores
+  the original block boundaries;
+- conjunctive atom-proven filters re-order most-selective-first from
+  observed selectivity; non-atom (cross-row) predicates never move;
+- a result-cache hit re-forces with ZERO pipeline dispatches, is
+  admitted two-touch, and invalidates on any source-version change
+  (parquet append, ``uncache()``);
+- preempt-aware serve admission parks a checkpointable whale instead
+  of shedding the arrival (deadline assertions ride the ``timing``
+  lane with ``timing_margin``).
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import tensorframes_tpu as tft
+from tensorframes_tpu import io as tio
+from tensorframes_tpu.plan import adaptive as _adaptive
+from tensorframes_tpu.utils.tracing import counters
+
+from conftest import timing_margin
+
+pytestmark = pytest.mark.adaptive
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    monkeypatch.setenv("TFT_RETRY_BASE_DELAY", "0.001")
+    monkeypatch.delenv("TFT_ADAPTIVE", raising=False)
+    monkeypatch.delenv("TFT_RESULT_CACHE", raising=False)
+    monkeypatch.delenv("TFT_FUSE", raising=False)
+    _adaptive.invalidate_results()
+    yield
+    _adaptive.invalidate_results()
+
+
+def _snapshot(frame):
+    out = []
+    for b in frame.blocks():
+        cols = {}
+        for n, c in b.columns.items():
+            cols[n] = list(c) if not isinstance(c, np.ndarray) else c
+        out.append((b.num_rows, cols))
+    return out
+
+
+def _assert_identical(adaptive, static):
+    assert len(adaptive) == len(static), "block count differs"
+    for i, ((na, ca), (ns, cs)) in enumerate(zip(adaptive, static)):
+        assert na == ns, f"block {i}: rows {na} != {ns}"
+        assert set(ca) == set(cs), f"block {i}: columns differ"
+        for n in cs:
+            a, s = ca[n], cs[n]
+            if isinstance(s, np.ndarray):
+                assert isinstance(a, np.ndarray), (i, n)
+                assert a.dtype == s.dtype, (i, n, a.dtype, s.dtype)
+                assert a.shape == s.shape, (i, n, a.shape, s.shape)
+                assert np.array_equal(a, s), (i, n)
+            else:
+                assert len(a) == len(s), (i, n)
+                for x, y in zip(a, s):
+                    assert np.array_equal(np.asarray(x), np.asarray(y))
+
+
+def _static_snapshot(monkeypatch, build):
+    monkeypatch.setenv("TFT_ADAPTIVE", "0")
+    monkeypatch.setenv("TFT_RESULT_CACHE", "0")
+    snap = _snapshot(build())
+    monkeypatch.delenv("TFT_ADAPTIVE")
+    monkeypatch.delenv("TFT_RESULT_CACHE")
+    return snap
+
+
+# ---------------------------------------------------------------------------
+# leg 1: adaptive block sizing
+# ---------------------------------------------------------------------------
+
+class TestAdaptiveBlockSizing:
+    def test_coalesce_engages_second_forcing_and_is_bit_identical(
+            self, monkeypatch):
+        # 48 dispatch-bound blocks; feedback gate: forcing 1 static,
+        # forcing 2 re-bucketed; boundaries restored both times
+        df = tft.frame({"x": np.arange(3000, dtype=np.float64)},
+                       num_partitions=48)
+        df.cache()
+        f = lambda x: {"y": x * 2.0 + 1.0}        # noqa: E731
+        p = lambda y: y > 500.0                   # noqa: E731
+
+        def build():
+            return df.map_rows(f).filter(p).select(["y"])
+
+        before = counters.get("plan.adaptive_layouts")
+        first = _snapshot(build())
+        assert counters.get("plan.adaptive_layouts") == before, \
+            "first forcing must run the static layout (no feedback yet)"
+        second = _snapshot(build())
+        assert counters.get("plan.adaptive_layouts") > before
+        assert counters.get("plan.adaptive_coalesces") > 0
+        static = _static_snapshot(monkeypatch, build)
+        _assert_identical(first, static)
+        _assert_identical(second, static)
+
+    def test_split_oversized_block_under_ledger(self, monkeypatch):
+        from tensorframes_tpu import memory as _memory
+        df = tft.frame({"x": np.arange(60_000, dtype=np.float64)},
+                       num_partitions=2)
+        df.cache()
+        f = lambda x: {"y": x + 0.5}              # noqa: E731
+        g = lambda y: {"z": y * 2.0}              # noqa: E731
+
+        def build():
+            return df.map_rows(f).map_rows(g).select(["z"])
+
+        static = _static_snapshot(monkeypatch, build)
+        # single-block frame: the canonical split case (one block far
+        # over the ceiling) must also re-bucket
+        one = tft.frame({"x": np.arange(60_000, dtype=np.float64)},
+                        num_partitions=1)
+        one.cache()
+
+        def build_one():
+            return one.map_rows(f).map_rows(g).select(["z"])
+
+        static_one = _static_snapshot(monkeypatch, build_one)
+        _memory.configure(limit_bytes=300_000)  # blocks ~480 KB each
+        try:
+            before = counters.get("plan.adaptive_splits")
+            first = _snapshot(build())    # static (feedback gate)
+            second = _snapshot(build())   # split layout
+            assert counters.get("plan.adaptive_splits") > before
+            before1 = counters.get("plan.adaptive_splits")
+            first_one = _snapshot(build_one())
+            second_one = _snapshot(build_one())
+            assert counters.get("plan.adaptive_splits") > before1
+        finally:
+            _memory._reset()
+        _assert_identical(first, static)
+        _assert_identical(second, static)
+        _assert_identical(first_one, static_one)
+        _assert_identical(second_one, static_one)
+
+    def test_empty_and_skewed_partitions_restore_boundaries(
+            self, monkeypatch):
+        # skewed layout with EMPTY partitions: 0-row originals must
+        # come back as the verbatim empty-chain replay, in position
+        blocks = ([np.arange(400.0)] + [np.empty(0)] * 3
+                  + [np.arange(400.0, 405.0)] * 20)
+        from tensorframes_tpu.frame import Block, TensorFrame
+        schema = tft.frame({"x": blocks[0]}).schema
+        bl = [Block({"x": a}, len(a)) for a in blocks]
+        df = TensorFrame.from_blocks(bl, schema)
+        df.cache()
+        f = lambda x: {"y": x - 1.0}              # noqa: E731
+        p = lambda y: y < 300.0                   # noqa: E731
+
+        def build():
+            return df.map_rows(f).filter(p)
+
+        _snapshot(build())                        # feedback
+        adaptive = _snapshot(build())
+        static = _static_snapshot(monkeypatch, build)
+        _assert_identical(adaptive, static)
+        assert len(adaptive) == len(bl)
+
+    def test_cross_row_map_blocks_never_rebuckets(self, monkeypatch):
+        # z = x - mean(x) is block-level state: coalescing would change
+        # the mean, so the chain must stay on the static layout
+        import jax.numpy as jnp
+        df = tft.frame({"x": np.arange(900, dtype=np.float64)},
+                       num_partitions=30)
+        df.cache()
+        f = lambda x: {"z": x - jnp.mean(x)}      # noqa: E731
+        g = lambda z: {"w": z * 2.0}              # noqa: E731
+
+        def build():
+            return df.map_blocks(f).map_blocks(g).select(["w"])
+
+        before = counters.get("plan.adaptive_layouts")
+        first = _snapshot(build())
+        second = _snapshot(build())
+        assert counters.get("plan.adaptive_layouts") == before
+        static = _static_snapshot(monkeypatch, build)
+        _assert_identical(first, static)
+        _assert_identical(second, static)
+
+    def test_adaptive_layout_over_join_leaf(self, monkeypatch):
+        import jax.numpy as jnp
+        from tensorframes_tpu import relational as rel
+        left = tft.frame(
+            {"k": np.arange(600, dtype=np.int64) % 50,
+             "v": np.arange(600, dtype=np.float64)},
+            num_partitions=24)
+        right = tft.frame(
+            {"k": np.arange(50, dtype=np.int64),
+             "w": np.arange(50, dtype=np.float64) * 10.0})
+        left.cache()
+        right.cache()
+        f = lambda v, w: {"s": v + jnp.asarray(w)}   # noqa: E731
+
+        def build():
+            out = rel.broadcast_join(left, right, on="k", how="left")
+            return out.map_rows(f).select(["k", "s"])
+
+        first = _snapshot(build())
+        second = _snapshot(build())
+        static = _static_snapshot(monkeypatch, build)
+        _assert_identical(first, static)
+        _assert_identical(second, static)
+
+
+# ---------------------------------------------------------------------------
+# leg 2: re-planning from observed selectivity
+# ---------------------------------------------------------------------------
+
+class TestReplanning:
+    def test_filter_reorder_is_bit_identical(self, monkeypatch):
+        df = tft.frame({"z": np.arange(4000, dtype=np.float64)},
+                       num_partitions=16)
+        df.cache()
+        p_all = lambda z: z >= 0.0                # noqa: E731
+        p_few = lambda z: z < 15.0                # noqa: E731
+
+        def build():
+            return df.filter(p_all).filter(p_few)
+
+        before = counters.get("plan.filter_reorders")
+        first = _snapshot(build())      # records observed selectivity
+        second = _snapshot(build())     # re-ordered plan
+        assert counters.get("plan.filter_reorders") > before
+        static = _static_snapshot(monkeypatch, build)
+        _assert_identical(first, static)
+        _assert_identical(second, static)
+
+    def test_cross_row_predicate_never_reorders(self, monkeypatch):
+        # a predicate the atom extractor cannot prove row-local must
+        # keep its position: reordering x > mean(x) would change it
+        import jax.numpy as jnp
+        df = tft.frame({"z": np.arange(1000, dtype=np.float64)},
+                       num_partitions=4)
+        df.cache()
+        p_mean = lambda z: z > jnp.mean(z)        # noqa: E731
+        p_few = lambda z: z < 900.0               # noqa: E731
+
+        def build():
+            return df.filter(p_mean).filter(p_few)
+
+        before = counters.get("plan.filter_reorders")
+        first = _snapshot(build())
+        second = _snapshot(build())
+        assert counters.get("plan.filter_reorders") == before
+        static = _static_snapshot(monkeypatch, build)
+        _assert_identical(first, static)
+        _assert_identical(second, static)
+
+    def test_mid_plan_replan_on_shifted_distribution(self, monkeypatch):
+        # q2 keeps everything on the warm-up data, then drops ~99% on
+        # the real forcing: the probe block's observation deviates past
+        # TFT_REPLAN_RATIO mid-run and the remaining stages re-plan
+        monkeypatch.setenv("TFT_REPLAN_RATIO", "3")
+        q1 = lambda v: v > -1.0                   # noqa: E731
+        q2 = lambda v: v < 50.0                   # noqa: E731
+
+        def chain(frame):
+            return frame.filter(q1).filter(q2)
+
+        warm = tft.frame({"v": np.arange(30, dtype=np.float64)},
+                         num_partitions=30)
+        warm.cache()
+        _snapshot(chain(warm))          # priced ~keep-everything
+        _snapshot(chain(warm))          # feedback for the shape
+
+        big = tft.frame({"v": np.arange(6000, dtype=np.float64)},
+                        num_partitions=30)
+        big.cache()
+
+        def build():
+            return chain(big)
+
+        before = counters.get("plan.replans")
+        out = _snapshot(build())
+        assert counters.get("plan.replans") > before, \
+            "expected a mid-plan re-plan at the probe boundary"
+        static = _static_snapshot(monkeypatch, build)
+        _assert_identical(out, static)
+
+    def test_join_cardinality_from_build_table_spans(self):
+        from tensorframes_tpu import relational as rel
+        # duplicate build keys: 4 rows per key — the sketch-based
+        # estimate prices the expansion, not the old probe-row count
+        left = tft.frame({"k": np.arange(100, dtype=np.int64) % 10,
+                          "v": np.arange(100, dtype=np.float64)})
+        right = tft.frame(
+            {"k": np.repeat(np.arange(10, dtype=np.int64), 4),
+             "w": np.arange(40, dtype=np.float64)})
+        out = rel.broadcast_join(left, right, on="k", how="inner")
+        est = out.estimated_rows()
+        assert est is not None and 300 <= est <= 500  # true: 400
+        # unique build keys stay exact (the PR 12 contract)
+        right_u = tft.frame({"k": np.arange(10, dtype=np.int64),
+                             "w": np.arange(10, dtype=np.float64)})
+        out_u = rel.broadcast_join(left, right_u, on="k", how="left")
+        assert out_u.estimated_rows() == 100
+
+    def test_approx_key_distinct_probe(self):
+        from tensorframes_tpu.relational.join import approx_key_distinct
+        df = tft.frame({"k": (np.arange(5000) % 137).astype(np.int64),
+                        "v": np.arange(5000, dtype=np.float64)},
+                       num_partitions=4)
+        assert approx_key_distinct(df, ["k"]) is None  # unforced
+        df.cache()
+        est = approx_key_distinct(df, ["k"])
+        assert est is not None and abs(est - 137) / 137 < 0.15
+        # cached per (keys, version)
+        before = counters.get("relational.key_distinct_probes")
+        approx_key_distinct(df, ["k"])
+        assert counters.get("relational.key_distinct_probes") == before
+
+
+# ---------------------------------------------------------------------------
+# leg 3: the plan-fingerprint result cache
+# ---------------------------------------------------------------------------
+
+class TestResultCache:
+    def test_two_touch_hit_with_zero_dispatches(self, monkeypatch):
+        df = tft.frame({"x": np.arange(512, dtype=np.float64)},
+                       num_partitions=8)
+        df.cache()
+        f = lambda x: {"y": x * 3.0}              # noqa: E731
+
+        def build():
+            return df.map_blocks(f).select(["y"])
+
+        static = _static_snapshot(monkeypatch, build)
+        hits0 = counters.get("plan.result_cache_hits")
+        _snapshot(build())        # 1st: seen
+        _snapshot(build())        # 2nd: interned
+        assert counters.get("plan.result_cache_hits") == hits0
+        before = (counters.get("pipeline.submitted"),
+                  counters.get("pipeline.drained"))
+        frame = build()
+        out = _snapshot(frame)    # 3rd: HIT
+        after = (counters.get("pipeline.submitted"),
+                 counters.get("pipeline.drained"))
+        assert counters.get("plan.result_cache_hits") == hits0 + 1
+        assert after == before, "a cache hit must dispatch nothing"
+        assert frame._plan_info and "result cache" in frame._plan_info[0]
+        _assert_identical(out, static)
+
+    def test_off_switch(self, monkeypatch):
+        monkeypatch.setenv("TFT_RESULT_CACHE", "0")
+        df = tft.frame({"x": np.arange(64, dtype=np.float64)})
+        df.cache()
+        f = lambda x: {"y": x + 1.0}              # noqa: E731
+
+        def build():
+            return df.map_blocks(f)
+
+        hits0 = counters.get("plan.result_cache_hits")
+        for _ in range(4):
+            _snapshot(build())
+        assert counters.get("plan.result_cache_hits") == hits0
+
+    def test_uncache_reversions_and_misses(self):
+        df = tft.frame({"x": np.arange(64, dtype=np.float64)})
+        df.cache()
+        f = lambda x: {"y": x + 1.0}              # noqa: E731
+
+        def build():
+            return df.map_blocks(f)
+
+        _snapshot(build())
+        _snapshot(build())        # interned
+        hits0 = counters.get("plan.result_cache_hits")
+        df.uncache()              # source re-versioned
+        df.cache()
+        _snapshot(build())
+        assert counters.get("plan.result_cache_hits") == hits0
+
+    def test_stale_invalidation_after_parquet_append(
+            self, monkeypatch, tmp_path):
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+        path = str(tmp_path / "t.parquet")
+        tio.write_parquet(
+            tft.frame({"x": np.arange(32, dtype=np.float64)},
+                      num_partitions=2), path)
+        f = lambda x: {"y": x * 2.0}              # noqa: E731
+
+        def build():
+            return tio.read_parquet(path).map_blocks(f).select(["y"])
+
+        static = _static_snapshot(monkeypatch, build)
+        _snapshot(build())
+        second = _snapshot(build())               # interned
+        _assert_identical(second, static)
+        hits0 = counters.get("plan.result_cache_hits")
+        out3 = _snapshot(build())                 # hit
+        assert counters.get("plan.result_cache_hits") == hits0 + 1
+        _assert_identical(out3, static)
+        # append a row group: footer identity changes -> the old entry
+        # can never hit; the re-read sees the same pinned range
+        time.sleep(0.01)
+        with pq.ParquetWriter(
+                path, pa.table(
+                    {"x": np.arange(40, dtype=np.float64)}).schema) \
+                as w:
+            w.write_table(
+                pa.table({"x": np.arange(40, dtype=np.float64)}))
+        hits1 = counters.get("plan.result_cache_hits")
+        fresh = _snapshot(build())
+        assert counters.get("plan.result_cache_hits") == hits1
+        assert sum(n for n, _ in fresh) == 40
+
+    def test_streaming_batches_never_pollute_the_cache(self):
+        from tensorframes_tpu import stream
+        stats0 = _adaptive.result_cache_stats()["entries"]
+
+        def batches():
+            for i in range(6):
+                yield {"x": np.arange(8, dtype=np.float64) + i}
+
+        f = lambda x: {"y": x + 1.0}              # noqa: E731
+        h = stream.from_source(stream.GeneratorSource(batches())) \
+            .map_blocks(f).start(name="rc-pollute")
+        h.run()
+        assert _adaptive.result_cache_stats()["entries"] == stats0
+
+    def test_lru_eviction_under_entry_budget(self, monkeypatch):
+        monkeypatch.setenv("TFT_RESULT_CACHE_ENTRIES", "2")
+        df = tft.frame({"x": np.arange(32, dtype=np.float64)})
+        df.cache()
+        fns = [(lambda k: (lambda x: {"y": x + float(k)}))(k)
+               for k in range(4)]
+
+        def build(k):
+            return df.map_blocks(fns[k])
+
+        for k in range(4):
+            _snapshot(build(k))
+            _snapshot(build(k))   # intern each
+        assert _adaptive.result_cache_stats()["entries"] <= 2
+        assert counters.get("plan.result_cache_evictions") >= 2
+
+
+# ---------------------------------------------------------------------------
+# streams: adaptive batch sizing
+# ---------------------------------------------------------------------------
+
+class TestStreamBatchSizing:
+    def _rows(self, frames):
+        out = []
+        for fr in frames:
+            for b in fr.blocks():
+                out.extend(np.asarray(b.columns["y"]).tolist())
+        return out
+
+    def test_adaptive_batches_same_rows(self, monkeypatch):
+        from tensorframes_tpu import stream
+        f = lambda x: {"y": x * 2.0}              # noqa: E731
+
+        def batches():
+            for i in range(24):
+                yield {"x": np.arange(4, dtype=np.float64) + 4 * i}
+
+        h1 = stream.from_source(stream.GeneratorSource(batches())) \
+            .map_blocks(f).start(name="ab-static")
+        h1.run()
+        want = self._rows(h1.collect_updates())
+
+        h2 = stream.from_source(stream.GeneratorSource(batches())) \
+            .map_blocks(f).start(name="ab-adaptive",
+                                 batch_rows="adaptive")
+        h2.run()
+        got = self._rows(h2.collect_updates())
+        assert got == want
+        m = h2.metrics()
+        assert m["rows"] == 24 * 4
+        assert m["batches"] <= 24  # coalescing can only merge
+
+    def test_fixed_batch_rows_coalesce_and_kill_switch(
+            self, monkeypatch):
+        from tensorframes_tpu import stream
+        f = lambda x: {"y": x + 1.0}              # noqa: E731
+
+        def batches():
+            for i in range(12):
+                yield {"x": np.arange(2, dtype=np.float64) + 2 * i}
+
+        h = stream.from_source(stream.GeneratorSource(batches())) \
+            .map_blocks(f).start(name="ab-fixed", batch_rows=8)
+        h.run()
+        assert h.metrics()["rows"] == 24
+        assert h.metrics()["batches"] < 12
+
+        monkeypatch.setenv("TFT_ADAPTIVE", "0")
+        h0 = stream.from_source(stream.GeneratorSource(batches())) \
+            .map_blocks(f).start(name="ab-fixed-off", batch_rows=8)
+        h0.run()
+        assert h0.metrics()["batches"] == 12  # pass-through under =0
+
+    def test_windowed_aggregation_bit_identical_with_batching(
+            self, monkeypatch):
+        from tensorframes_tpu import stream
+
+        def batches():
+            for i in range(16):
+                yield {"k": (np.arange(4) % 2).astype(np.int64),
+                       "v": np.arange(4, dtype=np.float64) + i,
+                       "ts": np.full(4, float(i))}
+
+        def run(**kw):
+            h = stream.from_source(
+                stream.GeneratorSource(batches())) \
+                .group_by("k") \
+                .aggregate({"v": "sum"}, window=stream.tumbling(4.0),
+                           time_col="ts") \
+                .start(name=f"ab-win-{len(kw)}", **kw)
+            h.run()
+            rows = []
+            for fr in h.collect_updates():
+                for r in fr.collect():
+                    rows.append((float(r["window_start"]),
+                                 int(r["k"]), float(r["v"])))
+            return sorted(rows)
+
+        want = run()
+        got = run(batch_rows="adaptive")
+        assert got == want
+
+
+# ---------------------------------------------------------------------------
+# serve: preempt-aware admission
+# ---------------------------------------------------------------------------
+
+@pytest.mark.timing
+class TestPreemptAwareAdmission:
+    @pytest.fixture(autouse=True)
+    def _pin_memory(self):
+        # the fake watermark below must never be latched into the
+        # process memory manager's derived budget: pin an explicitly
+        # unlimited manager for the duration, then drop the singleton
+        # so later tests re-resolve against the real environment
+        from tensorframes_tpu import memory as _memory
+        _memory.configure(limit_bytes=0)
+        yield
+        _memory._reset()
+
+    def test_whale_parks_instead_of_shedding(self, monkeypatch):
+        import threading
+
+        from tensorframes_tpu import serve
+        from tensorframes_tpu.engine import preempt as _preempt
+        from tensorframes_tpu.observability import device as _obs_device
+        release = threading.Event()
+        parked = threading.Event()
+        whale_running = threading.Event()
+
+        # a synthetic watermark so admission is enforceable on CPU:
+        # roomy until the whale starts, full while it runs, roomy
+        # again once it parked (its buffers moved off-device)
+        def fake_watermark():
+            live = 900 if (whale_running.is_set()
+                           and not parked.is_set()) else 100
+            return {"live_bytes": live, "limit_bytes": 1000}
+
+        monkeypatch.setattr(_obs_device, "watermark", fake_watermark)
+        monkeypatch.setenv("TFT_SERVE_ADMISSION_WAIT_S",
+                           str(timing_margin(5.0)))
+
+        class Whale:
+            def blocks(self):
+                # a fake long-running forcing that honors preemption at
+                # its "block boundary"
+                whale_running.set()
+                sc = _preempt.current_scope()
+                for i in range(4000):
+                    if sc is not None and sc.preempt_requested \
+                            and _preempt.boundary(sc, i > 0):
+                        parked.set()
+                        _preempt.park(sc, [], 4000, None)  # raises
+                    if release.wait(0.002):
+                        break
+                return []
+
+        with serve.QueryScheduler(workers=2, name="adm-preempt") as s:
+            # the whale's footprint (800 B) plausibly covers any later
+            # arrival's shortfall — the plausibility guard lets it park
+            q_whale = s.submit(Whale(), tenant="big",
+                               est_rows=10.0, est_bytes=800)
+            t0 = time.monotonic()
+            while q_whale.state != "running" \
+                    and time.monotonic() - t0 < timing_margin(5.0):
+                time.sleep(0.005)
+            assert q_whale.state == "running"
+            before = counters.get("serve.admission_preempts")
+            small = tft.frame({"x": np.arange(8, dtype=np.float64)})
+            q2 = s.submit(small, tenant="small",
+                          est_rows=8.0, est_bytes=500)
+            assert parked.wait(timing_margin(5.0)), \
+                "the whale was never asked to park"
+            assert counters.get("serve.admission_preempts") > before
+            # the arrival admits into the cleared headroom and finishes
+            q2.result(timeout=timing_margin(10.0))
+            assert q2.state == "done"
+            release.set()
+
+    def test_no_victim_still_sheds(self, monkeypatch):
+        from tensorframes_tpu import serve
+        from tensorframes_tpu.resilience import AdmissionDeadline
+        from tensorframes_tpu.observability import device as _obs_device
+        monkeypatch.setattr(
+            _obs_device, "watermark",
+            lambda: {"live_bytes": 990, "limit_bytes": 1000})
+        monkeypatch.setenv("TFT_SERVE_ADMISSION_WAIT_S", "0.1")
+        with serve.QueryScheduler(workers=0, name="adm-shed") as s:
+            df = tft.frame({"x": np.arange(8, dtype=np.float64)})
+            q = s.submit(df, tenant="t", est_rows=8.0, est_bytes=10_000)
+            assert s.step()
+            with pytest.raises(AdmissionDeadline):
+                q.result(timeout=timing_margin(2.0))

@@ -23,7 +23,10 @@
    fault-back path moves user data between device and host (a silently
    swallowed spill error is silent data loss), the plan layer's
    fall-back-to-per-op decisions must be LOGGED (a silently swallowed
-   optimizer error would hide why a chain stopped fusing), and the
+   optimizer error would hide why a chain stopped fusing — and that
+   now includes ``plan/adaptive.py``: a swallowed re-plan, layout, or
+   result-cache error would silently pin the static path or hide why
+   an interned result vanished), and the
    relational layer's join/sketch degradations (chunked builds, host
    segment-fold fallbacks, unpushable predicates) must likewise leave a
    trace — a join that silently dropped to a slower path is a perf bug
