@@ -1172,6 +1172,84 @@ def _child(platform: str) -> None:
     finally:
         os.environ.pop("TFT_RESULT_CACHE", None)
 
+    # secondary metric (never costs the headline): the ALWAYS-ON flight
+    # recorder + SLO accounting (docs/observability.md) on the serve
+    # mixed workload. Unlike tracing (opt-in, measured off-vs-bypass),
+    # the flight layer's default state IS on, so the acceptance bar is
+    # the ON path within 2% of TFT_FLIGHT=0 (the bit-identical bypass)
+    # — order-flipped interleaved pairs, medians, wall-clock budgeted
+    # like every other secondary. The layer meets it by recording
+    # DECISIONS (admit/start/finish per query), never blocks.
+    flight_secondary = None
+    flight_budget_s = 40.0
+    flight_t0 = time.perf_counter()
+    try:
+        from statistics import median as _fl_median
+
+        from tensorframes_tpu.observability import flight as _fl_mod
+        from tensorframes_tpu.serve import (QueryScheduler as _FlSched,
+                                            TenantQuota as _FlQuota)
+
+        fl_sizes = {"small": 10_000, "medium": 50_000}
+        fl_frames = {t: [tft.frame({"x": np.arange(float(n)) + k},
+                                   num_partitions=4)
+                         for k in range(4)]
+                     for t, n in fl_sizes.items()}
+
+        def _fl_round(sched) -> float:
+            t0 = time.perf_counter()
+            futs = [sched.submit(fr, lambda x: {"z": x + 3.0}, tenant=t)
+                    for t in fl_sizes for fr in fl_frames[t]]
+            for f in futs:
+                f.result(timeout=60)
+            return time.perf_counter() - t0
+
+        def _fl_bypassed(sched) -> float:
+            os.environ["TFT_FLIGHT"] = "0"
+            try:
+                return _fl_round(sched)
+            finally:
+                os.environ.pop("TFT_FLIGHT", None)
+
+        rec0 = _fl_mod.stats()["recorded_total"]
+        with _FlSched(quotas={t: _FlQuota(max_queue=1024)
+                              for t in fl_sizes},
+                      workers=2, name="flbench") as sched:
+            # steady-state serving: warm the shared compile cache
+            sched.submit(fl_frames["small"][0],
+                         lambda x: {"z": x + 3.0},
+                         tenant="small").result(timeout=60)
+            fl_samples = {"on": [], "bypass": []}
+            rounds = 0
+            fl_pair_budget = flight_budget_s * 0.9
+            while rounds < 60 and (
+                    time.perf_counter() - flight_t0 < fl_pair_budget
+                    or rounds < 2):
+                if rounds % 2:
+                    fl_samples["on"].append(_fl_round(sched))
+                    fl_samples["bypass"].append(_fl_bypassed(sched))
+                else:
+                    fl_samples["bypass"].append(_fl_bypassed(sched))
+                    fl_samples["on"].append(_fl_round(sched))
+                rounds += 1
+        fl_on = _fl_median(fl_samples["on"])
+        fl_byp = _fl_median(fl_samples["bypass"])
+        fl_pct = (fl_on - fl_byp) / fl_byp * 100.0
+        flight_secondary = {
+            "queries_per_round": sum(len(v) for v in fl_frames.values()),
+            "rounds": rounds,
+            "bypass_round_s": round(fl_byp, 6),
+            "on_round_s": round(fl_on, 6),
+            "always_on_overhead_pct": round(fl_pct, 2),
+            "within_2pct": bool(fl_pct < 2.0),
+            "decisions_recorded": _fl_mod.stats()["recorded_total"]
+            - rec0,
+        }
+    except Exception as e:  # noqa: BLE001 - headline must survive
+        flight_secondary = {"error": str(e)[:300]}
+    finally:
+        os.environ.pop("TFT_FLIGHT", None)
+
     # reference structure: Rows materialized in and out per block
     schema = df.schema
     t0 = time.perf_counter()
@@ -1207,6 +1285,7 @@ def _child(platform: str) -> None:
         "preempt_resume": preempt_secondary,
         "adaptive_blocks": adaptive_secondary,
         "result_cache_hit": rcache_secondary,
+        "flight_recorder_overhead": flight_secondary,
     }
 
     if plat == "tpu":

@@ -126,6 +126,17 @@ if [ "${1:-}" = "--adaptive" ]; then
   exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m adaptive "$@"
 fi
 
+# --flight: run only the flight-recorder/decision-audit/SLO/health lane
+# (tests/test_flight.py: decision ring + tft.why() reconstruction with
+# tracing off, dump-on-slow-query/giveup with rotation, SLO burn math,
+# tft.health(), metrics-provider conformance) — fast, CPU-only, no
+# native build needed
+if [ "${1:-}" = "--flight" ]; then
+  shift
+  echo "== flight lane (pytest -m flight, CPU) =="
+  exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m flight "$@"
+fi
+
 # --timing: run only the wall-clock-sensitive deadline tests, serially
 # (they flake under concurrent suite load; TFT_TIMING_MARGIN widens
 # their assertion bounds further on badly oversubscribed boxes)

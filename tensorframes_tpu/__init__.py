@@ -35,7 +35,7 @@ from .utils.tracing import dump_stats
 from .schema import Field, Schema
 from .frame import Block, GroupedFrame, Row, TensorFrame
 from . import observability
-from .observability import last_query_report
+from .observability import doctor, health, last_query_report, why
 from .computation import Computation, TensorSpec, analyze_graph
 from .api import (
     aggregate, analyze, block, explain, filter_rows, frame, map_blocks,
@@ -82,6 +82,9 @@ __all__ = [
     "initialize_logging",
     "observability",
     "last_query_report",
+    "why",
+    "health",
+    "doctor",
     "dump_stats",
     "memory",
     "relational",

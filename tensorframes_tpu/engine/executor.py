@@ -27,6 +27,7 @@ from .. import dtypes as _dt
 from .. import memory as _memory
 from .. import native as _native
 from ..computation import Computation
+from ..observability import flight as _flight
 from ..observability.events import add_event as _obs_event
 from ..observability.events import current_trace as _obs_current_trace
 from ..resilience import (default_policy, env_bool, faults, is_oom,
@@ -161,6 +162,8 @@ def _oom_split_run(executor, comp: Computation, arrays: Mapping,
             _log.debug("OOM watermark sample failed: %s", e)
     _obs_event("oom_split", rows=n_rows, error=type(cause).__name__,
                **hbm)
+    _flight.record("engine.oom_split", rows=n_rows,
+                   error=type(cause).__name__, **hbm)
     _log.warning(
         "block dispatch hit an OOM-shaped failure (%s); re-dispatching "
         "as two %d/%d-row halves", cause, n_rows // 2,
@@ -215,6 +218,9 @@ def _proactive_split_run(executor, comp: Computation, arrays: Mapping,
     """
     counters.inc("memory.proactive_splits")
     _obs_event("proactive_split", rows=n_rows, est_bytes=est)
+    mgr = _memory.active()
+    _flight.record("memory.proactive_split", rows=n_rows, bytes=est,
+                   limit=mgr.limit if mgr is not None else None)
     _log.info(
         "block of %d rows (~%d B estimated) exceeds the device budget; "
         "splitting before dispatch", n_rows, est)
@@ -407,6 +413,9 @@ class PendingBlock:
         counters.inc("pipeline.sync_fallbacks")
         _obs_event("sync_fallback", error=type(self._error).__name__,
                    padded=self._pad_to is not None)
+        _flight.record("pipeline.sync_fallback",
+                       error=type(self._error).__name__,
+                       padded=self._pad_to is not None)
         _log.warning(
             "async fast path failed for a block (%s); re-running it "
             "synchronously through the resilient path", self._error)

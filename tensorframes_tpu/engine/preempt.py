@@ -41,6 +41,7 @@ import threading
 from typing import Iterator, List, Optional, Sequence
 
 from ..observability import events as _obs
+from ..observability import flight as _flight
 from ..resilience import QueryCancelled, QueryPreempted
 from ..resilience import faults as _faults
 from ..utils.logging import get_logger
@@ -172,6 +173,8 @@ def boundary(scope: PreemptionScope, progressed: bool = True) -> bool:
         # cancelled query's own trace, not the canceller's
         _obs.add_event("cancel", name=scope.query_id,
                        reason=scope.reason or "requested")
+        _flight.record("preempt.cancel", query=scope.query_id,
+                       reason=scope.reason or "requested")
         raise QueryCancelled(
             f"query {scope.query_id} cancelled at a block boundary"
             + (f" ({scope.reason})" if scope.reason else ""))
@@ -206,6 +209,9 @@ def park(scope: PreemptionScope, outputs: Sequence, total: int,
         _obs.add_event("preempt_park", name=scope.query_id, blocks=0,
                        total=int(total), bytes=0,
                        reason=scope.reason or "requested")
+        _flight.record("preempt.park", query=scope.query_id, blocks=0,
+                       total=int(total), bytes=0, anonymous=True,
+                       reason=scope.reason or "requested")
         _log.info("query %s preempted at an anonymous stream boundary "
                   "%d/%d (%s); no checkpoint — resume re-runs it",
                   scope.query_id, len(outputs), total,
@@ -217,6 +223,9 @@ def park(scope: PreemptionScope, outputs: Sequence, total: int,
     moved = scope.ensure_checkpoint().park_stream(outputs, total, tag)
     counters.inc("pipeline.preempted_streams")
     _obs.add_event("preempt_park", name=scope.query_id,
+                   blocks=len(outputs), total=int(total), bytes=moved,
+                   reason=scope.reason or "requested")
+    _flight.record("preempt.park", query=scope.query_id,
                    blocks=len(outputs), total=int(total), bytes=moved,
                    reason=scope.reason or "requested")
     _log.info("query %s preempted at block boundary %d/%d (%s); %d B "
@@ -243,6 +252,8 @@ def resume_stream(scope: PreemptionScope, total: int,
     if restored:
         counters.inc("pipeline.resumed_blocks", len(restored))
         _obs.add_event("resume", name=scope.query_id,
+                       blocks=len(restored), total=int(total))
+        _flight.record("preempt.resume", query=scope.query_id,
                        blocks=len(restored), total=int(total))
         _log.info("query %s resumed: %d/%d block(s) restored from its "
                   "checkpoint; re-dispatching the rest",

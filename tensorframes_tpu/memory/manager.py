@@ -44,6 +44,7 @@ import weakref
 from collections import OrderedDict
 from typing import Any, Dict, Iterator, Optional
 
+from ..observability import flight as _flight
 from ..observability.events import add_event as _obs_event
 from ..resilience import check_deadline, env_bool, env_float, env_int
 from ..utils.logging import get_logger
@@ -187,6 +188,8 @@ class MemoryManager:
             counters.inc("memory.spills")
             counters.inc("memory.spill_bytes", freed)
             _obs_event("spill", name=name, bytes=freed)
+            _flight.record("memory.spill", name=name, bytes=freed,
+                           limit=self.limit)
             _log.debug("spilled %s (%d B) to host", name, freed)
         return freed
 
@@ -197,6 +200,8 @@ class MemoryManager:
             counters.inc("memory.faults")
             counters.inc("memory.fault_bytes", restored)
             _obs_event("fault", name=obj.mem_name(), bytes=restored)
+            _flight.record("memory.fault", name=obj.mem_name(),
+                           bytes=restored)
             _log.debug("faulted %s (%d B) back to device",
                        obj.mem_name(), restored)
         return restored
@@ -230,11 +235,14 @@ class MemoryManager:
         counters.inc("memory.spills")
         counters.inc("memory.spill_bytes", int(nbytes))
         _obs_event("spill", name=name, bytes=int(nbytes))
+        _flight.record("memory.spill", name=name, bytes=int(nbytes),
+                       limit=self.limit)
 
     def note_fault(self, nbytes: int, name: str) -> None:
         counters.inc("memory.faults")
         counters.inc("memory.fault_bytes", int(nbytes))
         _obs_event("fault", name=name, bytes=int(nbytes))
+        _flight.record("memory.fault", name=name, bytes=int(nbytes))
 
     # -- admission ---------------------------------------------------------
     def try_reserve(self, nbytes: int, op: str = "dispatch"
@@ -272,6 +280,8 @@ class MemoryManager:
                 self._make_room_locked(0)
                 self._inflight += nbytes
             counters.inc("memory.overflow_admissions")
+            _flight.record("memory.overflow_admit", op=op, bytes=nbytes,
+                           limit=self.limit, cause="request > budget")
             _log.warning(
                 "admitting %d B for %s OVER the %d B device budget (the "
                 "request alone exceeds it); split the input into "
@@ -283,6 +293,8 @@ class MemoryManager:
             return tok
         counters.inc("memory.admission_waits")
         _obs_event("mem_wait", name=op, bytes=nbytes)
+        _flight.record("memory.wait", op=op, bytes=nbytes,
+                       limit=self.limit)
         budget = env_float("TFT_MEM_ADMIT_WAIT_S", 5.0)
         give_up = time.monotonic() + max(budget, 0.0)
         while time.monotonic() < give_up:
@@ -292,6 +304,9 @@ class MemoryManager:
             if tok is not None:
                 return tok
         counters.inc("memory.overflow_admissions")
+        _flight.record("memory.overflow_admit", op=op, bytes=nbytes,
+                       limit=self.limit,
+                       cause=f"wait budget {budget:g}s exhausted")
         _log.warning(
             "admitting %d B for %s OVER the %d B device budget (nothing "
             "left to spill and in-flight work did not drain within "

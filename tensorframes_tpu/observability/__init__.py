@@ -30,6 +30,18 @@ package adds the query dimension on top of the existing primitives
   the human-readable per-stage breakdown, plus a mesh section
   (per-device rows/bytes/time, straggler ratio, imbalance warning)
   for queries that touched the distributed layer.
+- :mod:`.flight` — the ALWAYS-ON flight recorder: a bounded ring of
+  decision-level records (admission verdicts, re-plans, shrinks,
+  spills — each with the inputs it was decided from), correlated by
+  query id with ``TFT_TRACE`` off; JSONL auto-dumps on slow query /
+  giveup / device loss / exit (``TFT_FLIGHT_DUMP``).
+- :mod:`.decisions` — ``tft.why(query_id)`` (one query's causal chain
+  from the ring) and ``tft.doctor()`` (process triage).
+- :mod:`.slo` — per-tenant latency objectives + error-budget burn
+  rates from the existing serve latency histograms
+  (``tft_serve_slo_*``, ``serve_report()`` lines, burn callbacks).
+- :mod:`.health` — ``tft.health()``: one machine-readable snapshot
+  across ledger, mesh, serve, caches, streams, SLOs.
 
 Everything is zero-cost-when-off: with tracing disabled
 (``TFT_TRACE`` unset), :func:`query_trace` yields ``None`` and every
@@ -47,8 +59,13 @@ from .events import (DEVICE_TRACK_BASE, Event, QueryTrace, add_event,
                      last_query, query_trace, recent_events, traced_query,
                      wrap_context)
 from . import device
+from . import flight
+from . import slo
+from .decisions import doctor, why
+from .health import health
 from .metrics import metrics_port, metrics_text, serve_metrics, stop_metrics
 from .report import frame_report, last_query_report, render
+from .slo import SLO, on_burn, set_slo, slo_status
 
 __all__ = [
     "Event", "QueryTrace", "query_trace", "current_trace", "add_event",
@@ -56,6 +73,8 @@ __all__ = [
     "clear_ring", "block_meta", "bypass", "DEVICE_TRACK_BASE", "device",
     "metrics_text", "serve_metrics", "stop_metrics", "metrics_port",
     "frame_report", "last_query_report", "render",
+    "flight", "slo", "why", "doctor", "health",
+    "SLO", "set_slo", "slo_status", "on_burn",
 ]
 
 _log = get_logger("observability")
@@ -65,6 +84,12 @@ _log = get_logger("observability")
 from .events import _on_span as _span_observer  # noqa: E402
 
 _tracing.set_span_observer(_span_observer)
+
+# the flight recorder's and SLO layer's metrics families register once
+# the provider registry exists (deferred: flight/slo are imported by
+# metrics' own import chain)
+flight._register_metrics()
+slo._register_metrics()
 
 
 def _maybe_autostart() -> None:

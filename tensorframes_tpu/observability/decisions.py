@@ -1,0 +1,303 @@
+"""Decision audit trail: ``tft.why(query_id)`` and ``tft.doctor()``.
+
+The flight recorder (:mod:`.flight`) captures every runtime decision
+with the inputs it was made from; this module turns the raw ring into
+answers. :func:`why` reconstructs one query's causal chain — admission
+verdict, preemptions, mid-plan re-plans, mesh shrinks it rode, spills
+it forced, its terminal outcome — each line showing the *inputs* (the
+estimate and the observation, the threshold, the knob) so "why was
+this query shed" reads off directly, with ``TFT_TRACE`` off and the
+query long gone. :func:`doctor` is the process-wide triage report: the
+:func:`~.health.health` snapshot's warnings plus the recent anomalous
+decisions (sheds, giveups, fallbacks, overflow admissions, shrinks)
+grouped by kind.
+
+Which tool when (``docs/observability.md`` has the full table):
+``TFT_TRACE``/``explain()`` for per-block depth on a query you can
+re-run; ``tft.why()`` for the decision chain of a query you cannot;
+``metrics_text()`` for rates and trends; ``tft.health()``/``doctor()``
+for "is the process OK right now".
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+from . import flight as _flight
+from .report import _fmt_bytes
+
+__all__ = ["why", "doctor"]
+
+# kinds that indicate something went sideways: doctor() surfaces these
+ANOMALY_KINDS = (
+    "serve.shed", "serve.reject", "serve.over_quota", "serve.preempt",
+    "serve.admission_preempt", "serve.cancel", "resilience.giveup",
+    "memory.overflow_admit", "memory.wait", "mesh.shrink",
+    "mesh.rebalance", "plan.oom_fallback", "dplan.fallback",
+    "pipeline.sync_fallback", "engine.oom_split", "preempt.park",
+)
+
+
+def _detail(r: Dict[str, Any]) -> str:
+    """One human line per decision kind, leading with the recorded
+    inputs (estimate vs observation, threshold, alternative chosen);
+    unknown kinds fall back to key=value so new record sites render
+    without touching this table."""
+    k = r["kind"]
+    if k == "serve.start":
+        return (f"started after {r.get('queue_wait_s', 0):.3f}s queued "
+                f"(tenant {r.get('tenant')!r}, est "
+                f"{_fmt_bytes(r.get('est_bytes') or 0)}"
+                + (", resumed from checkpoint" if r.get("resumed")
+                   else "") + ")")
+    if k == "serve.admit":
+        head = r.get("headroom")
+        head_s = _fmt_bytes(head) if head is not None else "unlimited"
+        wait = r.get("waited_s") or 0.0
+        return (f"admitted: est {_fmt_bytes(r.get('est_bytes') or 0)} "
+                f"vs headroom {head_s}"
+                + (f" after waiting {wait:.3f}s" if wait else ""))
+    if k == "serve.shed":
+        return (f"SHED: est {_fmt_bytes(r.get('est_bytes') or 0)} "
+                f"exceeds headroom "
+                f"{_fmt_bytes(r.get('headroom') or 0)} and admission "
+                f"could not clear within its "
+                f"{r.get('budget_s')}s budget "
+                f"(TFT_SERVE_ADMISSION_WAIT_S)")
+    if k == "serve.reject":
+        return (f"REJECTED at submit: tenant {r.get('tenant')!r} queue "
+                f"full ({r.get('queued')}/{r.get('max_queue')})")
+    if k == "serve.over_quota":
+        return (f"REJECTED over quota: est {r.get('est_rows')} rows vs "
+                f"{r.get('tokens') or 0:.0f} token(s) left of "
+                f"{r.get('rate') or 0:g} rows/s")
+    if k == "serve.preempt":
+        return (f"asked to park: arriving tenant "
+                f"{r.get('arriving')!r} (weight "
+                f"{r.get('arriving_weight')}) outweighs "
+                f"{r.get('victim_weight')} and all "
+                f"{r.get('workers')} worker(s) were busy "
+                f"(TFT_PREEMPT_AFTER_MS={r.get('after_ms')})")
+    if k == "serve.admission_preempt":
+        return (f"parked whale {r.get('victim')} "
+                f"({_fmt_bytes(r.get('victim_bytes') or 0)}) to clear "
+                f"{_fmt_bytes(r.get('shortfall') or 0)} of admission "
+                f"shortfall instead of shedding")
+    if k == "serve.cancel":
+        return f"cancel requested while {r.get('state', 'live')}"
+    if k == "serve.requeue":
+        return (f"re-queued at its tenant-queue FRONT with "
+                f"{r.get('parked_blocks')} block(s) checkpointed "
+                f"(preemption #{r.get('preemptions')})")
+    if k == "serve.finish":
+        return (f"finished: {r.get('outcome')} after "
+                f"{r.get('latency_s', 0):.3f}s end-to-end")
+    if k == "preempt.park":
+        return (f"parked at block boundary {r.get('blocks')}/"
+                f"{r.get('total')} — "
+                f"{_fmt_bytes(r.get('bytes') or 0)} checkpointed "
+                f"off-device ({r.get('reason') or 'requested'})")
+    if k == "preempt.resume":
+        return (f"resumed: {r.get('blocks')}/{r.get('total')} block(s) "
+                f"restored from checkpoint instead of re-dispatched")
+    if k == "preempt.cancel":
+        return (f"cancelled at a block boundary "
+                f"({r.get('reason') or 'requested'})")
+    if k == "plan.adaptive_layout":
+        return (f"re-bucketed {r.get('blocks')} leaf block(s) into "
+                f"{r.get('units')} unit(s) (coalesced "
+                f"{r.get('coalesced')}, split {r.get('splits')}) "
+                f"targeting {r.get('depth')} full pipeline slot(s)")
+    if k == "plan.replan":
+        return (f"mid-plan RE-PLAN at block {r.get('at_block')}: "
+                f"filter selectivity observed {r.get('observed')} vs "
+                f"priced {r.get('priced')} (deviation past "
+                f"TFT_REPLAN_RATIO={r.get('ratio')}); remaining stages "
+                f"re-ordered")
+    if k == "plan.filter_reorder":
+        return (f"filter run re-ordered by observed selectivity "
+                f"{r.get('selectivities')} -> order {r.get('order')}")
+    if k == "plan.oom_fallback":
+        return (f"fused plan hit an unsplittable OOM ({r.get('error')}); "
+                f"whole forcing re-ran per-op")
+    if k == "dplan.fallback":
+        return (f"fused mesh program failed ({r.get('error')}, "
+                f"kind {r.get('error_kind')}); recorded chain replayed "
+                f"per-op")
+    if k == "plan.result_cache_hit":
+        return (f"result cache HIT: {r.get('blocks')} block(s) / "
+                f"{_fmt_bytes(r.get('bytes') or 0)} served with zero "
+                f"dispatches")
+    if k == "plan.result_cache_admit":
+        return (f"result interned ({_fmt_bytes(r.get('bytes') or 0)}; "
+                f"second sighting of the fingerprint)")
+    if k == "plan.result_cache_evict":
+        return (f"{r.get('entries')} result-cache entr(ies) "
+                f"LRU-evicted under the budget")
+    if k == "mesh.shrink":
+        return (f"device {r.get('device')} LOST during "
+                f"{r.get('op')!r}: mesh shrunk "
+                f"{r.get('devices_before')} -> "
+                f"{r.get('devices_after')} device(s), "
+                f"{r.get('reshard_rows')} row(s) re-sharded through "
+                f"the host")
+    if k == "mesh.grow":
+        return (f"device(s) {r.get('devices')} re-admitted after "
+                f"probe+warm-up: mesh grown {r.get('devices_before')} "
+                f"-> {r.get('devices_after')}")
+    if k == "mesh.rebalance":
+        return (f"persistent skew {r.get('ratio')} (> TFT_SKEW_WARN="
+                f"{r.get('threshold')} for {r.get('streak')} "
+                f"dispatches): rows re-partitioned {r.get('before')} "
+                f"-> {r.get('after')}")
+    if k == "mesh.salt":
+        return (f"{r.get('count')} hot key group(s) (> "
+                f"{r.get('fraction')} of rows, TFT_HOT_KEY_FRACTION) "
+                f"salted across {r.get('slots')} slot(s)")
+    if k == "memory.spill":
+        return (f"spilled {r.get('name')} "
+                f"({_fmt_bytes(r.get('bytes') or 0)}) to pinned host "
+                f"under budget pressure")
+    if k == "memory.fault":
+        return (f"faulted {r.get('name')} "
+                f"({_fmt_bytes(r.get('bytes') or 0)}) back to device")
+    if k == "memory.overflow_admit":
+        return (f"OVERFLOW admission: {_fmt_bytes(r.get('bytes') or 0)} "
+                f"for {r.get('op')} over the "
+                f"{_fmt_bytes(r.get('limit') or 0)} budget "
+                f"({r.get('cause')})")
+    if k == "memory.wait":
+        return (f"admission waited: {_fmt_bytes(r.get('bytes') or 0)} "
+                f"for {r.get('op')} had no headroom")
+    if k == "memory.proactive_split":
+        return (f"block split BEFORE dispatch: est "
+                f"{_fmt_bytes(r.get('bytes') or 0)} would overflow the "
+                f"{_fmt_bytes(r.get('limit') or 0)} budget")
+    if k == "engine.oom_split":
+        return (f"allocator OOM ({r.get('error')}): {r.get('rows')} "
+                f"row(s) re-dispatched as halves")
+    if k == "pipeline.sync_fallback":
+        return (f"async submit failed ({r.get('error')}); block re-ran "
+                f"synchronously through the retry machinery")
+    if k == "resilience.giveup":
+        return (f"GAVE UP on {r.get('op')} after {r.get('attempts')} "
+                f"attempt(s): {r.get('error')} (classified "
+                f"{r.get('error_kind')})")
+    if k == "stream.batch_skip":
+        return (f"batch {r.get('batch')} poisoned ({r.get('error')}, "
+                f"classified {r.get('error_kind')}); skipped")
+    skip = {"seq", "ts", "kind", "query"}
+    kv = " ".join(f"{k2}={v!r}" for k2, v in r.items() if k2 not in skip)
+    return kv or k
+
+
+def why(query_id, scheduler=None) -> str:
+    """Reconstruct the decision chain of one query from the flight
+    ring — with ``TFT_TRACE`` off, after the fact. ``query_id`` is the
+    serving id (``SubmittedQuery.query_id``, e.g. ``"serve-q17"``) or
+    any id the work ran under a :func:`~.flight.scope` for; a
+    ``SubmittedQuery`` object is also accepted. Lines render oldest
+    first with offsets from the first decision."""
+    qid = getattr(query_id, "query_id", query_id)
+    recs = _flight.for_query(str(qid))
+    if not recs:
+        if not _flight.enabled():
+            return (f"(flight recorder disabled — TFT_FLIGHT=0; no "
+                    f"decisions recorded for {qid})")
+        return (f"(no decisions recorded for query {qid!r} — it ran "
+                f"before the flight ring's horizon, under no flight "
+                f"scope, or never ran; the ring holds "
+                f"{_flight.stats()['records']} decision(s))")
+    t0 = recs[0]["ts"]
+    lines = [f"query {qid} · {len(recs)} decision(s) recorded "
+             f"(flight ring; TFT_TRACE-independent)"]
+    for r in recs:
+        lines.append(f"  +{r['ts'] - t0:8.3f}s {r['kind']:<24} "
+                     f"{_detail(r)}")
+    return "\n".join(lines)
+
+
+def doctor(max_per_kind: int = 5) -> str:
+    """Process-wide triage: the :func:`~.health.health` snapshot's
+    vitals and warnings, the SLO burn table, and the recent anomalous
+    decisions from the flight ring grouped by kind (newest
+    ``max_per_kind`` each). The "what should I look at" report for a
+    process you did not watch."""
+    from .health import health as _health
+    snap = _health()
+    lines = ["tft.doctor() · process triage report"]
+    mem = snap["memory"]
+    if mem["limited"]:
+        lines.append(
+            f"  memory   : budget {_fmt_bytes(mem['limit_bytes'])} · "
+            f"headroom {_fmt_bytes(mem['headroom_bytes'] or 0)} · "
+            f"{mem['resident_buffers']} resident / "
+            f"{mem['spilled_buffers']} spilled buffer(s) · "
+            f"{mem['spills']} spill(s), "
+            f"{mem['overflow_admissions']} overflow admission(s)")
+    else:
+        lines.append("  memory   : unlimited (no ledger budget)")
+    mesh = snap["mesh"]
+    lines.append(
+        f"  mesh     : {mesh['visible_devices']} visible device(s) · "
+        f"lost pool {mesh['lost_pool'] or 'empty'} · "
+        f"{mesh['shrinks']} shrink(s) / {mesh['grows']} grow(s) / "
+        f"{mesh['rebalances']} rebalance(s)")
+    serve = snap["serve"]
+    if serve.get("running"):
+        lines.append(
+            f"  serve    : {serve['name']!r} · {serve['queued']} "
+            f"queued / {serve['inflight']} in flight across "
+            f"{len(serve['tenants'])} tenant(s) · {serve['workers']} "
+            f"worker(s), {serve['slots']} slot(s)")
+    else:
+        lines.append("  serve    : no scheduler running")
+    for t, s in snap["slo"].items():
+        if s["total"] == 0:
+            continue
+        lines.append(
+            f"  slo      : tenant {t!r} — {s['objective_ms']:g} ms @ "
+            f"{s['target']:.4g}: compliance "
+            f"{s['compliance']:.4%} · burn {s['burn_rate']:.2f}x · "
+            f"budget left {s['budget_remaining']:.1%}")
+    for name, s in snap["streams"].items():
+        lines.append(
+            f"  stream   : {name!r} — {s['batches']} batch(es), "
+            f"{s['batches_skipped']} skipped, watermark "
+            f"{s['watermark']}, lag {s['batch_lag_s']}")
+    fl = snap["flight"]
+    lines.append(
+        f"  flight   : {'on' if fl['enabled'] else 'OFF'} · "
+        f"{fl['records']}/{fl['capacity']} decision(s) buffered · "
+        f"{fl['dumps']} dump(s)")
+    res = snap["resilience"]
+    lines.append(
+        f"  engine   : {res['retries']} retri(es), {res['giveups']} "
+        f"giveup(s), {res['oom_splits']} oom split(s), "
+        f"{res['sync_fallbacks']} sync fallback(s), "
+        f"{res['plan_oom_fallbacks']}+{res['dplan_fallbacks']} plan "
+        f"fallback(s)")
+    if snap["warnings"]:
+        lines.append("  WARNINGS :")
+        for w in snap["warnings"]:
+            lines.append(f"    ! {w}")
+    else:
+        lines.append("  WARNINGS : none")
+    by_kind: Dict[str, List[Dict[str, Any]]] = {}
+    for r in _flight.recent():
+        if r["kind"] in ANOMALY_KINDS:
+            by_kind.setdefault(r["kind"], []).append(r)
+    if by_kind:
+        lines.append("  recent anomalous decisions (flight ring):")
+        now = time.time()
+        for k in sorted(by_kind):
+            recs = by_kind[k][-max_per_kind:]
+            lines.append(f"    {k} ({len(by_kind[k])} total):")
+            for r in recs:
+                q = f" [{r['query']}]" if r.get("query") else ""
+                lines.append(f"      -{now - r['ts']:7.1f}s{q} "
+                             f"{_detail(r)}")
+    else:
+        lines.append("  recent anomalous decisions: none recorded")
+    return "\n".join(lines)

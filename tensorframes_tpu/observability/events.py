@@ -44,6 +44,7 @@ from typing import (Any, Callable, Dict, Iterator, List, Mapping, Optional,
 
 from ..utils import tracing
 from ..utils.logging import get_logger
+from . import flight as _flight
 
 __all__ = ["Event", "QueryTrace", "query_trace", "current_trace",
            "add_event", "wrap_context", "traced_query", "last_query",
@@ -92,8 +93,6 @@ _last_query: Optional["QueryTrace"] = None
 _ring_lock = threading.Lock()
 _ring: "deque[Dict[str, Any]]" = deque(
     maxlen=_env_int("TFT_TRACE_RING", 8192))
-
-_file_lock = threading.Lock()
 
 
 class Event:
@@ -235,11 +234,13 @@ class QueryTrace:
         head = {"type": "query", "query_id": self.query_id, "op": self.op,
                 "start_time": self.start_time, "duration": self.duration,
                 "dropped": self.dropped, **self.meta}
+        lines = [json.dumps(head, default=str)]
+        lines.extend(json.dumps(d, default=str) for d in dicts)
         try:
-            with _file_lock, open(path, "a") as f:
-                f.write(json.dumps(head, default=str) + "\n")
-                for d in dicts:
-                    f.write(json.dumps(d, default=str) + "\n")
+            # the shared size-capped sink (TFT_TRACE_FILE_MAX_BYTES,
+            # keep-1 rollover to <path>.1) — a long-running serve
+            # process must not grow the trace file without bound
+            _flight.append_jsonl(path, lines)
         except OSError as e:
             _log.warning("TFT_TRACE_FILE=%s write failed: %s", path, e)
 
@@ -480,13 +481,16 @@ def _slow_query_threshold_ms() -> Optional[float]:
 
 def _emit_slow(rec: Dict[str, Any]) -> None:
     """One condensed slow-query JSONL line: to the ``TFT_TRACE_FILE``
-    sink when set, else the logger."""
+    sink when set (size-capped rotation shared with the trace writer),
+    else the logger. A slow query also triggers a flight-recorder dump
+    when ``TFT_FLIGHT_DUMP`` is set — the decisions that made it slow
+    are in the ring right now."""
     line = json.dumps(rec, default=str)
+    _flight.maybe_dump("slow_query")
     path = os.environ.get("TFT_TRACE_FILE")
     if path:
         try:
-            with _file_lock, open(path, "a") as f:
-                f.write(line + "\n")
+            _flight.append_jsonl(path, [line])
             return
         except OSError as e:
             _log.warning("TFT_TRACE_FILE=%s write failed: %s", path, e)

@@ -1,0 +1,293 @@
+"""Always-on flight recorder: a bounded ring of runtime *decisions*.
+
+The engine makes autonomous calls on every query — admission sheds or
+parks a whale, the adaptive layer re-orders filters and re-plans
+mid-run, the result cache admits and evicts, elastic meshes shrink and
+grow, the ledger spills and overflow-admits — and until now each call
+was visible only as a bare counter, with causal detail existing only
+when ``TFT_TRACE`` was set *before* the query ran. A production server
+needs the post-mortem answer to "why was this query slow / shed /
+re-planned / run on 3 devices" *after the fact*, without reproducing.
+
+This module is that black box:
+
+- :func:`record` — the one hook every subsystem calls at a DECISION
+  (never per-block): appends one structured dict (seq, wall-clock ts,
+  kind, correlated query id, and the decision's *inputs* — estimate vs
+  observation, threshold, knob value, chosen alternative) to a bounded
+  lock-cheap ring (``TFT_FLIGHT_RING``, default 4096; overflow drops
+  oldest).
+- :func:`scope` — an always-on contextvar carrying the query id, so
+  decisions made deep inside a forcing (a mesh shrink, a mid-plan
+  re-plan) correlate to the serving query that rode them — with
+  ``TFT_TRACE`` off. The serve scheduler scopes every execution; the
+  contextvar survives the pipeline's worker threads through the same
+  ``wrap_context`` copy the trace id uses.
+- :func:`dump` / :func:`maybe_dump` — JSONL snapshots of the ring,
+  auto-triggered on slow queries, classified giveups, and device losses
+  and at process exit when ``TFT_FLIGHT_DUMP=<path>`` is set; writes
+  share the trace-file sink's size-capped keep-1 rotation
+  (``TFT_TRACE_FILE_MAX_BYTES``, :func:`append_jsonl`).
+
+``tft.why(query_id)`` (:mod:`.decisions`) reconstructs a query's causal
+chain from this ring; ``tft.health()`` (:mod:`.health`) reports its
+liveness. ``TFT_FLIGHT=0`` bypasses the recorder bit-identically —
+every hook returns at one env check, nothing is recorded or dumped.
+The recorder is bench-enforced ≤2% on the serve mixed workload
+(``bench.py flight_recorder_overhead``) — which it meets by recording
+decisions, not blocks: the hot per-block paths never touch this module.
+"""
+
+from __future__ import annotations
+
+import atexit
+import contextlib
+import contextvars
+import itertools
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, Iterator, List, Optional
+
+from ..utils.logging import get_logger
+
+__all__ = ["enabled", "record", "scope", "current_query", "recent",
+           "for_query", "dump", "maybe_dump", "clear", "append_jsonl",
+           "stats"]
+
+_log = get_logger("observability.flight")
+
+
+def _env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        _log.warning("ignoring malformed %s=%r", name, raw)
+        return default
+
+
+def enabled() -> bool:
+    """``TFT_FLIGHT`` gate (default ON — the recorder exists for the
+    queries nobody knew to trace). ``TFT_FLIGHT=0`` bypasses every hook
+    at this one check, bit-identically."""
+    return os.environ.get("TFT_FLIGHT", "") not in ("0", "false")
+
+
+_seq = itertools.count(1)
+_ring_lock = threading.Lock()
+_ring: "deque[Dict[str, Any]]" = deque(
+    maxlen=_env_int("TFT_FLIGHT_RING", 4096))
+_recorded = 0  # lifetime total (the ring drops, this does not)
+_dumps = 0
+
+# the always-on query correlation id (serve query ids, or whatever the
+# caller scopes); independent of the TFT_TRACE query trace so decisions
+# correlate even for queries that were never traced
+_query: "contextvars.ContextVar[Optional[str]]" = \
+    contextvars.ContextVar("tft_flight_query", default=None)
+
+
+def current_query() -> Optional[str]:
+    """The ambient flight-correlation query id, or None."""
+    return _query.get()
+
+
+@contextlib.contextmanager
+def scope(query_id: str) -> Iterator[None]:
+    """Correlate every decision recorded inside the body to
+    ``query_id`` (nested scopes shadow; the serve scheduler scopes each
+    query's execution with its serving id)."""
+    token = _query.set(str(query_id))
+    try:
+        yield
+    finally:
+        _query.reset(token)
+
+
+def record(kind: str, query: Optional[str] = None, **inputs) -> None:
+    """Record one decision. ``kind`` names it (``serve.shed``,
+    ``plan.replan``, ``mesh.shrink``, ...); ``inputs`` carry what the
+    decision SAW — the estimate and the observation, the threshold it
+    compared against, the knob value, the alternative chosen — so the
+    audit trail can reconstruct *why*, not just *that*. ``query``
+    defaults to the ambient :func:`scope` id. Call this at decisions
+    only, never from per-block hot paths."""
+    if not enabled():
+        return
+    rec: Dict[str, Any] = {"ts": time.time(), "kind": kind}
+    q = query if query is not None else _query.get()
+    if q is not None:
+        rec["query"] = q
+    if inputs:
+        rec.update(inputs)
+    global _recorded
+    # seq drawn under the ring lock so ring/dump order and seq order
+    # always agree (a post-mortem consumer sorts dump lines by seq)
+    with _ring_lock:
+        rec["seq"] = next(_seq)
+        _ring.append(rec)
+        _recorded += 1
+
+
+def recent(kind: Optional[str] = None,
+           limit: Optional[int] = None) -> List[Dict[str, Any]]:
+    """Ring snapshot, oldest first; ``kind`` filters (prefix match with
+    a trailing ``.`` treated as a namespace, e.g. ``"mesh"``),
+    ``limit`` keeps the newest N after filtering."""
+    with _ring_lock:
+        out = list(_ring)
+    if kind is not None:
+        out = [r for r in out
+               if r["kind"] == kind or r["kind"].startswith(kind + ".")]
+    if limit is not None and len(out) > limit:
+        out = out[-limit:]
+    return out
+
+
+def for_query(query_id: str) -> List[Dict[str, Any]]:
+    """Every recorded decision correlated to ``query_id``, oldest
+    first (the ``tft.why()`` source)."""
+    qid = str(query_id)
+    with _ring_lock:
+        return [r for r in _ring if r.get("query") == qid]
+
+
+def stats() -> Dict[str, Any]:
+    with _ring_lock:
+        return {"enabled": enabled(), "records": len(_ring),
+                "capacity": _ring.maxlen, "recorded_total": _recorded,
+                "dumps": _dumps}
+
+
+def clear() -> None:
+    """Drop the ring and re-read ``TFT_FLIGHT_RING`` (tests flip it)."""
+    global _ring
+    with _ring_lock:
+        _ring = deque(maxlen=_env_int("TFT_FLIGHT_RING", 4096))
+
+
+# ---------------------------------------------------------------------------
+# JSONL sink with size-capped keep-1 rotation
+# ---------------------------------------------------------------------------
+
+_file_lock = threading.Lock()
+
+
+def _max_sink_bytes() -> int:
+    """``TFT_TRACE_FILE_MAX_BYTES``: the shared JSONL-sink size cap (0 /
+    unset = unbounded). One knob for the trace file AND flight dumps —
+    a long-running serve process must not grow either without bound."""
+    return max(_env_int("TFT_TRACE_FILE_MAX_BYTES", 0), 0)
+
+
+def append_jsonl(path: str, lines: List[str]) -> None:
+    """Append pre-serialized JSONL ``lines`` to ``path`` under the
+    shared sink lock, rotating first when the write would push the file
+    past ``TFT_TRACE_FILE_MAX_BYTES``: the current file moves to
+    ``<path>.1`` (keep-1 rollover, replacing any previous ``.1``) and a
+    fresh file starts. A single write larger than the cap still lands
+    (capping it would truncate mid-record); it rotates out on the next
+    write. Raises ``OSError`` like a plain append — callers keep their
+    own degrade-to-log handling."""
+    text = "\n".join(lines) + "\n"
+    cap = _max_sink_bytes()
+    with _file_lock:
+        if cap:
+            try:
+                size = os.path.getsize(path)
+            except OSError:
+                size = 0
+            if size and size + len(text.encode()) > cap:
+                os.replace(path, path + ".1")
+        with open(path, "a") as f:
+            f.write(text)
+
+
+# ---------------------------------------------------------------------------
+# dumps
+# ---------------------------------------------------------------------------
+
+def dump(path: Optional[str] = None,
+         reason: str = "manual") -> Optional[str]:
+    """Write the ring as one JSONL snapshot — a ``flight_dump`` header
+    line (reason, timestamp, record count) followed by one line per
+    decision — to ``path`` (default ``TFT_FLIGHT_DUMP``). Returns the
+    path written, or None (no path configured / recorder bypassed).
+    A failed write degrades to a warning log, never raises into the
+    query that triggered it."""
+    if not enabled():
+        return None
+    path = path or os.environ.get("TFT_FLIGHT_DUMP")
+    if not path:
+        return None
+    with _ring_lock:
+        records = list(_ring)
+    head = {"type": "flight_dump", "reason": reason, "ts": time.time(),
+            "records": len(records)}
+    lines = [json.dumps(head, default=str)]
+    lines.extend(json.dumps(r, default=str) for r in records)
+    try:
+        append_jsonl(path, lines)
+    except OSError as e:
+        _log.warning("TFT_FLIGHT_DUMP=%s write failed: %s", path, e)
+        return None
+    global _dumps
+    with _ring_lock:
+        _dumps += 1
+    _log.info("flight recorder dumped %d decision(s) to %s (%s)",
+              len(records), path, reason)
+    return path
+
+
+def maybe_dump(reason: str) -> Optional[str]:
+    """Auto-dump hook for the trigger sites (slow query, classified
+    giveup, device loss, process exit): dumps only when
+    ``TFT_FLIGHT_DUMP`` is set, so the triggers cost one env read when
+    it is not."""
+    if not os.environ.get("TFT_FLIGHT_DUMP"):
+        return None
+    return dump(reason=reason)
+
+
+@atexit.register
+def _dump_at_exit() -> None:
+    # the crash-adjacent case the recorder exists for: whatever was in
+    # the ring when the process died is the last evidence
+    try:
+        if _ring:
+            maybe_dump("exit")
+    except Exception as e:  # noqa: BLE001 - interpreter is shutting down
+        _log.debug("exit flight dump failed: %s", e)
+
+
+# ---------------------------------------------------------------------------
+# metrics
+# ---------------------------------------------------------------------------
+
+def _render_metrics() -> List[str]:
+    s = stats()
+    return [
+        "# HELP tft_flight_records_total Decisions recorded by the "
+        "flight recorder (lifetime; the ring holds the newest).",
+        "# TYPE tft_flight_records_total counter",
+        f"tft_flight_records_total {s['recorded_total']}",
+        "# HELP tft_flight_ring_records Decisions currently held in "
+        "the bounded flight ring.",
+        "# TYPE tft_flight_ring_records gauge",
+        f"tft_flight_ring_records {s['records']}",
+        "# HELP tft_flight_dumps_total JSONL flight snapshots written "
+        "(slow query / giveup / device loss / exit / manual).",
+        "# TYPE tft_flight_dumps_total counter",
+        f"tft_flight_dumps_total {s['dumps']}",
+    ]
+
+
+def _register_metrics() -> None:
+    # deferred: metrics imports events which imports this module
+    from .metrics import register_metrics_provider
+    register_metrics_provider("flight", _render_metrics)

@@ -1,0 +1,257 @@
+"""``tft.health()``: one machine-readable snapshot across every
+subsystem.
+
+Each subsystem already answers its own "how am I doing" — the memory
+ledger's :meth:`~..memory.manager.MemoryManager.snapshot`, the
+scheduler's per-tenant queue/in-flight state, the elastic layer's lost
+pool, per-stream watermarks, the cache hit counters — but an operator
+(or a readiness probe) wants ONE call that sees across them. This
+module is the first layer with that cross-cutting view; it aggregates,
+it never measures: every number here is read from state the subsystems
+maintain anyway, so ``health()`` is safe to poll.
+
+The snapshot's top-level ``warnings`` list is the triage summary (the
+same heuristics ``tft.doctor()`` narrates): overflow admissions mean
+the ledger is being overrun, a non-empty lost pool means shrunken
+meshes are waiting on re-admission, a burn rate over 1.0 means an SLO
+budget is being spent too fast, deep queues mean admission or capacity
+trouble.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List
+
+from ..utils import tracing
+from ..utils.logging import get_logger
+from . import flight as _flight
+from . import slo as _slo
+
+__all__ = ["health"]
+
+_log = get_logger("observability.health")
+
+
+def _memory_section(counts: Dict[str, int]) -> Dict[str, Any]:
+    out: Dict[str, Any] = {
+        "limited": False, "limit_bytes": 0, "headroom_bytes": None,
+        "inflight_bytes": 0, "resident_bytes": 0, "resident_buffers": 0,
+        "spilled_bytes": 0, "spilled_buffers": 0,
+    }
+    try:
+        from .. import memory as _memory
+        mgr = _memory.active()
+    except Exception as e:  # noqa: BLE001 - health must render regardless
+        _log.debug("health: memory manager unavailable: %s", e)
+        mgr = None
+    if mgr is not None:
+        out.update(mgr.snapshot())
+        out["limited"] = mgr.limited
+        out["headroom_bytes"] = mgr.headroom()
+    out["spills"] = counts.get("memory.spills", 0)
+    out["spill_bytes_total"] = counts.get("memory.spill_bytes", 0)
+    out["faults"] = counts.get("memory.faults", 0)
+    out["overflow_admissions"] = counts.get("memory.overflow_admissions",
+                                            0)
+    out["proactive_splits"] = counts.get("memory.proactive_splits", 0)
+    return out
+
+
+def _backend_initialized() -> bool:
+    """Whether a JAX backend already exists — WITHOUT creating one.
+    ``health()`` is documented safe-to-poll; ``jax.devices()`` on a
+    fresh process would block on (and claim) the TPU runtime as a side
+    effect of a health check."""
+    try:
+        from jax._src import xla_bridge as _xb
+    except Exception:  # noqa: BLE001 - private module moved
+        try:
+            from jax.lib import xla_bridge as _xb
+        except Exception:
+            return False
+    return bool(getattr(_xb, "_backends", None))
+
+
+def _mesh_section(counts: Dict[str, int]) -> Dict[str, Any]:
+    visible = None
+    try:
+        if _backend_initialized():
+            import jax
+            visible = len(jax.devices())
+        # else: None — "not initialized yet", not "no devices"
+    except Exception as e:  # noqa: BLE001 - backend may not be up yet
+        _log.debug("health: device enumeration failed: %s", e)
+    lost: List[int] = []
+    try:
+        from ..parallel import elastic as _elastic
+        lost = _elastic.lost_pool()
+    except Exception as e:  # noqa: BLE001 - optional subsystem
+        _log.debug("health: elastic lost pool unavailable: %s", e)
+    return {
+        "visible_devices": visible,
+        "lost_pool": lost,
+        "devices_lost": counts.get("mesh.devices_lost", 0),
+        "shrinks": counts.get("mesh.shrinks", 0),
+        "grows": counts.get("mesh.grows", 0),
+        "rebalances": counts.get("mesh.rebalances", 0),
+        "dispatches": counts.get("mesh.dispatches", 0),
+    }
+
+
+def _serve_section() -> Dict[str, Any]:
+    try:
+        from ..serve.scheduler import live_scheduler
+        sched = live_scheduler()
+    except Exception as e:  # noqa: BLE001 - optional subsystem
+        _log.debug("health: serve layer unavailable: %s", e)
+        sched = None
+    if sched is None:
+        return {"running": False}
+    snap = sched.snapshot()
+    return {
+        "running": True,
+        "name": sched.name,
+        "workers": sched.workers,
+        "slots": sched.slot_pool.slots,
+        "queued": sum(s["queued"] for s in snap.values()),
+        "inflight": sum(s["inflight"] for s in snap.values()),
+        "tenants": {t: {"queued": s["queued"],
+                        "inflight": s["inflight"],
+                        "completed": s["completed"],
+                        "failed": s["failed"],
+                        "shed": s["shed"],
+                        "rejected": s["rejected"]}
+                    for t, s in snap.items()},
+    }
+
+
+def _cache_section(counts: Dict[str, int]) -> Dict[str, Any]:
+    def ratio(hits: int, misses: int):
+        total = hits + misses
+        return (hits / total) if total else None
+
+    compile_cache = None
+    try:
+        from ..serve.scheduler import live_scheduler
+        sched = live_scheduler()
+        if sched is not None and sched.compile_cache is not None:
+            st = sched.compile_cache.stats()
+            compile_cache = {**st,
+                             "hit_ratio": ratio(st["hits"], st["misses"])}
+    except Exception as e:  # noqa: BLE001 - optional subsystem
+        _log.debug("health: compile cache unavailable: %s", e)
+    result = {"entries": 0, "bytes": 0}
+    try:
+        from ..plan.adaptive import result_cache_stats
+        result = result_cache_stats()
+    except Exception as e:  # noqa: BLE001 - optional subsystem
+        _log.debug("health: result cache unavailable: %s", e)
+    rc_hits = counts.get("plan.result_cache_hits", 0)
+    rc_misses = counts.get("plan.result_cache_misses", 0)
+    return {
+        "compile": compile_cache,
+        "result": {**result, "hits": rc_hits, "misses": rc_misses,
+                   "hit_ratio": ratio(rc_hits, rc_misses)},
+        "engine_compile_hits": counts.get("compile_cache.hits", 0),
+        "engine_compile_misses": counts.get("compile_cache.misses", 0),
+    }
+
+
+def _stream_section() -> Dict[str, Any]:
+    handles = []
+    try:
+        from ..stream.runtime import live_handles
+        handles = live_handles()
+    except Exception as e:  # noqa: BLE001 - optional subsystem
+        _log.debug("health: stream handles unavailable: %s", e)
+    out: Dict[str, Any] = {}
+    for h in handles:
+        try:
+            m = h.metrics()
+        except Exception as e:  # noqa: BLE001 - a dying handle is not news
+            _log.debug("health: stream %s metrics failed: %s",
+                       getattr(h, "name", "?"), e)
+            continue
+        out[h.name] = {
+            "batches": m["batches"],
+            "batches_skipped": m["batches_skipped"],
+            "watermark": m["watermark"],
+            "batch_lag_s": m["batch_lag_s"],
+            "state_rows": m["state_rows"],
+            "state_bytes": m["state_bytes"],
+            "late_rows": m["late_rows"],
+            "done": h.done(),
+        }
+    return out
+
+
+def _warnings(snap: Dict[str, Any]) -> List[str]:
+    warns: List[str] = []
+    mem = snap["memory"]
+    if mem["overflow_admissions"]:
+        warns.append(
+            f"memory: {mem['overflow_admissions']} overflow "
+            f"admission(s) — dispatches ran OVER the device budget; "
+            f"shrink blocks or raise TFT_MEM_LIMIT_BYTES")
+    mesh = snap["mesh"]
+    if mesh["lost_pool"]:
+        warns.append(
+            f"mesh: device(s) {mesh['lost_pool']} lost and not "
+            f"re-admitted — meshes are running shrunken "
+            f"(parallel.elastic.admit_devices)")
+    serve = snap["serve"]
+    if serve.get("running"):
+        for t, s in serve["tenants"].items():
+            if s["shed"] or s["rejected"]:
+                warns.append(
+                    f"serve: tenant {t!r} had {s['shed']} shed / "
+                    f"{s['rejected']} rejected quer(ies) — admission "
+                    f"or queue pressure")
+    for t, s in snap["slo"].items():
+        burn = s.get("burn_rate")
+        if burn is not None and burn > 1.0:
+            warns.append(
+                f"slo: tenant {t!r} burning its error budget at "
+                f"{burn:.1f}x the sustainable rate "
+                f"({s['objective_ms']:g} ms @ {s['target']:.4g})")
+    for name, s in snap["streams"].items():
+        if s["batches_skipped"]:
+            warns.append(
+                f"stream: {name!r} skipped {s['batches_skipped']} "
+                f"poisoned batch(es)")
+    return warns
+
+
+def health() -> Dict[str, Any]:
+    """One cross-subsystem snapshot: ledger headroom and spill
+    pressure, mesh population and the lost-device pool, serve queue
+    depths and in-flight, compile/result cache hit ratios, per-stream
+    watermark lag and state size, SLO burn, and the flight recorder's
+    own liveness — plus a ``warnings`` triage list. Always-on and
+    read-only; see the module docstring."""
+    counts = tracing.counters.snapshot()
+    snap: Dict[str, Any] = {
+        "ts": time.time(),
+        "memory": _memory_section(counts),
+        "mesh": _mesh_section(counts),
+        "serve": _serve_section(),
+        "caches": _cache_section(counts),
+        "streams": _stream_section(),
+        "slo": _slo.slo_status(),
+        "flight": _flight.stats(),
+        "resilience": {
+            "giveups": sum(v for k, v in counts.items()
+                           if k.startswith("retry.")
+                           and k.endswith(".giveups")),
+            "retries": sum(v for k, v in counts.items()
+                           if k.startswith("retry.")
+                           and k.endswith(".retries")),
+            "sync_fallbacks": counts.get("pipeline.sync_fallbacks", 0),
+            "oom_splits": counts.get("oom_split.dispatches", 0),
+            "plan_oom_fallbacks": counts.get("plan.oom_fallbacks", 0),
+            "dplan_fallbacks": counts.get("dplan.fallbacks", 0),
+        },
+    }
+    snap["warnings"] = _warnings(snap)
+    return snap

@@ -43,7 +43,8 @@ from ..utils.logging import get_logger
 from . import events as _events
 
 __all__ = ["metrics_text", "serve_metrics", "stop_metrics", "metrics_port",
-           "register_metrics_provider", "unregister_metrics_provider"]
+           "register_metrics_provider", "unregister_metrics_provider",
+           "registered_providers"]
 
 _log = get_logger("observability.metrics")
 
@@ -68,6 +69,15 @@ def register_metrics_provider(name: str, fn) -> None:
 def unregister_metrics_provider(name: str) -> None:
     with _providers_lock:
         _providers.pop(name, None)
+
+
+def registered_providers() -> list:
+    """Names of every registered provider (the metrics-conformance test
+    sweeps them all: one ``# TYPE`` per family, escaped label values,
+    no duplicate series — the contract every current and future
+    provider must meet)."""
+    with _providers_lock:
+        return sorted(_providers)
 
 
 def _escape_label(value: str) -> str:

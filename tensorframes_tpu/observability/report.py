@@ -44,10 +44,15 @@ def _skew_threshold() -> float:
         return DEFAULT_SKEW_WARN
 
 
-def _fmt_bytes(n: int) -> str:
-    v = float(n)
+def _fmt_bytes(n) -> str:
+    # tolerant of None/strings: the decision-audit renderer feeds it
+    # whatever a flight record carried
+    try:
+        v = float(n)
+    except (TypeError, ValueError):
+        return str(n)
     for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
-        if v < 1024.0 or unit == "TiB":
+        if abs(v) < 1024.0 or unit == "TiB":
             return f"{v:.1f} {unit}" if unit != "B" else f"{int(v)} B"
         v /= 1024.0
     return f"{int(n)} B"
@@ -145,6 +150,15 @@ def render(trace: "_events.QueryTrace") -> str:
                 f"{a.get('ops')} op(s) in ONE GSPMD program, "
                 f"{a.get('filters', 0)} in-program filter(s){res} "
                 f"(docs/plan.md)")
+            if a.get("wall_s") is not None:
+                # the per-stage shard-time record the fused dispatch
+                # feeds into the adaptive feedback registry — surfaced
+                # here and in DistributedFrame.explain()
+                lines.append(
+                    f"    stage shard time: {_fmt_secs(a['wall_s'])} "
+                    f"across {a.get('shards')} shard(s) "
+                    f"(~{_fmt_secs(a['wall_s'] / max(a.get('shards') or 1, 1))}"
+                    f"/shard amortized)")
     if s["mesh_shrinks"]:
         for ev in list(trace.events):
             if ev.etype == "mesh_shrink":

@@ -91,6 +91,7 @@ import numpy as np
 from jax.sharding import Mesh
 
 from ..observability import events as _obs
+from ..observability import flight as _flight
 from ..observability import metrics as _metrics
 from ..resilience import faults as _faults
 from ..resilience.classify import is_device_lost
@@ -328,6 +329,14 @@ def _recover(exc: BaseException, dist, op: str):
                        devices_before=mesh.num_devices,
                        devices_after=new_mesh.num_devices,
                        reshard_rows=moved)
+        _flight.record("mesh.shrink", op=op, device=int(d),
+                       devices_before=mesh.num_devices,
+                       devices_after=new_mesh.num_devices,
+                       reshard_rows=moved)
+    # a device loss is one of the flight recorder's auto-dump triggers
+    # (docs/observability.md): the ring right now holds the decisions
+    # that led here
+    _flight.maybe_dump("device_lost")
     _log.warning(
         "%s: device loss (%s); lost device(s) %s — mesh shrunk "
         "%d -> %d shards, %d row(s) re-sharded through the host; "
@@ -356,6 +365,14 @@ _upgrades: Dict[int, Tuple["weakref.ref", DeviceMesh]] = {}
 # recovered-chip case), so growth never grabs another live mesh's
 # healthy devices while genuinely lost ones exist
 _lost_pool: set = set()
+
+
+def lost_pool() -> List[int]:
+    """Flat ids of devices dropped by elastic shrinks and not yet
+    re-admitted (``tft.health()``'s mesh section reads this): non-empty
+    means meshes are running shrunken and ``admit_devices`` has
+    recovery candidates waiting."""
+    return sorted(_lost_pool)
 
 
 def _forget_upgrades_containing(device_ids: set) -> None:
@@ -540,6 +557,10 @@ def admit_devices(target, devices: Optional[Sequence] = None,
                    devices=[int(getattr(d, "id", -1)) for d in admitted],
                    devices_before=mesh.num_devices,
                    devices_after=new_mesh.num_devices)
+    _flight.record("mesh.grow",
+                   devices=[int(getattr(d, "id", -1)) for d in admitted],
+                   devices_before=mesh.num_devices,
+                   devices_after=new_mesh.num_devices)
     _log.info("mesh grown %d -> %d device(s): admitted %s (probe + "
               "warm-up passed); frames on the old mesh migrate at "
               "their next dispatch", mesh.num_devices,
@@ -684,6 +705,11 @@ def _maybe_rebalance(op: str, dist):
     _obs.add_event("rebalance", name=op, ratio=round(ratio, 3),
                    before=[int(v) for v in before],
                    after=[int(v) for v in after])
+    from ..observability.report import _skew_threshold
+    _flight.record("mesh.rebalance", op=op, ratio=round(ratio, 3),
+                   threshold=_skew_threshold(), streak=n,
+                   before=[int(v) for v in before],
+                   after=[int(v) for v in after])
     new_dist._rebalance = {"op": op, "ratio": ratio,
                            "before": [int(v) for v in before],
                            "after": [int(v) for v in after]}
@@ -749,6 +775,8 @@ def plan_key_salt(dist, ids_dev, num_groups: int, n_shards: int
     counters.inc("mesh.salted_keys", int(hot.size))
     _obs.add_event("key_salt", name="daggregate", count=int(hot.size),
                    salt=K, groups=[int(g) for g in hot[:16]])
+    _flight.record("mesh.salt", count=int(hot.size), fraction=frac,
+                   slots=K, rows=n, groups=[int(g) for g in hot[:16]])
     _log.info("daggregate: %d hot key group(s) (> %.0f%% of %d rows) "
               "salted across %d slots", hot.size, frac * 100, n, K)
     # 4th element: each hot group's observed row fraction — the

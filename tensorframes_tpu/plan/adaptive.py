@@ -522,9 +522,12 @@ def cached_result(frame) -> Optional[List]:
         _results.move_to_end(key)
     counters.inc("plan.result_cache_hits")
     counters.inc("plan.result_cache_hit_bytes", entry.nbytes)
+    from ..observability import flight as _flight
     from ..observability.events import add_event
     add_event("result_cache_hit", name=frame._plan, bytes=entry.nbytes,
               blocks=len(entry._cache))
+    _flight.record("plan.result_cache_hit", bytes=entry.nbytes,
+                   blocks=len(entry._cache))
     _log.debug("result cache hit for %s (%d block(s), %d B)",
                frame._plan, len(entry._cache), entry.nbytes)
     return list(entry._cache)
@@ -569,6 +572,12 @@ def offer_result(frame, blocks) -> None:
             counters.inc("plan.result_cache_evictions", len(evicted))
         gauge("plan.result_cache_bytes", total)
         gauge("plan.result_cache_entries", len(_results))
+    from ..observability import flight as _flight
+    _flight.record("plan.result_cache_admit", bytes=int(nbytes),
+                   entries=len(blocks))
+    if evicted:
+        _flight.record("plan.result_cache_evict", entries=len(evicted),
+                       bytes=sum(e.nbytes for e in evicted))
 
 
 def invalidate_results() -> None:

@@ -62,10 +62,12 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
+from ..observability import flight as _flight
 from ..observability.events import add_event, current_trace, traced_query
 from ..utils.compat import shard_map
 from ..utils.logging import get_logger
 from ..utils.tracing import counters, span
+from .adaptive import record_stream_feedback, stream_feedback
 from .nodes import _cell_bytes, observed_selectivity, record_selectivity
 from .optimize import _mask_shaped, _row_preserving
 from .optimize import enabled as fuse_enabled
@@ -771,6 +773,42 @@ def _register_result(cols: Dict, mesh_tag: str):
     return cols
 
 
+def _feedback_key(plan: _DPlan) -> str:
+    """The fused stage's identity in the adaptive feedback registry
+    (``docs/adaptive.md``): one record per plan shape, accumulated
+    across forcings."""
+    return (f"dplan[{','.join(o.kind for o in plan.ops)}]"
+            f"({plan.final_schema.names})")
+
+
+def _feedback_lines(plan: _DPlan) -> List[str]:
+    """The per-stage shard-time line ``explain()`` renders from the
+    feedback registry — the recorded-but-previously-unread half of the
+    ROADMAP item 2 follow-on, surfaced so the data is visible before a
+    future adaptive pass acts on it."""
+    fb = stream_feedback(_feedback_key(plan))
+    if fb is None or not fb.forcings:
+        return []
+    shards = max(fb.blocks // max(fb.forcings, 1), 1)
+    return [f"    feedback: {fb.forcings} fused forcing(s) · "
+            f"{shards} shard(s)/stage · mean stage wall "
+            f"{fb.wall_s / fb.forcings * 1e3:.2f} ms · "
+            f"{fb.rows} row(s) total (feedback registry; unused for "
+            f"sizing today)"]
+
+
+def _record_fallback(e: BaseException) -> None:
+    """Always-on bookkeeping of a fused-chain fallback to the per-op
+    path: the counter pair plus the flight-recorder decision (with the
+    classified kind — fallbacks are rare enough to classify)."""
+    from ..resilience import error_kind, is_oom
+    counters.inc("dplan.fallbacks")
+    if is_oom(e):
+        counters.inc("dplan.oom_fallbacks")
+    _flight.record("dplan.fallback", error=type(e).__name__,
+                   error_kind=error_kind(e))
+
+
 def _dispatch(plan: _DPlan, d, want_keeps: bool,
               agg_groups: Optional[int] = None, ids_dev=None):
     """One fused mesh dispatch over ``d`` through the resilient policy
@@ -800,14 +838,18 @@ def _dispatch(plan: _DPlan, d, want_keeps: bool,
     trace = current_trace()
     t0 = (D._trace_shards(trace, "dfused", dist=d)
           if trace is not None else 0.0)
+    import time as _time
+    w0 = _time.perf_counter()
     outs = policy.call(_go, op="dfused.dispatch")
+    wall = _time.perf_counter() - w0
     counters.inc("mesh.dispatches")
     if trace is not None:
         add_event("fused_stage", name="+".join(plan.labels) or "pass",
                   ops=plan.n_ops, filters=plan.n_filters,
-                  resident=len(plan.passthrough))
+                  resident=len(plan.passthrough), wall_s=wall,
+                  shards=mesh.num_data_shards)
         D._trace_mesh_done(trace, list(outs), t0, "dfused", mesh=mesh)
-    return outs
+    return outs, wall
 
 
 def _permute_host(a: np.ndarray, keep: np.ndarray, S: int) -> np.ndarray:
@@ -846,7 +888,7 @@ def _exec_frame(plan: _DPlan, d):
     D = _dist()
     S = d.mesh.num_data_shards
     want_keeps = plan.has_filter and bool(plan.host_names)
-    outs = _dispatch(plan, d, want_keeps)
+    outs, wall = _dispatch(plan, d, want_keeps)
     cols: Dict[str, object] = {}
     # resident passthrough: untouched source columns chain buffer-to-
     # buffer (matching shardings — no repartition, no program I/O);
@@ -889,15 +931,13 @@ def _exec_frame(plan: _DPlan, d):
         # spill of either wrapper free nothing.
         cols = _register_result(cols, f"dfused@{id(plan):x}")
     # adaptive feedback (docs/adaptive.md): fused mesh stages record
-    # their observed shard-stream shape like host plans do — unused for
-    # sizing today (mesh shards are fixed by the mesh, not the layout
-    # pass), but the record is what a future distributed block-sizing
-    # pass will gate on, and it keeps the feedback registry one surface
-    from .adaptive import record_stream_feedback
-    record_stream_feedback(
-        f"dplan[{','.join(o.kind for o in plan.ops)}]"
-        f"({plan.final_schema.names})",
-        blocks=S, rows=num_rows, wall_s=0.0)
+    # their observed shard-stream shape AND the measured stage wall —
+    # unused for sizing today (mesh shards are fixed by the mesh, not
+    # the layout pass), but surfaced as the per-stage shard-time line
+    # in DistributedFrame.explain()/last_query_report() so the record
+    # is visible before a future PR acts on it (ROADMAP 2 follow-on)
+    record_stream_feedback(_feedback_key(plan), blocks=S,
+                           rows=num_rows, wall_s=wall)
     return D.DistributedFrame(d.mesh, plan.final_schema, cols, num_rows,
                               shard_valid=shard_valid)
 
@@ -949,12 +989,10 @@ def _force_chain(lazy: LazyDistributedFrame):
     try:
         result = _run_fused_frame(plan, source)
     except Exception as e:  # noqa: BLE001 - reclassified below
-        from ..resilience import is_device_lost, is_oom
+        from ..resilience import is_device_lost
         if is_device_lost(e):
             raise  # elastic recovery exhausted: per-op parity is to raise
-        counters.inc("dplan.fallbacks")
-        if is_oom(e):
-            counters.inc("dplan.oom_fallbacks")
+        _record_fallback(e)
         _log.warning(
             "fused mesh program failed (%s: %s); re-running the recorded "
             "chain through the per-op d-op dispatches", type(e).__name__,
@@ -965,7 +1003,8 @@ def _force_chain(lazy: LazyDistributedFrame):
         result._dplan_info = lazy._dplan_info
         return result
     counters.inc("dplan.fused_forcings")
-    lazy._dplan_info = plan.describe(executed="executed")
+    lazy._dplan_info = plan.describe(executed="executed") \
+        + _feedback_lines(plan)
     # explain() on the FORCED frame renders the same plan section
     result._dplan_info = lazy._dplan_info
     return result
@@ -1006,19 +1045,18 @@ def record_reduce(fetches, lazy: LazyDistributedFrame
     except _EmptyReduceError:
         raise  # the empty-after-filter contract (per-op parity)
     except Exception as e:  # noqa: BLE001 - reclassified below
-        from ..resilience import is_device_lost, is_oom
+        from ..resilience import is_device_lost
         if is_device_lost(e):
             raise
-        counters.inc("dplan.fallbacks")
-        if is_oom(e):
-            counters.inc("dplan.oom_fallbacks")
+        _record_fallback(e)
         _log.warning(
             "fused mesh reduce failed (%s: %s); re-running per-op",
             type(e).__name__, e)
         D = _dist()
         return D.dreduce_blocks(fetches, _replay_per_op(source, ops))
     counters.inc("dplan.fused_forcings")
-    lazy._dplan_info = plan.describe(executed="executed")
+    lazy._dplan_info = plan.describe(executed="executed") \
+        + _feedback_lines(plan)
     return result
 
 
@@ -1034,7 +1072,10 @@ def _exec_reduce(plan: _DPlan, d) -> Dict[str, np.ndarray]:
     from .. import dtypes as _dt
 
     D = _dist()
-    outs = _dispatch(plan, d, want_keeps=False)
+    outs, wall = _dispatch(plan, d, want_keeps=False)
+    record_stream_feedback(_feedback_key(plan),
+                           blocks=d.mesh.num_data_shards,
+                           rows=d.num_rows, wall_s=wall)
     names = plan.reduce_names
     if plan.has_filter:
         counts = D._read_global(outs[len(names)]).astype(np.int64)
@@ -1094,19 +1135,18 @@ def record_aggregate(fetches, lazy: LazyDistributedFrame, keys,
     try:
         result = _run_fused_aggregate(plan, source, list(keys))
     except Exception as e:  # noqa: BLE001 - reclassified below
-        from ..resilience import is_device_lost, is_oom
+        from ..resilience import is_device_lost
         if is_device_lost(e):
             raise
-        counters.inc("dplan.fallbacks")
-        if is_oom(e):
-            counters.inc("dplan.oom_fallbacks")
+        _record_fallback(e)
         _log.warning(
             "fused mesh aggregate failed (%s: %s); re-running per-op",
             type(e).__name__, e)
         D = _dist()
         return D.daggregate(fetches, _replay_per_op(source, ops), keys)
     counters.inc("dplan.fused_forcings")
-    lazy._dplan_info = plan.describe(executed="executed")
+    lazy._dplan_info = plan.describe(executed="executed") \
+        + _feedback_lines(plan)
     return result
 
 
@@ -1130,8 +1170,11 @@ def _exec_aggregate(plan: _DPlan, d, keys):
     else:
         prog_ids, prog_groups = ids_dev, num_groups
     fetch_names = sorted(plan.agg_combiners)
-    outs = _dispatch(plan, d, want_keeps=False, agg_groups=prog_groups,
-                     ids_dev=prog_ids)
+    outs, wall = _dispatch(plan, d, want_keeps=False,
+                           agg_groups=prog_groups, ids_dev=prog_ids)
+    record_stream_feedback(_feedback_key(plan),
+                           blocks=d.mesh.num_data_shards,
+                           rows=d.num_rows, wall_s=wall)
     tables = list(outs)
     if salt_plan is not None:
         from ..parallel import elastic as _elastic

@@ -87,6 +87,9 @@ def maybe_run(frame) -> Optional[List]:
             # can (op-granular splits), so hand the forcing back to it
             # instead of failing a query the unfused engine survives
             counters.inc("plan.oom_fallbacks")
+            from ..observability import flight as _flight
+            _flight.record("plan.oom_fallback",
+                           error=type(e).__name__)
             _log.warning(
                 "fused plan hit an OOM its stage could not split (%s); "
                 "re-running through the per-op path", e)
@@ -419,25 +422,32 @@ def _unit_fns(plan: ExecPlan):
     return serial_fn, submit_fn, drain_fn, ex0
 
 
-def _should_replan(plan: ExecPlan) -> bool:
-    """True when a filter's observed selectivity deviates from what
-    this plan priced it at by more than ``TFT_REPLAN_RATIO``."""
+def _should_replan(plan: ExecPlan):
+    """The worst ``(priced, observed)`` selectivity pair deviating past
+    ``TFT_REPLAN_RATIO``, or ``None`` (plan still priced right). The
+    pair is the re-plan decision's recorded INPUT — what the plan
+    believed vs what the blocks showed (docs/observability.md)."""
     from . import adaptive as _adaptive
     from .nodes import observed_selectivity
     ratio = _adaptive.replan_ratio()
+    worst = None
+    worst_dev = ratio
     for i, sel0 in plan.priced_sel.items():
         cur = observed_selectivity(plan.ops[i].comp)
         if cur is None:
             continue
         a = max(sel0 if sel0 is not None else 1.0, 1e-6)
         b = max(cur, 1e-6)
-        if max(a, b) / min(a, b) > ratio:
-            return True
-    return False
+        dev = max(a, b) / min(a, b)
+        if dev > worst_dev:
+            worst_dev = dev
+            worst = (a, b)
+    return worst
 
 
 def _run_adaptive(plan: ExecPlan, layout, frame) -> List:
     from ..engine import pipeline as _pipeline
+    from ..observability import flight as _flight
     from ..observability.events import add_event
     from ..utils.tracing import counters as _counters
     serial_fn, submit_fn, drain_fn, ex0 = _unit_fns(plan)
@@ -445,12 +455,18 @@ def _run_adaptive(plan: ExecPlan, layout, frame) -> List:
     add_event("adaptive_layout", name=plan.leaf.describe(),
               blocks=layout.n_orig, units=len(units),
               coalesced=layout.coalesced_from, splits=layout.splits)
+    _flight.record("plan.adaptive_layout", blocks=layout.n_orig,
+                   units=len(units), coalesced=layout.coalesced_from,
+                   splits=layout.splits,
+                   depth=_pipeline.pipeline_depth(None))
     # probe the first unit serially: its observed selectivities are the
     # re-plan trigger for the remaining stages (ROADMAP 2d) — a
     # mid-plan boundary, not a new forcing
     outs = [serial_fn(units[0])]
     rest_plan = plan
-    if len(units) > 1 and frame is not None and _should_replan(plan):
+    deviation = (_should_replan(plan)
+                 if len(units) > 1 and frame is not None else None)
+    if deviation is not None:
         try:
             from .optimize import build_plan
             new_plan = build_plan(frame)
@@ -469,6 +485,12 @@ def _run_adaptive(plan: ExecPlan, layout, frame) -> List:
             _counters.inc("plan.replans")
             add_event("replan", name=plan.leaf.describe(),
                       at_block=int(len(units[0][2])))
+            from .adaptive import replan_ratio as _replan_ratio
+            _flight.record("plan.replan",
+                           at_block=int(len(units[0][2])),
+                           priced=round(deviation[0], 6),
+                           observed=round(deviation[1], 6),
+                           ratio=_replan_ratio())
             _log.info("mid-plan replan: observed selectivity deviated "
                       "past TFT_REPLAN_RATIO; re-ordered the remaining "
                       "filter stages")

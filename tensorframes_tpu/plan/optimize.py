@@ -383,6 +383,11 @@ def _reorder_filters(ops):
                 if order != list(range(len(run))):
                     out[i:j] = [run[k] for k in order]
                     changed = True
+                    from ..observability import flight as _flight
+                    _flight.record(
+                        "plan.filter_reorder", order=order,
+                        selectivities=[round(s, 6) if s is not None
+                                       else None for s in sels])
         i = j
     if changed:
         from ..utils.tracing import counters

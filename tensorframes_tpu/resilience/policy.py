@@ -34,6 +34,7 @@ import threading
 import time
 from typing import Callable, Optional, TypeVar
 
+from ..observability import flight as _flight
 from ..observability.events import add_event as _obs_event
 from ..observability.events import current_trace as _current_trace
 from ..utils.logging import get_logger
@@ -243,6 +244,15 @@ class RetryPolicy:
                                    attempts=attempt + 1,
                                    error=type(last).__name__,
                                    kind=_error_kind(last), deadline=True)
+                    # giveups are rare enough to classify always-on for
+                    # the flight ring, and a classified giveup is one
+                    # of the recorder's auto-dump triggers
+                    _flight.record("resilience.giveup", op=op,
+                                   attempts=attempt + 1,
+                                   error=type(last).__name__,
+                                   error_kind=_error_kind(last),
+                                   deadline=True)
+                    _flight.maybe_dump("giveup")
                     _log.error(
                         "%s: transient failure and only %.3fs left on "
                         "the deadline (backoff %.3fs); giving up", op,
@@ -266,6 +276,11 @@ class RetryPolicy:
                 _obs_event("giveup", name=op, attempts=self.max_attempts,
                            error=type(last).__name__,
                            kind=_error_kind(last))
+            _flight.record("resilience.giveup", op=op,
+                           attempts=self.max_attempts,
+                           error=type(last).__name__,
+                           error_kind=_error_kind(last))
+            _flight.maybe_dump("giveup")
             _log.error("%s: giving up after %d attempt(s): %s",
                        op, self.max_attempts, last)
             assert last is not None

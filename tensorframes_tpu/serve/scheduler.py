@@ -88,6 +88,8 @@ from ..engine import pipeline as _pipeline
 from ..engine import preempt as _preempt
 from ..observability import device as _obs_device
 from ..observability import events as _obs
+from ..observability import flight as _flight
+from ..observability import slo as _slo
 from ..resilience import (AdmissionDeadline, DeadlineExceeded, OverQuota,
                           QueryCancelled, QueryPreempted, QueueFull,
                           ServeRejected, deadline as _deadline,
@@ -514,6 +516,9 @@ class QueryScheduler:
             if len(t.queue) >= t.max_queue:
                 t.counts["rejected"] += 1
                 counters.inc("serve.rejected")
+                _flight.record("serve.reject", tenant=tenant,
+                               queued=len(t.queue),
+                               max_queue=t.max_queue)
                 raise QueueFull(
                     f"tenant {tenant!r} queue is full "
                     f"({t.max_queue} queued); retry later (classified "
@@ -522,6 +527,10 @@ class QueryScheduler:
                 if not t.bucket.try_take(est_rows):
                     t.counts["over_quota"] += 1
                     counters.inc("serve.over_quota")
+                    _flight.record("serve.over_quota", tenant=tenant,
+                                   est_rows=est_rows,
+                                   rate=t.bucket.rate,
+                                   tokens=t.bucket.tokens)
                     raise OverQuota(
                         f"tenant {tenant!r} rows/sec budget exhausted "
                         f"({t.bucket.rate:g} rows/s, query estimated "
@@ -547,12 +556,14 @@ class QueryScheduler:
             t.counts["submitted"] += 1
             counters.inc("serve.submitted")
             gauge("serve.queue_depth", self._queued_locked())
-            self._maybe_preempt_locked(t)
+            self._maybe_preempt_locked(t, arriving_query=q.query_id)
             self._cond.notify()
         return q
 
     # -- preemption & cancellation -----------------------------------------
-    def _maybe_preempt_locked(self, arriving: _Tenant) -> None:
+    def _maybe_preempt_locked(self, arriving: _Tenant,
+                              arriving_query: Optional[str] = None
+                              ) -> None:
         """Priority preemption on arrival (``docs/serving.md``): when a
         higher-weight tenant submits and every execution slot is busy,
         the lowest-weight running query that has run for at least
@@ -590,6 +601,14 @@ class QueryScheduler:
             f"(weight {arriving.weight:g} > "
             f"{self._tenants[victim.tenant].weight:g})")
         counters.inc("serve.preempt_requests")
+        _flight.record("serve.preempt", query=victim.query_id,
+                       victim_tenant=victim.tenant,
+                       victim_weight=self._tenants[victim.tenant].weight,
+                       arriving=arriving.name,
+                       arriving_query=arriving_query,
+                       arriving_weight=arriving.weight,
+                       workers=max(1, self.workers),
+                       after_ms=env_float("TFT_PREEMPT_AFTER_MS", 100.0))
         # no add_event here: this runs on the SUBMITTER's thread, whose
         # active trace (if any) is not the victim's — the victim-side
         # park records the request (with its reason naming the
@@ -626,6 +645,8 @@ class QueryScheduler:
                     sc.request_cancel(f"cancel({query_id})")
             self._cond.notify_all()
         counters.inc("serve.cancel_requests")
+        _flight.record("serve.cancel", query=query_id, tenant=q.tenant,
+                       state="queued" if queued else "running")
         # like the preempt request above, the victim-side boundary
         # records the `cancel` event into the victim's own trace
         if queued:
@@ -694,6 +715,14 @@ class QueryScheduler:
         return True
 
     def _execute(self, q: SubmittedQuery) -> None:
+        with _flight.scope(q.query_id):
+            self._execute_scoped(q)
+
+    def _execute_scoped(self, q: SubmittedQuery) -> None:
+        # everything inside runs under the flight-recorder correlation
+        # scope: decisions made deep in the forcing (a mesh shrink, a
+        # re-plan, a ledger spill) land in the ring tagged with this
+        # query id — with TFT_TRACE off (docs/observability.md)
         t = self._tenants[q.tenant]
         q.started_at = time.monotonic()
         q.state = "running"
@@ -717,6 +746,10 @@ class QueryScheduler:
             with self._cond:
                 t.counts["admitted"] += 1
             counters.inc("serve.admitted")
+            _flight.record("serve.start", tenant=q.tenant,
+                           queue_wait_s=round(queue_wait, 6),
+                           est_bytes=q.est_bytes,
+                           resumed=q.preemptions > 0)
             remaining = None
             if q.deadline_at is not None:
                 remaining = max(q.deadline_at - time.monotonic(), 1e-3)
@@ -802,6 +835,10 @@ class QueryScheduler:
             self._cond.notify_all()
         counters.inc("serve.preemptions")
         cp = q._checkpoint
+        _flight.record("serve.requeue", query=q.query_id,
+                       tenant=q.tenant, preemptions=q.preemptions,
+                       parked_blocks=cp.parked_blocks
+                       if cp is not None else 0)
         _log.info("query %s (tenant %r) parked (%d block(s) "
                   "checkpointed); re-queued at the front", q.query_id,
                   q.tenant, cp.parked_blocks if cp is not None else 0)
@@ -843,6 +880,7 @@ class QueryScheduler:
         give_up_at = time.monotonic() + max(budget, 0.0)
         if q.deadline_at is not None:
             give_up_at = min(give_up_at, q.deadline_at)
+        waited_since: Optional[float] = None
         waited = False
         preempt_tried = False
         while True:
@@ -856,6 +894,11 @@ class QueryScheduler:
             if headroom is None or need <= headroom:
                 if waited:
                     counters.inc("serve.admission_waits")
+                _flight.record(
+                    "serve.admit", tenant=q.tenant, est_bytes=need,
+                    headroom=headroom,
+                    waited_s=round(time.monotonic() - waited_since, 6)
+                    if waited_since is not None else 0.0)
                 return
             if not preempt_tried:
                 # one preemption attempt per admission: ask the whale
@@ -864,6 +907,10 @@ class QueryScheduler:
                 self._preempt_for_admission(q, need,
                                             shortfall=need - headroom)
             if time.monotonic() >= give_up_at:
+                _flight.record("serve.shed", tenant=q.tenant,
+                               est_bytes=need, headroom=headroom,
+                               budget_s=budget,
+                               preempt_tried=preempt_tried)
                 raise AdmissionDeadline(
                     f"query {q.query_id} (tenant {q.tenant!r}) shed: "
                     f"estimated footprint {need} B exceeds HBM "
@@ -872,6 +919,7 @@ class QueryScheduler:
                     f"free enough (classified 'deadline_admission')")
             if not waited:
                 waited = True
+                waited_since = time.monotonic()
                 _obs.add_event("sched_admission_wait", name=q.query_id,
                                tenant=q.tenant, est_bytes=need)
             time.sleep(max(poll, 0.001))
@@ -914,6 +962,10 @@ class QueryScheduler:
         _obs.add_event("sched_admission_preempt", name=q.query_id,
                        tenant=q.tenant, victim=victim.query_id,
                        victim_bytes=victim.est_bytes or 0)
+        _flight.record("serve.admission_preempt", query=q.query_id,
+                       tenant=q.tenant, victim=victim.query_id,
+                       victim_bytes=victim.est_bytes or 0, need=need,
+                       shortfall=shortfall)
         _log.info("admission for query %s (tenant %r, %d B) preempting "
                   "query %s (est %s B): parking the whale instead of "
                   "shedding the arrival", q.query_id, q.tenant, need,
@@ -965,6 +1017,11 @@ class QueryScheduler:
         histograms.observe("query_latency_seconds", dur, op="serve",
                            tenant=t.name, outcome=outcome)
         counters.inc(f"serve.{key}")
+        _flight.record("serve.finish", query=q.query_id, tenant=t.name,
+                       outcome=key, latency_s=round(dur, 6))
+        # SLO burn-rate callbacks evaluate off the completion path
+        # (throttled per tenant; docs/observability.md)
+        _slo.note_completion(t.name)
         with self._cond:
             self._queries.pop(q.query_id, None)
             t.inflight -= 1

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional
 
+from ..observability import slo as _slo
 from ..observability.metrics import (_escape_label as _escape,
                                      register_metrics_provider,
                                      unregister_metrics_provider)
@@ -136,6 +137,15 @@ class ServerStats:
                 f"    rejected {s['rejected']} (queue full) · "
                 f"over_quota {s['over_quota']} · shed {s['shed']} "
                 f"(admission)")
+            slo = _slo.slo_status(name).get(name)
+            if slo is not None and slo["total"]:
+                lines.append(
+                    f"    SLO {slo['objective_ms']:g} ms @ "
+                    f"{slo['target']:.4g}: compliance "
+                    f"{slo['compliance']:.4%} · burn "
+                    f"{slo['burn_rate']:.2f}x · budget left "
+                    f"{slo['budget_remaining']:.1%} "
+                    f"({slo['good']} good / {slo['bad']} bad)")
             if s.get("preempted") or s.get("cancelled"):
                 lines.append(
                     f"    preempted {s.get('preempted', 0)} "

@@ -64,7 +64,7 @@ from ..resilience import (QueryInterrupted, check_deadline,
 from ..utils.logging import get_logger
 from ..utils.tracing import counters, gauge, span
 
-__all__ = ["StreamHandle"]
+__all__ = ["StreamHandle", "live_handles"]
 
 _log = get_logger("stream.runtime")
 
@@ -73,6 +73,13 @@ _log = get_logger("stream.runtime")
 _live_lock = threading.Lock()
 _live: "weakref.WeakSet[StreamHandle]" = weakref.WeakSet()
 _provider_registered = False
+
+
+def live_handles() -> List["StreamHandle"]:
+    """Every live stream handle (``tft.health()``'s stream section and
+    the metrics provider read the same set)."""
+    with _live_lock:
+        return list(_live)
 
 
 def _register_provider() -> None:
@@ -413,6 +420,10 @@ class StreamHandle:
                 self._skipped += 1
             _obs.add_event("batch_skip", name=self.name, batch=i,
                            error=type(e).__name__, kind=kind)
+            from ..observability import flight as _flight
+            _flight.record("stream.batch_skip", stream=self.name,
+                           batch=i, error=type(e).__name__,
+                           error_kind=kind)
             if env_bool("TFT_STREAM_FAIL_FAST", False):
                 raise
             _log.error(

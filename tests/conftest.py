@@ -121,6 +121,13 @@ def pytest_configure(config):
                    "TFT_RESULT_CACHE=0 (run-tests.sh --adaptive runs "
                    "this lane standalone)")
     config.addinivalue_line(
+        "markers", "flight: flight-recorder/decision-audit/SLO/health "
+                   "suite — always-on decision ring + tft.why() causal "
+                   "chains with TFT_TRACE off, JSONL auto-dumps with "
+                   "rotation, SLO burn math, tft.health(), metrics-"
+                   "provider conformance (run-tests.sh --flight runs "
+                   "this lane standalone)")
+    config.addinivalue_line(
         "markers", "timing: wall-clock-sensitive deadline assertions — "
                    "margins are widened for loaded machines "
                    "(TFT_TIMING_MARGIN multiplies the bounds; "

@@ -1,0 +1,608 @@
+"""Flight-recorder / decision-audit / SLO / health suite (tier-1;
+marker ``flight``; ``run-tests.sh --flight``).
+
+The load-bearing contracts:
+
+- the flight ring is ALWAYS-ON, bounded, and decision-level — hot
+  per-block paths never write to it (zero-cost assertions), and
+  ``TFT_FLIGHT=0`` bypasses every hook bit-identically;
+- ``tft.why(query_id)`` reconstructs the causal chain — with its
+  recorded inputs (estimates, observations, thresholds, knobs) — for a
+  query that was SHED, one that was PREEMPTED, one that was RE-PLANNED,
+  and one that rode a MESH SHRINK, all with ``TFT_TRACE`` off;
+- slow queries and classified giveups auto-dump a parseable JSONL
+  flight snapshot (``TFT_FLIGHT_DUMP``), sharing the trace-file sink's
+  size-capped keep-1 rotation (``TFT_TRACE_FILE_MAX_BYTES``);
+- SLO burn math matches hand-computed histogram fixtures; the burn
+  callback is edge-triggered; ``serve_report()`` renders the SLO line;
+- ``tft.health()`` aggregates every subsystem into one snapshot;
+- every registered ``metrics_text()`` provider conforms: exactly one
+  ``# TYPE`` header per family, escaped label values, no duplicate
+  series.
+
+Latency-bound assertions are ``timing``-marked with ``timing_margin()``
+per the tier-1 flake note.
+"""
+
+import json
+import re
+import threading
+
+import numpy as np
+import pytest
+
+import tensorframes_tpu as tft
+from conftest import timing_margin
+from tensorframes_tpu import parallel as par
+from tensorframes_tpu import resilience as rz
+from tensorframes_tpu import serve, stream
+from tensorframes_tpu.engine import preempt as engine_preempt
+from tensorframes_tpu.observability import (flight, health, metrics,
+                                            slo)
+from tensorframes_tpu.observability import device as obs_device
+from tensorframes_tpu.parallel import elastic
+from tensorframes_tpu.resilience import faults
+from tensorframes_tpu.serve import QueryScheduler, TenantQuota
+from tensorframes_tpu.utils import tracing
+from tensorframes_tpu.utils.tracing import counters, histograms
+
+pytestmark = pytest.mark.flight
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    monkeypatch.setenv("TFT_RETRY_BASE_DELAY", "0.001")
+    monkeypatch.setenv("TFT_RETRY_MAX_DELAY", "0.01")
+    for var in ("TFT_FLIGHT", "TFT_FLIGHT_DUMP", "TFT_FLIGHT_RING",
+                "TFT_TRACE_FILE", "TFT_TRACE_FILE_MAX_BYTES",
+                "TFT_SLOW_QUERY_MS"):
+        monkeypatch.delenv(var, raising=False)
+    tracing.disable()
+    faults.reset()
+    flight.clear()
+    slo.clear_slos()
+    elastic._lost_pool.clear()
+    elastic._tracker.clear()
+    elastic._upgrades.clear()
+    yield
+    faults.reset()
+    flight.clear()
+    slo.clear_slos()
+    elastic._lost_pool.clear()
+    elastic._tracker.clear()
+    elastic._upgrades.clear()
+    tracing.disable()
+
+
+def _frame(n=16, parts=4):
+    return tft.frame({"x": np.arange(float(n))}, num_partitions=parts)
+
+
+# ---------------------------------------------------------------------------
+# the ring
+# ---------------------------------------------------------------------------
+
+class TestRing:
+    def test_ring_bounds_and_eviction_order(self, monkeypatch):
+        monkeypatch.setenv("TFT_FLIGHT_RING", "8")
+        flight.clear()
+        for i in range(20):
+            flight.record("test.kind", i=i)
+        recs = flight.recent("test.kind")
+        assert len(recs) == 8, "ring must drop oldest at the bound"
+        assert [r["i"] for r in recs] == list(range(12, 20)), \
+            "eviction must be oldest-first, order preserved"
+        seqs = [r["seq"] for r in recs]
+        assert seqs == sorted(seqs)
+
+    def test_records_carry_inputs_and_scope(self):
+        with flight.scope("q-scope"):
+            assert flight.current_query() == "q-scope"
+            flight.record("test.decision", estimate=100, observed=412,
+                          threshold=4.0)
+        assert flight.current_query() is None
+        recs = flight.for_query("q-scope")
+        assert len(recs) == 1
+        r = recs[0]
+        assert r["estimate"] == 100 and r["observed"] == 412
+        assert r["threshold"] == 4.0
+        assert r["query"] == "q-scope"
+        assert "ts" in r and "seq" in r
+
+    def test_scope_survives_worker_threads(self):
+        from tensorframes_tpu.observability.events import wrap_context
+        got = {}
+
+        def work():
+            flight.record("test.threaded")
+            got["q"] = flight.current_query()
+
+        with flight.scope("q-thread"):
+            t = threading.Thread(target=wrap_context(work))
+            t.start()
+            t.join()
+        assert got["q"] == "q-thread"
+        assert flight.for_query("q-thread")
+
+    def test_kind_filter_is_namespace_aware(self):
+        flight.record("mesh.shrink", device=1)
+        flight.record("mesh.grow", devices=[1])
+        flight.record("meshy.other")
+        assert {r["kind"] for r in flight.recent("mesh")} == \
+            {"mesh.shrink", "mesh.grow"}
+        assert len(flight.recent("mesh.shrink")) == 1
+
+    def test_bypass_is_total(self, monkeypatch):
+        monkeypatch.setenv("TFT_FLIGHT", "0")
+        flight.record("test.kind", x=1)
+        assert flight.recent() == []
+        assert flight.dump(reason="manual") is None
+        assert "disabled" in tft.why("anything")
+
+    def test_flight_off_forcing_bit_identical(self, monkeypatch):
+        df_on = _frame(32, 8).map_rows(lambda x: {"z": x * 2.0})
+        on = [np.asarray(b.columns["z"]) for b in df_on.blocks()]
+        monkeypatch.setenv("TFT_FLIGHT", "0")
+        df_off = _frame(32, 8).map_rows(lambda x: {"z": x * 2.0})
+        off = [np.asarray(b.columns["z"]) for b in df_off.blocks()]
+        assert len(on) == len(off)
+        for a, b in zip(on, off):
+            assert a.dtype == b.dtype and np.array_equal(a, b)
+
+
+# ---------------------------------------------------------------------------
+# zero-cost: hot per-block paths never touch the ring
+# ---------------------------------------------------------------------------
+
+class TestZeroCost:
+    def test_no_ring_writes_from_per_block_paths(self):
+        before = flight.stats()["recorded_total"]
+        df = _frame(64, 16).map_rows(lambda x: {"z": x + 1.0})
+        df.blocks()
+        after = flight.stats()["recorded_total"]
+        assert after == before, (
+            f"a healthy multi-block forcing recorded "
+            f"{after - before} flight decision(s); the ring is for "
+            f"DECISIONS, not blocks: {flight.recent(limit=10)}")
+
+    def test_healthy_stream_batches_record_nothing(self):
+        def gen():
+            for i in range(6):
+                yield {"v": np.arange(4, dtype=np.float64) + i}
+
+        before = flight.stats()["recorded_total"]
+        h = stream.from_source(stream.GeneratorSource(gen())) \
+            .map_rows(lambda v: {"z": v * 2.0}).start()
+        h.run()
+        assert flight.stats()["recorded_total"] == before
+
+
+# ---------------------------------------------------------------------------
+# dumps: slow query, giveup, device loss, rotation
+# ---------------------------------------------------------------------------
+
+def _parse_dump(path):
+    lines = path.read_text().splitlines()
+    assert lines, "dump file is empty"
+    recs = [json.loads(ln) for ln in lines]  # every line parses
+    heads = [r for r in recs if r.get("type") == "flight_dump"]
+    assert heads, "no flight_dump header line"
+    return heads, recs
+
+
+class TestDumps:
+    def test_manual_dump_parseable_jsonl(self, tmp_path):
+        flight.record("test.kind", detail="with \"quotes\" and\nnewline")
+        out = tmp_path / "flight.jsonl"
+        assert flight.dump(str(out), reason="manual") == str(out)
+        heads, recs = _parse_dump(out)
+        assert heads[0]["reason"] == "manual"
+        assert heads[0]["records"] == 1
+        assert any(r.get("kind") == "test.kind" for r in recs)
+
+    def test_dump_on_slow_query(self, tmp_path, monkeypatch):
+        out = tmp_path / "dump.jsonl"
+        monkeypatch.setenv("TFT_FLIGHT_DUMP", str(out))
+        monkeypatch.setenv("TFT_SLOW_QUERY_MS", "0")
+        flight.record("test.context", hint="pre-slow-query state")
+        assert not tracing.enabled()
+        _frame(8, 2).map_rows(lambda x: {"z": x + 1.0}).blocks()
+        heads, recs = _parse_dump(out)
+        assert any(h["reason"] == "slow_query" for h in heads)
+        assert any(r.get("kind") == "test.context" for r in recs)
+
+    def test_dump_on_classified_giveup(self, tmp_path, monkeypatch):
+        out = tmp_path / "dump.jsonl"
+        monkeypatch.setenv("TFT_FLIGHT_DUMP", str(out))
+
+        def always_transient():
+            raise RuntimeError("UNAVAILABLE: flaky backend")
+
+        with pytest.raises(RuntimeError):
+            rz.RetryPolicy(max_attempts=2, base_delay=0.001,
+                           jitter=0.0).call(always_transient, op="t")
+        heads, recs = _parse_dump(out)
+        assert any(h["reason"] == "giveup" for h in heads)
+        give = [r for r in recs if r.get("kind") == "resilience.giveup"]
+        assert give and give[-1]["attempts"] == 2
+        assert give[-1]["error_kind"] == "transient"
+
+    def test_dump_on_device_loss(self, tmp_path, monkeypatch):
+        out = tmp_path / "dump.jsonl"
+        monkeypatch.setenv("TFT_FLIGHT_DUMP", str(out))
+        dist = par.distribute(_frame(40, 1), par.local_mesh(8))
+        with faults.inject("device", 1):
+            par.dmap_blocks(lambda x: {"z": x * 2.0}, dist)
+        heads, _ = _parse_dump(out)
+        assert any(h["reason"] == "device_lost" for h in heads)
+
+    def test_sink_rotation_keep_one(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("TFT_TRACE_FILE_MAX_BYTES", "400")
+        path = tmp_path / "sink.jsonl"
+        line = json.dumps({"type": "filler", "pad": "x" * 60})
+        for _ in range(12):
+            flight.append_jsonl(str(path), [line])
+        rolled = tmp_path / "sink.jsonl.1"
+        assert rolled.exists(), "keep-1 rollover file missing"
+        assert path.stat().st_size <= 400 + len(line) + 1
+        # both generations stay line-parseable
+        for p in (path, rolled):
+            for ln in p.read_text().splitlines():
+                json.loads(ln)
+
+    def test_trace_file_rides_the_rotation(self, tmp_path, monkeypatch):
+        path = tmp_path / "trace.jsonl"
+        monkeypatch.setenv("TFT_TRACE_FILE", str(path))
+        monkeypatch.setenv("TFT_TRACE_FILE_MAX_BYTES", "2000")
+        tracing.enable()
+        try:
+            for _ in range(8):
+                _frame(8, 2).map_rows(lambda x: {"z": x + 1.0}).blocks()
+        finally:
+            tracing.disable()
+        assert path.exists()
+        assert (tmp_path / "trace.jsonl.1").exists(), \
+            "TFT_TRACE_FILE must rotate at TFT_TRACE_FILE_MAX_BYTES"
+
+
+# ---------------------------------------------------------------------------
+# tft.why(): the acceptance chains, all with TFT_TRACE off
+# ---------------------------------------------------------------------------
+
+class _FakeDevice:
+    def __init__(self, live, peak, limit):
+        self.stats = {"bytes_in_use": live, "peak_bytes_in_use": peak,
+                      "bytes_limit": limit}
+
+    def memory_stats(self):
+        return self.stats
+
+
+class TestWhy:
+    @pytest.mark.timing
+    def test_why_reconstructs_a_shed_query(self, monkeypatch):
+        monkeypatch.setattr(obs_device, "_local_devices",
+                            lambda: [_FakeDevice(950, 950, 1000)])
+        obs_device._reset()
+        monkeypatch.setenv("TFT_SERVE_ADMISSION_WAIT_S", "0.05")
+        monkeypatch.setenv("TFT_SERVE_ADMISSION_POLL_S", "0.01")
+        assert not tracing.enabled()
+        with QueryScheduler(workers=0, name="fshed") as sched:
+            fut = sched.submit(_frame(8), tenant="t", est_bytes=500)
+            assert sched.step()
+            with pytest.raises(rz.AdmissionDeadline):
+                fut.result(timeout=timing_margin(5))
+            report = tft.why(fut.query_id)
+        kinds = [r["kind"] for r in flight.for_query(fut.query_id)]
+        assert "serve.shed" in kinds and "serve.finish" in kinds
+        shed = [r for r in flight.for_query(fut.query_id)
+                if r["kind"] == "serve.shed"][0]
+        # the decision's INPUTS: estimate vs headroom vs wait budget
+        assert shed["est_bytes"] == 500
+        assert shed["headroom"] is not None and shed["headroom"] < 500
+        assert shed["budget_s"] == pytest.approx(0.05)
+        assert "SHED" in report and "500 B" in report
+        obs_device._reset()
+
+    def test_why_reconstructs_a_preempted_query(self):
+        df = _frame(40, 8).map_rows(lambda x: {"z": x + 1.0})
+        sc = engine_preempt.PreemptionScope("q-preempted")
+        faults.arm("preempt", 1)
+        with pytest.raises(rz.QueryPreempted):
+            with engine_preempt.activate(sc):
+                df.blocks()
+        faults.reset()
+        with engine_preempt.activate(sc):
+            df.blocks()  # resume restores the parked prefix
+        recs = flight.for_query("q-preempted")
+        kinds = [r["kind"] for r in recs]
+        assert "preempt.park" in kinds and "preempt.resume" in kinds
+        park = [r for r in recs if r["kind"] == "preempt.park"][0]
+        resume = [r for r in recs if r["kind"] == "preempt.resume"][0]
+        assert park["total"] == 8 and 1 <= park["blocks"] < 8
+        assert resume["blocks"] == park["blocks"]
+        assert "injected fault" in park["reason"]
+        report = tft.why("q-preempted")
+        assert "parked at block boundary" in report
+        assert "restored from checkpoint" in report
+
+    def test_why_reconstructs_a_replanned_query(self, monkeypatch):
+        monkeypatch.setenv("TFT_REPLAN_RATIO", "3")
+        assert not tracing.enabled()
+        q1 = lambda v: v > -1.0                   # noqa: E731
+        q2 = lambda v: v < 50.0                   # noqa: E731
+
+        def chain(frame):
+            return frame.filter(q1).filter(q2)
+
+        warm = tft.frame({"v": np.arange(30, dtype=np.float64)},
+                         num_partitions=30)
+        warm.cache()
+        chain(warm).blocks()   # priced ~keep-everything
+        chain(warm).blocks()   # feedback for the plan shape
+        big = tft.frame({"v": np.arange(6000, dtype=np.float64)},
+                        num_partitions=30)
+        big.cache()
+        with flight.scope("q-replan"):
+            chain(big).blocks()
+        recs = flight.for_query("q-replan")
+        replans = [r for r in recs if r["kind"] == "plan.replan"]
+        assert replans, f"no replan recorded; got {recs}"
+        r = replans[0]
+        # inputs: what the plan priced vs what the blocks showed, and
+        # the knob the deviation was compared against
+        assert r["ratio"] == pytest.approx(3.0)
+        assert r["priced"] > 0 and r["observed"] > 0
+        assert max(r["priced"], r["observed"]) \
+            / min(r["priced"], r["observed"]) > 3.0
+        report = tft.why("q-replan")
+        assert "RE-PLAN" in report and "TFT_REPLAN_RATIO" in report
+
+    def test_why_reconstructs_a_mesh_shrink(self):
+        assert not tracing.enabled()
+        dist = par.distribute(_frame(40, 1), par.local_mesh(8))
+        with flight.scope("q-shrink"):
+            with faults.inject("device", 1):
+                out = par.dmap_blocks(lambda x: {"z": x * 2.0}, dist)
+        assert out.mesh.num_devices == 7
+        recs = flight.for_query("q-shrink")
+        shr = [r for r in recs if r["kind"] == "mesh.shrink"]
+        assert len(shr) == 1
+        assert shr[0]["devices_before"] == 8
+        assert shr[0]["devices_after"] == 7
+        assert shr[0]["device"] == 0
+        assert shr[0]["reshard_rows"] > 0
+        report = tft.why("q-shrink")
+        assert "LOST" in report and "8 -> 7" in report
+
+    def test_why_unknown_query_is_helpful(self):
+        msg = tft.why("serve-q99999")
+        assert "no decisions recorded" in msg
+
+
+# ---------------------------------------------------------------------------
+# SLO: burn math vs hand-computed fixtures
+# ---------------------------------------------------------------------------
+
+class TestSLO:
+    def test_burn_math_matches_hand_computed_buckets(self):
+        tenant = "slo-fixture-a"
+        slo.set_slo(tenant, objective_ms=250.0, target=0.99)
+        # 8 fast successes (<= 0.25s bucket edge), 1 slow success
+        # (lands in the 0.5 bucket), 1 failure: good=8, bad=2 of 10
+        for _ in range(8):
+            histograms.observe("query_latency_seconds", 0.01,
+                               op="serve", tenant=tenant, outcome="ok")
+        histograms.observe("query_latency_seconds", 0.3, op="serve",
+                           tenant=tenant, outcome="ok")
+        histograms.observe("query_latency_seconds", 0.01, op="serve",
+                           tenant=tenant, outcome="error")
+        s = slo.slo_status(tenant)[tenant]
+        assert s["total"] == 10
+        assert s["good"] == 8
+        assert s["bad"] == 2
+        assert s["compliance"] == pytest.approx(0.8)
+        # burn = (bad fraction) / (1 - target) = 0.2 / 0.01 = 20x
+        assert s["burn_rate"] == pytest.approx(20.0)
+        assert s["budget_remaining"] == pytest.approx(1.0 - 20.0)
+
+    def test_objective_rounds_down_to_bucket_edge(self):
+        tenant = "slo-fixture-b"
+        # objective 300 ms sits between the 0.25 and 0.5 edges: the
+        # conservative rule counts only <= 0.25 as good
+        slo.set_slo(tenant, objective_ms=300.0, target=0.999)
+        histograms.observe("query_latency_seconds", 0.3, op="serve",
+                           tenant=tenant, outcome="ok")
+        s = slo.slo_status(tenant)[tenant]
+        assert s["good"] == 0 and s["bad"] == 1
+
+    def test_default_slo_env_knobs(self, monkeypatch):
+        monkeypatch.setenv("TFT_SLO_DEFAULT_MS", "123")
+        monkeypatch.setenv("TFT_SLO_TARGET", "0.95")
+        d = slo.default_slo()
+        assert d.objective_ms == 123.0
+        assert d.target == pytest.approx(0.95)
+
+    def test_slo_validation(self):
+        with pytest.raises(ValueError):
+            slo.SLO(objective_ms=0)
+        with pytest.raises(ValueError):
+            slo.SLO(objective_ms=100, target=1.5)
+
+    def test_burn_callback_edge_triggered(self):
+        tenant = "slo-fixture-c"
+        slo.set_slo(tenant, objective_ms=250.0, target=0.99)
+        histograms.observe("query_latency_seconds", 5.0, op="serve",
+                           tenant=tenant, outcome="error")
+        fired = []
+        key = slo.on_burn(lambda t, s: fired.append((t, s["burn_rate"])),
+                          threshold=1.0)
+        try:
+            slo.note_completion(tenant)
+            assert fired and fired[0][0] == tenant
+            assert fired[0][1] > 1.0
+            # edge-triggered: still over threshold, no second fire
+            slo._last_eval.clear()  # defeat the 1s throttle for the test
+            slo.note_completion(tenant)
+            assert len(fired) == 1
+        finally:
+            slo.remove_burn_callback(key)
+
+    def test_serve_report_renders_the_slo_line(self):
+        with QueryScheduler(workers=0, name="fslo") as sched:
+            fut = sched.submit(_frame(8), lambda x: {"z": x + 1.0},
+                               tenant="slo-report")
+            sched.step()
+            fut.result(timeout=timing_margin(10))
+            report = serve.serve_report(sched)
+        assert "SLO" in report and "burn" in report
+
+    def test_always_on_accounting_via_scheduler(self):
+        # zero-config: a tenant with no explicit set_slo still gets a
+        # status from the default objective once it completes a query
+        with QueryScheduler(workers=0, name="fdflt") as sched:
+            fut = sched.submit(_frame(8), tenant="slo-default-t")
+            sched.step()
+            fut.result(timeout=timing_margin(10))
+        s = slo.slo_status("slo-default-t")["slo-default-t"]
+        assert s["total"] >= 1
+        assert s["objective_ms"] == slo.default_slo().objective_ms
+
+
+# ---------------------------------------------------------------------------
+# tft.health()
+# ---------------------------------------------------------------------------
+
+class TestHealth:
+    def test_snapshot_keys(self):
+        _frame(4, 1).map_rows(lambda x: {"z": x + 1.0}).blocks()
+        snap = health()
+        assert set(snap) >= {"ts", "memory", "mesh", "serve", "caches",
+                             "streams", "slo", "flight", "resilience",
+                             "warnings"}
+        assert set(snap["memory"]) >= {
+            "limited", "limit_bytes", "headroom_bytes", "spills",
+            "faults", "overflow_admissions", "resident_bytes",
+            "spilled_bytes"}
+        assert set(snap["mesh"]) >= {"visible_devices", "lost_pool",
+                                     "shrinks", "grows", "rebalances"}
+        assert set(snap["flight"]) >= {"enabled", "records", "capacity",
+                                       "recorded_total", "dumps"}
+        assert snap["mesh"]["visible_devices"] == 8
+        assert isinstance(snap["warnings"], list)
+
+    def test_health_sees_serve_and_streams(self):
+        def gen():
+            for i in range(3):
+                yield {"v": np.arange(4, dtype=np.float64) + i}
+
+        h = stream.from_source(stream.GeneratorSource(gen())) \
+            .map_rows(lambda v: {"z": v * 2.0}) \
+            .start(name="flight-health-stream")
+        h.run()
+        with QueryScheduler(workers=0, name="fhlth") as sched:
+            fut = sched.submit(_frame(8), tenant="t")
+            sched.step()
+            fut.result(timeout=timing_margin(10))
+            snap = health()
+            assert snap["serve"]["running"] is True
+            assert "t" in snap["serve"]["tenants"]
+        assert "flight-health-stream" in snap["streams"]
+        st = snap["streams"]["flight-health-stream"]
+        assert st["batches"] == 3 and st["batches_skipped"] == 0
+
+    def test_lost_pool_surfaces_and_warns(self):
+        dist = par.distribute(_frame(40, 1), par.local_mesh(8))
+        with faults.inject("device", 1):
+            par.dmap_blocks(lambda x: {"z": x * 2.0}, dist)
+        snap = health()
+        assert snap["mesh"]["lost_pool"] == [0]
+        assert any("lost" in w for w in snap["warnings"])
+        elastic._lost_pool.clear()
+
+    def test_doctor_renders(self):
+        flight.record("serve.shed", query="doc-q", tenant="t",
+                      est_bytes=500, headroom=50, budget_s=5.0)
+        out = tft.doctor()
+        assert "triage" in out
+        assert "serve.shed" in out
+        assert "memory" in out and "mesh" in out and "flight" in out
+
+
+# ---------------------------------------------------------------------------
+# metrics conformance: every registered provider
+# ---------------------------------------------------------------------------
+
+_SAMPLE_RE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)'
+    r'(\{(?:[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\\n]|\\["\\n])*",?)*\})?'
+    r' (NaN|[+-]?Inf|[-+0-9.eE]+)$')
+
+
+class TestMetricsConformance:
+    def test_every_registered_provider_conforms(self):
+        # touch every subsystem so its provider is registered and has
+        # live series — including a label value that NEEDS escaping
+        from tensorframes_tpu import memory as _memory
+        _memory.manager()
+
+        def gen():
+            yield {"v": np.arange(4, dtype=np.float64)}
+
+        h = stream.from_source(stream.GeneratorSource(gen())) \
+            .start(name='we"ird\\stream\nname')
+        h.run()
+        serve.shutdown_default_scheduler()
+        weird_tenant = 'ten"ant\\with\nnewline'
+        with QueryScheduler(workers=0, name="fconf",
+                            quotas={weird_tenant: TenantQuota()}) as s:
+            fut = s.submit(_frame(8), tenant=weird_tenant)
+            s.step()
+            fut.result(timeout=timing_margin(10))
+            providers = metrics.registered_providers()
+            # the sweep must actually cover the fleet
+            for expected in ("flight", "serve.slo", "plan.adaptive",
+                             "mesh", "memory", "relational", "stream"):
+                assert expected in providers, providers
+            assert any(p.startswith("serve:") for p in providers)
+            text = metrics.metrics_text()
+        self._assert_conformant(text)
+
+    def _assert_conformant(self, text):
+        type_counts = {}
+        series_seen = set()
+        declared_type = {}
+        for line in text.splitlines():
+            if line.startswith("# TYPE "):
+                parts = line.split()
+                assert len(parts) == 4, f"malformed TYPE line: {line!r}"
+                fam, mtype = parts[2], parts[3]
+                assert mtype in ("counter", "gauge", "histogram",
+                                 "summary"), line
+                type_counts[fam] = type_counts.get(fam, 0) + 1
+                declared_type[fam] = mtype
+                continue
+            if line.startswith("#") or not line.strip():
+                continue
+            m = _SAMPLE_RE.match(line)
+            assert m, f"unparseable sample line: {line!r}"
+            key = (m.group(1), m.group(2) or "")
+            assert key not in series_seen, f"duplicate series: {key}"
+            series_seen.add(key)
+        dupes = {f: n for f, n in type_counts.items() if n != 1}
+        assert not dupes, f"families with != 1 TYPE header: {dupes}"
+        # every sample belongs to a declared family (histogram/summary
+        # suffixes resolve to their base family)
+        fams = set(declared_type)
+        for name, _ in series_seen:
+            base = name
+            for suf in ("_bucket", "_sum", "_count"):
+                if name.endswith(suf) and name[:-len(suf)] in fams:
+                    base = name[:-len(suf)]
+                    break
+            assert base in fams, f"sample {name} has no TYPE header"
+
+    def test_escaping_helper_is_the_single_rule(self):
+        # providers must escape through metrics._escape_label: the
+        # exposition format's backslash/quote/newline rules
+        assert metrics._escape_label('a"b\\c\nd') == 'a\\"b\\\\c\\nd'
