@@ -27,6 +27,7 @@ with ``platform`` and (on failure) ``error`` fields.
 
 import json
 import os
+import shutil
 import subprocess
 import sys
 import time
@@ -1172,6 +1173,68 @@ def _child(platform: str) -> None:
     finally:
         os.environ.pop("TFT_RESULT_CACHE", None)
 
+    # secondary metric (never costs the headline): WARM RESTART of the
+    # serving fabric (docs/serving.md). A parquet-backed hot query is
+    # primed through a 2-worker ServeFabric until the result cache
+    # persists to the durable tier; every worker is then rolling-
+    # restarted (in-memory caches die with each epoch) and the same
+    # query re-issued. Acceptance bar: the post-restart hit is served
+    # WARM from disk with ZERO pipeline dispatches. Wall-clock
+    # budgeted.
+    restart_secondary = None
+    rw_budget_s = 30.0
+    rw_t0 = time.perf_counter()
+    try:
+        import tempfile as _rw_tempfile
+
+        from tensorframes_tpu import io as _rw_io
+        from tensorframes_tpu.plan import adaptive as _rw_adaptive
+        from tensorframes_tpu.serve import ServeFabric as _RwFabric
+        from tensorframes_tpu.utils.tracing import counters as _rwc
+
+        rw_dir = _rw_tempfile.mkdtemp(prefix="tft-bench-restart-")
+        rw_pq = os.path.join(rw_dir, "bench.parquet")
+        rwN = 200_000
+        _rw_io.write_parquet(
+            tft.frame({"x": np.arange(rwN, dtype=np.float64)},
+                      num_partitions=8), rw_pq)
+        _rw_fn = lambda x: {"y": x * 2.0 + 1.0}    # noqa: E731
+        _rw_adaptive.invalidate_results()
+        with _RwFabric(workers=2, monitor=False, probe=False,
+                       persist_dir=os.path.join(rw_dir, "persist"),
+                       name="bench-rw") as rw_fab:
+            rw_f = _rw_io.read_parquet(rw_pq)
+            for _ in range(2):   # two-touch: second sighting persists
+                rw_fab.submit(rw_f, _rw_fn,
+                              tenant="bench").result(timeout=60)
+            t0 = time.perf_counter()
+            rw_fab.rolling_restart()
+            restart_s = time.perf_counter() - t0
+            d0 = (_rwc.get("pipeline.submitted")
+                  + _rwc.get("pipeline.drained"))
+            warm0 = _rwc.get("plan.result_cache_warm_hits")
+            t0 = time.perf_counter()
+            rw_fab.submit(rw_f, _rw_fn,
+                          tenant="bench").result(timeout=60)
+            warm_hit_s = time.perf_counter() - t0
+            warm_dispatches = (_rwc.get("pipeline.submitted")
+                               + _rwc.get("pipeline.drained")) - d0
+            restart_secondary = {
+                "rows": rwN,
+                "rolling_restart_s": round(restart_s, 6),
+                "warm_hit_s": round(warm_hit_s, 6),
+                "warm_hit_rows_per_s": round(rwN / warm_hit_s, 1),
+                "warm_hit_block_dispatches": int(warm_dispatches),
+                "served_from_durable_tier": bool(
+                    _rwc.get("plan.result_cache_warm_hits") == warm0
+                    + 1),
+                "budget_s": rw_budget_s,
+                "elapsed_s": round(time.perf_counter() - rw_t0, 3),
+            }
+        shutil.rmtree(rw_dir, ignore_errors=True)
+    except Exception as e:  # noqa: BLE001 - headline must survive
+        restart_secondary = {"error": str(e)[:300]}
+
     # secondary metric (never costs the headline): the ALWAYS-ON flight
     # recorder + SLO accounting (docs/observability.md) on the serve
     # mixed workload. Unlike tracing (opt-in, measured off-vs-bypass),
@@ -1285,6 +1348,7 @@ def _child(platform: str) -> None:
         "preempt_resume": preempt_secondary,
         "adaptive_blocks": adaptive_secondary,
         "result_cache_hit": rcache_secondary,
+        "restart_warm": restart_secondary,
         "flight_recorder_overhead": flight_secondary,
     }
 

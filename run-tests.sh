@@ -137,6 +137,18 @@ if [ "${1:-}" = "--flight" ]; then
   exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m flight "$@"
 fi
 
+# --fabric: run only the multi-host serving-fabric lane
+# (tests/test_fabric.py: tenant sharding across workers, worker-loss
+# leases with checkpointed cross-worker resume, durable
+# checkpoint/result tiers surviving rolling restarts warm, SLO-burn
+# re-placement, TFT_FABRIC=0 parity) — fast, CPU-only, no native
+# build needed
+if [ "${1:-}" = "--fabric" ]; then
+  shift
+  echo "== fabric lane (pytest -m fabric, CPU) =="
+  exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m fabric "$@"
+fi
+
 # --timing: run only the wall-clock-sensitive deadline tests, serially
 # (they flake under concurrent suite load; TFT_TIMING_MARGIN widens
 # their assertion bounds further on badly oversubscribed boxes)

@@ -65,13 +65,17 @@ class PreemptionScope:
     is never cleared (a cancelled query must not resume).
     """
 
-    __slots__ = ("query_id", "checkpoint", "reason",
+    __slots__ = ("query_id", "checkpoint", "reason", "worker_fault",
                  "_cancel", "_preempt", "_lock", "_tag_counts")
 
     def __init__(self, query_id: str, checkpoint=None):
         self.query_id = query_id
         self.checkpoint = checkpoint  # QueryCheckpoint or None
         self.reason = ""
+        # set by boundary() when the `worker` fault site fires: the park
+        # doubles as a worker crash — the scheduler's requeue path tells
+        # the serving fabric so it can kill this worker (docs/serving.md)
+        self.worker_fault = False
         self._cancel = False
         self._preempt = False
         self._lock = threading.Lock()
@@ -183,6 +187,16 @@ def boundary(scope: PreemptionScope, progressed: bool = True) -> bool:
             _faults.check("preempt")
         except _faults.InjectedFault as e:
             scope.request_preempt(f"injected fault: {e}")
+    if progressed and _faults.active("worker"):
+        # the `worker` site kills the PROCESS, not just the query: park
+        # like a preempt (checkpoint persists to the durable tier), and
+        # flag the scope so the scheduler's requeue path reports the
+        # crash to the serving fabric (docs/resilience.md)
+        try:
+            _faults.check("worker")
+        except _faults.InjectedFault as e:
+            scope.worker_fault = True
+            scope.request_preempt(f"worker fault: {e}")
     return scope.preempt_requested
 
 

@@ -12,7 +12,10 @@ Public surface:
   control for tests and benchmarks;
 - :class:`SpillableBuffer` / :class:`SpillableColumns` /
   :func:`external_sort` — the spill mechanics and the out-of-core sort
-  (``dsort`` routes here when a frame outgrows the budget).
+  (``dsort`` routes here when a frame outgrows the budget);
+- :mod:`.persist` — the durable disk tier under the in-memory state:
+  preemption checkpoints and result-cache entries written through so
+  they survive process death (``TFT_PERSIST_DIR``, ``serve/fabric.py``).
 
 Integration map: the block executor admits every dispatch
 (``engine/executor.py``: reserve at submit, release at drain, proactive
@@ -33,6 +36,7 @@ import threading
 from typing import Any, Mapping, Optional
 
 from .checkpoint import QueryCheckpoint
+from . import persist
 from .estimate import (blocks_estimate, frame_estimate, propagate_hints,
                        schema_row_bytes)
 from .external_sort import external_sort
@@ -48,6 +52,7 @@ __all__ = [
     "blocks_estimate", "schema_row_bytes", "array_nbytes",
     "host_value", "value_nbytes", "is_device_value", "to_pinned_host",
     "note_frame_cache", "forget_frame_cache", "QueryCheckpoint",
+    "persist",
 ]
 
 _lock = threading.Lock()

@@ -113,6 +113,14 @@ class QueryCheckpoint:
                 m.note_spill(self.moved_bytes,
                              f"checkpoint:{self.query_id}")
         counters.inc("pipeline.parked_blocks", len(vals))
+        from . import persist as _persist
+        if _persist.enabled():
+            # write-through to the durable tier: a crash of THIS process
+            # can now resume the query in another one (serve/fabric.py);
+            # best-effort — a failed write degrades to a cold re-run
+            _persist.save_checkpoint(self.query_id, self._parked,
+                                     self.parked_blocks,
+                                     self.moved_bytes)
         return self.moved_bytes
 
     def resume_stream(self, total: int,

@@ -1,0 +1,346 @@
+"""Durable state tier: checkpoints and result-cache entries on disk.
+
+Every robustness guarantee earned above this line — preempt/resume
+(:mod:`.checkpoint`), the plan-fingerprint result cache
+(``plan/adaptive.py``) — lives in process memory and dies with the
+process. This module is the disk tier UNDER those LRUs that makes them
+survive process death, which is what the serving fabric
+(``serve/fabric.py``) needs to keep a promise no single process can:
+a worker crash resumes its running queries elsewhere, and a rolling
+restart comes back warm.
+
+Two artifact families, one directory (``TFT_PERSIST_DIR`` or
+:func:`configure`):
+
+- **checkpoints** (``<dir>/checkpoints/<query>.ckpt``): the parked form
+  of a :class:`~.checkpoint.QueryCheckpoint`, written through on every
+  park. Device shardings are stripped before pickling — a sharding is a
+  live-process handle and the restoring process re-plans placement
+  anyway (``spill._device_put(host, None)`` takes the default). The
+  stream ``tag`` + ``total`` cursor ride along verbatim, so a resume on
+  a DIFFERENT host hits exactly the PR 13 mismatch contract: any drift
+  discards to a cold re-run, never restores wrong data.
+- **results** (``<dir>/results/<fp>.res``): interned result blocks keyed
+  by their *portable* plan fingerprint (footer identity + structural
+  computation signatures — see ``plan/adaptive.py``), so a restarted
+  worker can serve a zero-dispatch warm hit for a plan it has never
+  executed. The result dir is byte-budgeted (``TFT_PERSIST_RESULT_BYTES``)
+  and swept oldest-first.
+
+Durability here is best-effort by design: every write/read failure is
+logged and counted, never raised — a broken disk must degrade the
+serving tier to cold re-runs, not crash the query that was being
+checkpointed. Corrupt or truncated files load as ``None`` (cold path).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+import re
+import tempfile
+import threading
+from typing import Any, List, Optional, Tuple
+
+from ..utils.logging import get_logger
+from ..utils.tracing import counters
+
+__all__ = ["configure", "root", "enabled", "save_checkpoint",
+           "load_checkpoint", "discard_checkpoint", "save_result",
+           "load_result", "stats"]
+
+_log = get_logger("memory.persist")
+
+_lock = threading.Lock()
+_override: Optional[str] = None  # configure() beats the env knob
+
+_CKPT_DIR = "checkpoints"
+_RES_DIR = "results"
+
+# result-dir byte budget before the oldest-first sweep (default 512 MiB)
+_DEFAULT_RESULT_BYTES = 512 * 1024 * 1024
+
+
+def configure(path: Optional[str]) -> Optional[str]:
+    """Point the tier at ``path`` (``None`` disables unless
+    ``TFT_PERSIST_DIR`` is set). Returns the previous override so a
+    scoped owner (the fabric) can restore it on close."""
+    global _override
+    with _lock:
+        prev = _override
+        _override = path
+    return prev
+
+
+def root() -> Optional[str]:
+    """The active persistence root, or ``None`` when the tier is off."""
+    with _lock:
+        if _override is not None:
+            return _override
+    return os.environ.get("TFT_PERSIST_DIR") or None
+
+
+def enabled() -> bool:
+    return root() is not None
+
+
+def _safe_name(key: str) -> str:
+    """A filesystem-safe, collision-free filename for ``key``: the
+    sanitized key for greppability plus a short hash for identity."""
+    tail = hashlib.sha256(key.encode()).hexdigest()[:12]
+    stem = re.sub(r"[^A-Za-z0-9_.-]", "_", key)[:80]
+    return f"{stem}-{tail}"
+
+
+def _subdir(kind: str) -> Optional[str]:
+    base = root()
+    if base is None:
+        return None
+    path = os.path.join(base, kind)
+    try:
+        os.makedirs(path, exist_ok=True)
+    except OSError as e:
+        _log.warning("persist tier unavailable (%s): %s", path, e)
+        return None
+    return path
+
+
+def _atomic_write(path: str, payload: bytes) -> bool:
+    """Write-then-rename so readers never see a torn file (a crash
+    mid-write leaves the previous version or nothing, both safe)."""
+    d = os.path.dirname(path)
+    try:
+        fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(payload)
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        return True
+    except Exception as e:
+        counters.inc("persist.write_errors")
+        _log.warning("persist write failed (%s): %s", path, e)
+        return False
+
+
+def _read(path: str) -> Optional[Any]:
+    try:
+        with open(path, "rb") as f:
+            return pickle.load(f)
+    except FileNotFoundError:
+        return None
+    except Exception as e:
+        # corrupt / truncated / version-skewed: the cold path is correct
+        counters.inc("persist.read_errors")
+        _log.warning("persist read failed (%s): %s — treating as cold",
+                     path, e)
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        return None
+
+
+def _strip_shardings(t: Tuple) -> Tuple:
+    """The parked form's ``("dev", host, sharding)`` tuples carry a live
+    sharding handle that neither pickles portably nor means anything in
+    another process; ``None`` makes the restore take the default
+    placement (bit-identical values either way)."""
+    kind = t[0]
+    if kind == "dev":
+        return ("dev", t[1], None)
+    if kind in ("block", "dict"):
+        mapped = {k: _strip_shardings(c) for k, c in t[1].items()}
+        return (kind, mapped) + tuple(t[2:])
+    return t
+
+
+# -- checkpoints ----------------------------------------------------------
+
+def save_checkpoint(query_id: str, parked: Tuple[List[Tuple], int, str],
+                    parked_blocks: int, moved_bytes: int) -> bool:
+    """Write-through one parked stream (called from
+    :meth:`~.checkpoint.QueryCheckpoint.park_stream`). Best-effort:
+    a failure degrades THAT query's cross-process resume to a cold
+    re-run and nothing else."""
+    d = _subdir(_CKPT_DIR)
+    if d is None:
+        return False
+    vals, total, tag = parked
+    try:
+        payload = pickle.dumps(
+            {"version": 1, "query_id": query_id, "tag": tag,
+             "total": int(total),
+             "vals": [_strip_shardings(v) for v in vals],
+             "parked_blocks": int(parked_blocks),
+             "moved_bytes": int(moved_bytes)},
+            protocol=pickle.HIGHEST_PROTOCOL)
+    except Exception as e:
+        counters.inc("persist.write_errors")
+        _log.warning("checkpoint of %s not picklable: %s", query_id, e)
+        return False
+    path = os.path.join(d, _safe_name(query_id) + ".ckpt")
+    if not _atomic_write(path, payload):
+        return False
+    counters.inc("persist.checkpoint_writes")
+    _log.debug("persisted checkpoint of %s: %d block(s), %d B -> %s",
+               query_id, parked_blocks, len(payload), path)
+    return True
+
+
+def load_checkpoint(query_id: str):
+    """The persisted :class:`~.checkpoint.QueryCheckpoint` of
+    ``query_id``, or ``None`` (cold). The returned checkpoint still
+    enforces the tag+total mismatch contract on resume."""
+    d = _subdir(_CKPT_DIR)
+    if d is None:
+        return None
+    rec = _read(os.path.join(d, _safe_name(query_id) + ".ckpt"))
+    if not isinstance(rec, dict) or rec.get("version") != 1:
+        return None
+    from .checkpoint import QueryCheckpoint
+    cp = QueryCheckpoint(query_id)
+    cp._parked = (rec["vals"], int(rec["total"]), str(rec["tag"]))
+    cp.parked_blocks = int(rec.get("parked_blocks", len(rec["vals"])))
+    cp.moved_bytes = int(rec.get("moved_bytes", 0))
+    counters.inc("persist.checkpoint_loads")
+    return cp
+
+
+def discard_checkpoint(query_id: str) -> None:
+    """Drop the persisted checkpoint (terminal completion — the query
+    finished for real, nothing left to resume)."""
+    base = root()
+    if base is None:
+        return
+    path = os.path.join(base, _CKPT_DIR, _safe_name(query_id) + ".ckpt")
+    try:
+        os.unlink(path)
+        counters.inc("persist.checkpoint_discards")
+    except FileNotFoundError:
+        pass
+    except OSError as e:
+        _log.debug("checkpoint discard of %s failed: %s", query_id, e)
+
+
+# -- result-cache entries -------------------------------------------------
+
+def _result_budget() -> int:
+    try:
+        return int(os.environ.get("TFT_PERSIST_RESULT_BYTES",
+                                  _DEFAULT_RESULT_BYTES))
+    except ValueError:
+        return _DEFAULT_RESULT_BYTES
+
+
+def _sweep_results(d: str) -> None:
+    """Oldest-first eviction when the result dir crosses its byte
+    budget — mirrors the in-memory LRU's discipline on disk."""
+    budget = _result_budget()
+    try:
+        entries = []
+        total = 0
+        with os.scandir(d) as it:
+            for e in it:
+                if not e.name.endswith(".res"):
+                    continue
+                st = e.stat()
+                entries.append((st.st_mtime, st.st_size, e.path))
+                total += st.st_size
+        if total <= budget:
+            return
+        entries.sort()
+        for _, size, path in entries:
+            try:
+                os.unlink(path)
+                counters.inc("persist.result_evictions")
+                total -= size
+            except OSError:
+                continue
+            if total <= budget:
+                break
+    except OSError as e:
+        _log.debug("result sweep failed: %s", e)
+
+
+def save_result(fingerprint: str, blocks: List[Any]) -> bool:
+    """Persist one interned result (the host-converted parked forms of
+    its blocks) under its portable plan fingerprint."""
+    d = _subdir(_RES_DIR)
+    if d is None:
+        return False
+    from .checkpoint import _park
+    try:
+        stats = {"moved": 0}
+        parked = [_strip_shardings(_park(b, stats)) for b in blocks]
+        payload = pickle.dumps({"version": 1, "blocks": parked},
+                               protocol=pickle.HIGHEST_PROTOCOL)
+    except Exception as e:
+        counters.inc("persist.write_errors")
+        _log.warning("result %s not picklable: %s", fingerprint[:16], e)
+        return False
+    path = os.path.join(d, _safe_name(fingerprint) + ".res")
+    if not _atomic_write(path, payload):
+        return False
+    counters.inc("persist.result_writes")
+    _sweep_results(d)
+    return True
+
+
+def load_result(fingerprint: str) -> Optional[List[Any]]:
+    """The persisted blocks for ``fingerprint``, or ``None`` (cold)."""
+    d = _subdir(_RES_DIR)
+    if d is None:
+        return None
+    rec = _read(os.path.join(d, _safe_name(fingerprint) + ".res"))
+    if not isinstance(rec, dict) or rec.get("version") != 1:
+        return None
+    from .checkpoint import _restore
+    try:
+        blocks = [_restore(b) for b in rec["blocks"]]
+    except Exception as e:
+        counters.inc("persist.read_errors")
+        _log.warning("result %s restore failed: %s — treating as cold",
+                     fingerprint[:16], e)
+        return None
+    counters.inc("persist.result_loads")
+    return blocks
+
+
+# -- introspection --------------------------------------------------------
+
+def _dir_stats(kind: str, suffix: str) -> Tuple[int, int]:
+    base = root()
+    if base is None:
+        return (0, 0)
+    d = os.path.join(base, kind)
+    n = total = 0
+    try:
+        with os.scandir(d) as it:
+            for e in it:
+                if e.name.endswith(suffix):
+                    n += 1
+                    total += e.stat().st_size
+    except OSError:
+        return (0, 0)
+    return (n, total)
+
+
+def stats() -> dict:
+    """Tier snapshot for ``tft.health()``: what is on disk right now."""
+    ckpt_n, ckpt_b = _dir_stats(_CKPT_DIR, ".ckpt")
+    res_n, res_b = _dir_stats(_RES_DIR, ".res")
+    return {
+        "enabled": enabled(),
+        "dir": root(),
+        "checkpoints": ckpt_n,
+        "checkpoint_bytes": ckpt_b,
+        "results": res_n,
+        "result_bytes": res_b,
+    }

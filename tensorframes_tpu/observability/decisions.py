@@ -36,6 +36,8 @@ ANOMALY_KINDS = (
     "memory.overflow_admit", "memory.wait", "mesh.shrink",
     "mesh.rebalance", "plan.oom_fallback", "dplan.fallback",
     "pipeline.sync_fallback", "engine.oom_split", "preempt.park",
+    "fabric.worker_lost", "fabric.worker_crash", "fabric.replace",
+    "fabric.admit_probe_failed",
 )
 
 
@@ -187,6 +189,60 @@ def _detail(r: Dict[str, Any]) -> str:
     if k == "stream.batch_skip":
         return (f"batch {r.get('batch')} poisoned ({r.get('error')}, "
                 f"classified {r.get('error_kind')}); skipped")
+    if k == "plan.result_cache_warm_hit":
+        return (f"result cache WARM hit: {r.get('blocks')} block(s) / "
+                f"{_fmt_bytes(r.get('bytes') or 0)} re-admitted from "
+                f"the durable tier (fingerprint "
+                f"{r.get('fingerprint')}…) — survived a restart")
+    if k == "plan.result_cache_persist":
+        return (f"result persisted to the durable tier "
+                f"({_fmt_bytes(r.get('bytes') or 0)}, fingerprint "
+                f"{r.get('fingerprint')}…)")
+    if k == "fabric.place":
+        return (f"tenant {r.get('tenant')!r} placed on "
+                f"{r.get('worker')} (least loaded: "
+                f"{r.get('tenants_on_worker')} tenant(s) there)")
+    if k == "fabric.replace":
+        return (f"tenant {r.get('tenant')!r} re-placed "
+                f"{r.get('source')} -> {r.get('worker')} "
+                f"({r.get('reason')})")
+    if k == "fabric.rebalance":
+        return (f"tenant {r.get('tenant')!r} re-placed "
+                f"{r.get('source')} -> {r.get('worker')}: SLO burn "
+                f"{r.get('burn_rate')}x vs hottest peer "
+                f"{r.get('peer_max')}x (> {r.get('factor')}x, "
+                f"TFT_FABRIC_BURN_FACTOR)")
+    if k == "fabric.worker_crash":
+        return (f"worker {r.get('worker')} (epoch {r.get('epoch')}) "
+                f"CRASHED: running queries parked to the durable "
+                f"tier, in-memory caches died with it")
+    if k == "fabric.worker_lost":
+        return (f"worker {r.get('worker')} declared LOST after "
+                f"{r.get('missed')} missed heartbeat(s) (classified "
+                f"{r.get('classified')}); tenants re-placed, queries "
+                f"re-dispatched")
+    if k == "fabric.heartbeat_miss":
+        return (f"worker {r.get('worker')} missed a heartbeat "
+                f"({r.get('missed')}/{r.get('limit')} before the "
+                f"lease expires)")
+    if k == "fabric.resume_dispatch":
+        cp = r.get("from_checkpoint")
+        return (f"re-dispatched to {r.get('worker')} "
+                f"({r.get('reason')}, attempt #{r.get('attempt')}): "
+                + (f"{r.get('resumed_blocks')} block(s) resume from "
+                   f"the persisted checkpoint" if cp
+                   else "no checkpoint found — cold re-run"))
+    if k == "fabric.worker_restart":
+        return (f"rolling restart of {r.get('worker')}: epoch "
+                f"{r.get('epoch')} -> {r.get('next_epoch')} (drain, "
+                f"persist, re-admit via probe)")
+    if k == "fabric.admit":
+        return (f"worker {r.get('worker')} (epoch {r.get('epoch')}) "
+                f"passed its admission probe")
+    if k == "fabric.admit_probe_failed":
+        return (f"worker {r.get('worker')} (epoch {r.get('epoch')}) "
+                f"FAILED its admission probe ({r.get('error')}); not "
+                f"admitted")
     skip = {"seq", "ts", "kind", "query"}
     kv = " ".join(f"{k2}={v!r}" for k2, v in r.items() if k2 not in skip)
     return kv or k
@@ -218,12 +274,19 @@ def why(query_id, scheduler=None) -> str:
     return "\n".join(lines)
 
 
-def doctor(max_per_kind: int = 5) -> str:
+def doctor(max_per_kind: int = 5,
+           flight_dumps: Optional[Any] = None) -> str:
     """Process-wide triage: the :func:`~.health.health` snapshot's
     vitals and warnings, the SLO burn table, and the recent anomalous
     decisions from the flight ring grouped by kind (newest
     ``max_per_kind`` each). The "what should I look at" report for a
-    process you did not watch."""
+    process you did not watch.
+
+    ``flight_dumps`` — a path or list of paths to per-worker
+    ``TFT_FLIGHT_DUMP`` JSONL files: they merge into the anomaly scan
+    via :func:`~.flight.load_dumps` (each record tagged with its
+    worker from the dump header), so one doctor() call triages a whole
+    fabric's worth of dead processes."""
     from .health import health as _health
     snap = _health()
     lines = ["tft.doctor() · process triage report"]
@@ -253,6 +316,16 @@ def doctor(max_per_kind: int = 5) -> str:
             f"worker(s), {serve['slots']} slot(s)")
     else:
         lines.append("  serve    : no scheduler running")
+    fab = snap.get("fabric") or {}
+    if fab.get("running"):
+        ps = fab.get("persist") or {}
+        lines.append(
+            f"  fabric   : {fab['name']!r} · {fab['live']}/"
+            f"{fab['workers']} worker(s) live, {fab['lost']} lost · "
+            f"{fab['queries']['inflight']} quer(ies) in flight · "
+            f"persist {_fmt_bytes((ps.get('checkpoint_bytes') or 0) + (ps.get('result_bytes') or 0))} "
+            f"({ps.get('checkpoints', 0)} ckpt / "
+            f"{ps.get('results', 0)} result)")
     for t, s in snap["slo"].items():
         if s["total"] == 0:
             continue
@@ -284,19 +357,28 @@ def doctor(max_per_kind: int = 5) -> str:
             lines.append(f"    ! {w}")
     else:
         lines.append("  WARNINGS : none")
+    pool = list(_flight.recent())
+    source = "flight ring"
+    if flight_dumps:
+        merged = _flight.load_dumps(flight_dumps)
+        pool = sorted(pool + merged,
+                      key=lambda r: (r.get("ts", 0), r.get("seq", 0)))
+        source = (f"flight ring + {len(merged)} record(s) from "
+                  f"per-worker dump(s)")
     by_kind: Dict[str, List[Dict[str, Any]]] = {}
-    for r in _flight.recent():
-        if r["kind"] in ANOMALY_KINDS:
+    for r in pool:
+        if r.get("kind") in ANOMALY_KINDS:
             by_kind.setdefault(r["kind"], []).append(r)
     if by_kind:
-        lines.append("  recent anomalous decisions (flight ring):")
+        lines.append(f"  recent anomalous decisions ({source}):")
         now = time.time()
         for k in sorted(by_kind):
             recs = by_kind[k][-max_per_kind:]
             lines.append(f"    {k} ({len(by_kind[k])} total):")
             for r in recs:
                 q = f" [{r['query']}]" if r.get("query") else ""
-                lines.append(f"      -{now - r['ts']:7.1f}s{q} "
+                w = f" w={r['worker']}" if r.get("worker") else ""
+                lines.append(f"      -{now - r['ts']:7.1f}s{q}{w} "
                              f"{_detail(r)}")
     else:
         lines.append("  recent anomalous decisions: none recorded")

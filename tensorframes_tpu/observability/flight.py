@@ -55,7 +55,7 @@ from ..utils.logging import get_logger
 
 __all__ = ["enabled", "record", "scope", "current_query", "recent",
            "for_query", "dump", "maybe_dump", "clear", "append_jsonl",
-           "stats"]
+           "stats", "set_worker_id", "current_worker", "load_dumps"]
 
 _log = get_logger("observability.flight")
 
@@ -97,15 +97,48 @@ def current_query() -> Optional[str]:
     return _query.get()
 
 
+# the worker identity dimension (serving fabric, docs/serving.md):
+# a process-level default (set_worker_id — one worker id per process in
+# a real multi-process fleet) plus a contextvar override for the
+# in-process fabric, where several simulated workers share one ring and
+# each scheduler execution must tag records with ITS worker, not a
+# process global.
+_worker_default: Optional[str] = None
+_worker: "contextvars.ContextVar[Optional[str]]" = \
+    contextvars.ContextVar("tft_flight_worker", default=None)
+
+
+def set_worker_id(worker_id: Optional[str]) -> Optional[str]:
+    """Set the process-default worker id stamped on every record (and
+    on dump headers). Returns the previous value."""
+    global _worker_default
+    prev = _worker_default
+    _worker_default = str(worker_id) if worker_id is not None else None
+    return prev
+
+
+def current_worker() -> Optional[str]:
+    """The ambient worker id: the scope override when inside one, else
+    the process default."""
+    w = _worker.get()
+    return w if w is not None else _worker_default
+
+
 @contextlib.contextmanager
-def scope(query_id: str) -> Iterator[None]:
+def scope(query_id: str,
+          worker: Optional[str] = None) -> Iterator[None]:
     """Correlate every decision recorded inside the body to
     ``query_id`` (nested scopes shadow; the serve scheduler scopes each
-    query's execution with its serving id)."""
+    query's execution with its serving id). ``worker`` additionally
+    tags records with the executing worker's id (the fabric sets each
+    scheduler's ``worker_id``; ``None`` leaves the ambient worker)."""
     token = _query.set(str(query_id))
+    wtoken = _worker.set(str(worker)) if worker is not None else None
     try:
         yield
     finally:
+        if wtoken is not None:
+            _worker.reset(wtoken)
         _query.reset(token)
 
 
@@ -123,6 +156,9 @@ def record(kind: str, query: Optional[str] = None, **inputs) -> None:
     q = query if query is not None else _query.get()
     if q is not None:
         rec["query"] = q
+    w = current_worker()
+    if w is not None and "worker" not in inputs:
+        rec["worker"] = w
     if inputs:
         rec.update(inputs)
     global _recorded
@@ -213,13 +249,14 @@ def append_jsonl(path: str, lines: List[str]) -> None:
 # ---------------------------------------------------------------------------
 
 def dump(path: Optional[str] = None,
-         reason: str = "manual") -> Optional[str]:
+         reason: str = "manual",
+         worker: Optional[str] = None) -> Optional[str]:
     """Write the ring as one JSONL snapshot — a ``flight_dump`` header
-    line (reason, timestamp, record count) followed by one line per
-    decision — to ``path`` (default ``TFT_FLIGHT_DUMP``). Returns the
-    path written, or None (no path configured / recorder bypassed).
-    A failed write degrades to a warning log, never raises into the
-    query that triggered it."""
+    line (reason, timestamp, record count, and the dumping ``worker``
+    when one is known) followed by one line per decision — to ``path``
+    (default ``TFT_FLIGHT_DUMP``). Returns the path written, or None
+    (no path configured / recorder bypassed). A failed write degrades
+    to a warning log, never raises into the query that triggered it."""
     if not enabled():
         return None
     path = path or os.environ.get("TFT_FLIGHT_DUMP")
@@ -229,6 +266,9 @@ def dump(path: Optional[str] = None,
         records = list(_ring)
     head = {"type": "flight_dump", "reason": reason, "ts": time.time(),
             "records": len(records)}
+    w = worker if worker is not None else current_worker()
+    if w is not None:
+        head["worker"] = w
     lines = [json.dumps(head, default=str)]
     lines.extend(json.dumps(r, default=str) for r in records)
     try:
@@ -252,6 +292,49 @@ def maybe_dump(reason: str) -> Optional[str]:
     if not os.environ.get("TFT_FLIGHT_DUMP"):
         return None
     return dump(reason=reason)
+
+
+def load_dumps(paths) -> List[Dict[str, Any]]:
+    """Merge per-worker JSONL flight dumps back into one decision
+    stream (``tft.doctor(flight_dumps=[...])``). Each file is the
+    :func:`dump` format: ``flight_dump`` header lines carry the
+    dumping worker's id, which is attributed to every following record
+    that lacks its own ``worker`` field. Records merge across files
+    sorted by wall-clock ``ts`` then ``seq`` — per-process seqs are
+    independent, but ts orders the fleet's decisions well enough for a
+    post-mortem. Unreadable files and malformed lines are skipped with
+    a warning (a post-mortem tool must salvage what it can)."""
+    if isinstance(paths, str):
+        paths = [paths]
+    merged: List[Dict[str, Any]] = []
+    for path in paths:
+        try:
+            with open(path) as f:
+                text = f.read()
+        except OSError as e:
+            _log.warning("flight dump %s unreadable: %s", path, e)
+            continue
+        header_worker: Optional[str] = None
+        for ln, line in enumerate(text.splitlines(), 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                _log.warning("flight dump %s:%d: malformed line "
+                             "skipped", path, ln)
+                continue
+            if not isinstance(rec, dict):
+                continue
+            if rec.get("type") == "flight_dump":
+                header_worker = rec.get("worker")
+                continue
+            if "worker" not in rec and header_worker is not None:
+                rec["worker"] = header_worker
+            merged.append(rec)
+    merged.sort(key=lambda r: (r.get("ts", 0), r.get("seq", 0)))
+    return merged
 
 
 @atexit.register

@@ -126,6 +126,22 @@ def _serve_section() -> Dict[str, Any]:
     }
 
 
+def _fabric_section() -> Dict[str, Any]:
+    try:
+        from ..serve.fabric import live_fabric
+        fab = live_fabric()
+    except Exception as e:  # noqa: BLE001 - optional subsystem
+        _log.debug("health: fabric unavailable: %s", e)
+        fab = None
+    if fab is None:
+        return {"running": False}
+    try:
+        return fab.health_snapshot()
+    except Exception as e:  # noqa: BLE001 - a closing fabric is not news
+        _log.debug("health: fabric snapshot failed: %s", e)
+        return {"running": False}
+
+
 def _cache_section(counts: Dict[str, int]) -> Dict[str, Any]:
     def ratio(hits: int, misses: int):
         total = hits + misses
@@ -208,6 +224,12 @@ def _warnings(snap: Dict[str, Any]) -> List[str]:
                     f"serve: tenant {t!r} had {s['shed']} shed / "
                     f"{s['rejected']} rejected quer(ies) — admission "
                     f"or queue pressure")
+    fab = snap.get("fabric") or {}
+    if fab.get("running") and fab.get("lost"):
+        warns.append(
+            f"fabric: {fab['lost']} worker(s) declared lost — their "
+            f"tenants re-placed and queries re-dispatched; restart "
+            f"them (ServeFabric.restart_worker) to restore capacity")
     for t, s in snap["slo"].items():
         burn = s.get("burn_rate")
         if burn is not None and burn > 1.0:
@@ -236,6 +258,7 @@ def health() -> Dict[str, Any]:
         "memory": _memory_section(counts),
         "mesh": _mesh_section(counts),
         "serve": _serve_section(),
+        "fabric": _fabric_section(),
         "caches": _cache_section(counts),
         "streams": _stream_section(),
         "slo": _slo.slo_status(),

@@ -42,7 +42,7 @@ from .distributed import DistributedFrame
 from .mesh import DeviceMesh
 
 __all__ = ["initialize", "cluster_mesh", "distribute_local",
-           "process_index", "process_count"]
+           "process_index", "process_count", "process_identity"]
 
 _log = get_logger("parallel.cluster")
 
@@ -225,6 +225,22 @@ def process_index() -> int:
 
 def process_count() -> int:
     return jax.process_count()
+
+
+def process_identity() -> str:
+    """A stable worker-id string for THIS process (``p<i>of<n>``).
+
+    The serving fabric's per-process identity in real multi-process
+    deployments: ``serve/fabric.py`` seeds worker ids from it and the
+    flight recorder stamps it on records and dump headers
+    (``TFT_FLIGHT_DUMP``), so per-process JSONL dumps merge
+    unambiguously in ``tft.doctor()``. Safe before :func:`initialize`
+    (a single uninitialized process is ``p0of1``)."""
+    try:
+        return f"p{jax.process_index()}of{jax.process_count()}"
+    except Exception as e:
+        _log.debug("process_identity before backend init: %s", e)
+        return "p0of1"
 
 
 def cluster_mesh(axis_names: Sequence[str] = ("data",),

@@ -54,7 +54,14 @@ chosen once from static estimates. This module is the feedback half
    any source-version change
    (parquet append, ``uncache()``) changes the key, so stale entries
    can never hit and age out of the LRU. ``TFT_RESULT_CACHE=0`` turns
-   the whole leg off.
+   the whole leg off. When the durable tier is on
+   (``TFT_PERSIST_DIR``, ``memory/persist.py``), parquet-rooted
+   entries also write through under a PORTABLE fingerprint
+   (:func:`portable_fingerprint` — footer identity + structural
+   computation signatures, no process-local ``id()``s), and a memory
+   miss falls through to disk before reporting cold: a restarted
+   worker serves the same plan with zero dispatches, counted
+   separately as ``plan.result_cache_warm_hits``.
 
 ``TFT_ADAPTIVE=0`` disables legs 1 and 2 wholesale; every unprovable
 case (non-row-local ops, ragged inputs, an active preemption scope —
@@ -64,6 +71,7 @@ today's layout bit-identically.
 
 from __future__ import annotations
 
+import hashlib
 import os
 import threading
 import time
@@ -79,7 +87,8 @@ from ..utils.tracing import counters, gauge
 
 __all__ = ["enabled", "result_cache_enabled", "replan_ratio",
            "StreamFeedback", "record_stream_feedback", "stream_feedback",
-           "Layout", "choose_layout", "fingerprint", "cached_result",
+           "Layout", "choose_layout", "fingerprint",
+           "portable_fingerprint", "cached_result",
            "offer_result", "invalidate_results", "result_cache_stats",
            "AdaptiveBatcher"]
 
@@ -499,27 +508,127 @@ def fingerprint(frame) -> Optional[Tuple[tuple, list, list]]:
     return key, validators, comps
 
 
+def _portable_node_fp(node) -> Optional[tuple]:
+    """Process-independent fingerprint of one plan node, or ``None``
+    when the node's identity is process-local (``source`` pins a live
+    frame by ``id()``; joins fold those in). Parquet leaves are already
+    portable (footer identity: path + mtime + size); computations swap
+    their ``id()`` for the structural signature the compile cache
+    shares across workers (``serve/cache.py``)."""
+    kind = node.kind
+    if kind == "parquet":
+        try:
+            st = os.stat(node.path)
+        except OSError:
+            return None
+        return ("pq", node.path, st.st_mtime_ns, st.st_size,
+                node.row_group_offset, node.row_group_limit,
+                node.columns, node.num_partitions)
+    if kind in ("map_blocks", "map_rows", "filter"):
+        from ..serve.cache import computation_signature
+        sig = computation_signature(node.comp)
+        if sig is None:
+            return None
+        if kind == "map_blocks":
+            return ("mb", sig, node.trim)
+        return ("mr" if kind == "map_rows" else "f", sig)
+    if kind == "select":
+        return ("sel", node.names)
+    return None  # source/join: identity is this process's memory
+
+
+def portable_fingerprint(frame) -> Optional[str]:
+    """A fingerprint of ``frame``'s chain that means the same thing in
+    ANOTHER process, or ``None`` when the chain has no portable
+    identity. This is the durable result tier's key
+    (``memory/persist.py``): a restarted worker that rebuilds the same
+    parquet-rooted chain derives the same digest and serves the
+    persisted result with zero dispatches — a warm hit. Chains rooted
+    in in-memory frames are never persisted (their identity dies with
+    the process that built them)."""
+    node = getattr(frame, "_plan_node", None)
+    if node is None:
+        return None
+    parts: List[tuple] = []
+    has_pq = False
+    depth = 0
+    while node is not None and depth < 256:
+        fp = _portable_node_fp(node)
+        if fp is None:
+            return None
+        has_pq = has_pq or fp[0] == "pq"
+        parts.append(fp)
+        node = node.input
+        depth += 1
+    if node is not None or len(parts) < 2 or not has_pq:
+        return None
+    raw = repr((tuple(parts), getattr(frame, "_version", 0)))
+    return hashlib.sha256(raw.encode()).hexdigest()
+
+
+def _warm_lookup(frame, key, validators, comps) -> Optional[List]:
+    """The durable tier's half of a miss: load the persisted result
+    for the frame's PORTABLE fingerprint and re-admit it into the
+    in-memory LRU under the live key. Counted separately
+    (``plan.result_cache_warm_hits``) — a warm hit is a restart
+    surviving, not a repeat forcing."""
+    from ..memory import persist as _persist
+    if not _persist.enabled():
+        return None
+    pfp = portable_fingerprint(frame)
+    if pfp is None:
+        return None
+    blocks = _persist.load_result(pfp)
+    if blocks is None:
+        return None
+    from ..memory.estimate import blocks_estimate
+    _, nbytes = blocks_estimate(blocks)
+    max_bytes, max_entries = _rc_budget()
+    if nbytes <= max_bytes:
+        entry = _CacheEntry(key, list(blocks), int(nbytes), comps,
+                            validators)
+        with _rc_lock:
+            if key not in _results:
+                _admit_locked(key, entry, max_bytes, max_entries)
+    counters.inc("plan.result_cache_warm_hits")
+    counters.inc("plan.result_cache_hit_bytes", int(nbytes))
+    from ..observability import flight as _flight
+    from ..observability.events import add_event
+    add_event("result_cache_warm_hit", name=frame._plan,
+              bytes=int(nbytes), blocks=len(blocks))
+    _flight.record("plan.result_cache_warm_hit", bytes=int(nbytes),
+                   blocks=len(blocks), fingerprint=pfp[:16])
+    _log.info("warm result-cache hit for %s from the durable tier "
+              "(%d block(s), %d B)", frame._plan, len(blocks), nbytes)
+    return list(blocks)
+
+
 def cached_result(frame) -> Optional[List]:
     """The interned blocks for ``frame``'s fingerprint, or ``None``
-    (miss / disabled / unfingerprintable)."""
+    (miss / disabled / unfingerprintable). A memory miss falls through
+    to the durable tier (:func:`_warm_lookup`) before reporting cold."""
     if not result_cache_enabled():
         return None
     fp = fingerprint(frame)
     if fp is None:
         return None
-    key = fp[0]
+    key, validators, comps = fp
     with _rc_lock:
         entry = _results.get(key)
         if entry is not None and not entry.valid():
             _results.pop(key, None)
             counters.inc("plan.result_cache_invalidations")
             entry = None
-        if entry is None:
-            # the "seen" mark is recorded by offer_result AFTER the
-            # forcing, so admission counts FORCINGS, not lookups
-            counters.inc("plan.result_cache_misses")
-            return None
-        _results.move_to_end(key)
+        if entry is not None:
+            _results.move_to_end(key)
+    if entry is None:
+        warm = _warm_lookup(frame, key, validators, comps)
+        if warm is not None:
+            return warm
+        # the "seen" mark is recorded by offer_result AFTER the
+        # forcing, so admission counts FORCINGS, not lookups
+        counters.inc("plan.result_cache_misses")
+        return None
     counters.inc("plan.result_cache_hits")
     counters.inc("plan.result_cache_hit_bytes", entry.nbytes)
     from ..observability import flight as _flight
@@ -531,6 +640,26 @@ def cached_result(frame) -> Optional[List]:
     _log.debug("result cache hit for %s (%d block(s), %d B)",
                frame._plan, len(entry._cache), entry.nbytes)
     return list(entry._cache)
+
+
+def _admit_locked(key, entry: _CacheEntry, max_bytes: int,
+                  max_entries: int) -> List[_CacheEntry]:
+    """Insert ``entry`` and LRU-sweep to budget. Caller holds
+    ``_rc_lock``. Returns the evicted entries."""
+    evicted: List[_CacheEntry] = []
+    _results[key] = entry
+    total = sum(e.nbytes for e in _results.values())
+    while _results and (total > max_bytes
+                        or len(_results) > max_entries):
+        _, old = _results.popitem(last=False)
+        total -= old.nbytes
+        evicted.append(old)
+    counters.inc("plan.result_cache_insertions")
+    if evicted:
+        counters.inc("plan.result_cache_evictions", len(evicted))
+    gauge("plan.result_cache_bytes", total)
+    gauge("plan.result_cache_entries", len(_results))
+    return evicted
 
 
 def offer_result(frame, blocks) -> None:
@@ -560,24 +689,23 @@ def offer_result(frame, blocks) -> None:
             return
         entry = _CacheEntry(key, list(blocks), int(nbytes), comps,
                             validators)
-        _results[key] = entry
-        total = sum(e.nbytes for e in _results.values())
-        while _results and (total > max_bytes
-                            or len(_results) > max_entries):
-            _, old = _results.popitem(last=False)
-            total -= old.nbytes
-            evicted.append(old)
-        counters.inc("plan.result_cache_insertions")
-        if evicted:
-            counters.inc("plan.result_cache_evictions", len(evicted))
-        gauge("plan.result_cache_bytes", total)
-        gauge("plan.result_cache_entries", len(_results))
+        evicted = _admit_locked(key, entry, max_bytes, max_entries)
     from ..observability import flight as _flight
     _flight.record("plan.result_cache_admit", bytes=int(nbytes),
                    entries=len(blocks))
     if evicted:
         _flight.record("plan.result_cache_evict", entries=len(evicted),
                        bytes=sum(e.nbytes for e in evicted))
+    from ..memory import persist as _persist
+    if _persist.enabled():
+        # write-through to the durable tier under the PORTABLE key:
+        # a rolling restart then serves this result warm, zero
+        # dispatches (process-local chains have no portable key and
+        # stay memory-only)
+        pfp = portable_fingerprint(frame)
+        if pfp is not None and _persist.save_result(pfp, list(blocks)):
+            _flight.record("plan.result_cache_persist",
+                           bytes=int(nbytes), fingerprint=pfp[:16])
 
 
 def invalidate_results() -> None:
@@ -604,6 +732,9 @@ _FAMILIES = (
      "Forcings served from the plan-fingerprint result cache."),
     ("plan.result_cache_misses", "tft_plan_result_cache_misses_total",
      "Result-cache lookups that missed."),
+    ("plan.result_cache_warm_hits",
+     "tft_plan_result_cache_warm_hits_total",
+     "Memory misses served from the durable tier (restart survived)."),
     ("plan.result_cache_hit_bytes",
      "tft_plan_result_cache_hit_bytes_total",
      "Host bytes served from the result cache."),

@@ -36,8 +36,9 @@ documented in ``docs/resilience.md``.
 
 from .classify import (AdmissionDeadline, DeviceLost, OverQuota,
                        QueryCancelled, QueryInterrupted, QueryPreempted,
-                       QueueFull, ServeRejected, error_kind,
-                       is_device_lost, is_oom, is_permanent, is_transient)
+                       QueueFull, ServeRejected, WorkerLost, error_kind,
+                       is_device_lost, is_oom, is_permanent, is_transient,
+                       is_worker_lost)
 from .faults import InjectedFault, inject
 from .policy import (DEFAULT_POLICY, ClusterInitError, DeadlineExceeded,
                      RetryPolicy, check_deadline, deadline, default_policy,
@@ -49,9 +50,9 @@ __all__ = [
     "DEFAULT_POLICY", "default_policy", "deadline", "remaining_time",
     "check_deadline",
     "is_transient", "is_oom", "is_permanent", "is_device_lost",
-    "error_kind",
+    "is_worker_lost", "error_kind",
     "ServeRejected", "QueueFull", "OverQuota", "AdmissionDeadline",
-    "DeviceLost",
+    "DeviceLost", "WorkerLost",
     "QueryInterrupted", "QueryPreempted", "QueryCancelled",
     "env_bool", "env_float", "env_int",
     "faults", "inject", "InjectedFault",

@@ -13,6 +13,12 @@ Three buckets, three responses:
   ``device`` fault site): neither retrying nor splitting helps; the
   elastic layer (``parallel.elastic``) rebuilds a shrunken mesh over
   the surviving devices, re-shards, and re-runs the op.
+- **worker_lost** — a serving WORKER PROCESS died (missed heartbeats on
+  the fabric coordinator, the ``worker`` fault site): like a lost
+  device, retrying against the dead worker is pointless; the recovery
+  is structural — the serving fabric (``serve/fabric.py``) re-places
+  the worker's tenants and resumes its running queries from their
+  persisted checkpoints on a survivor.
 - **permanent** — everything else (shape errors, type errors, compile
   diagnostics): fail fast, loudly, once.
 
@@ -27,11 +33,12 @@ from __future__ import annotations
 import os
 
 __all__ = ["is_transient", "is_oom", "is_permanent", "is_device_lost",
-           "error_kind",
+           "is_worker_lost", "error_kind",
            "ServeRejected", "QueueFull", "OverQuota", "AdmissionDeadline",
-           "DeviceLost",
+           "DeviceLost", "WorkerLost",
            "QueryInterrupted", "QueryPreempted", "QueryCancelled",
-           "TRANSIENT_MARKERS", "OOM_MARKERS", "DEVICE_LOST_MARKERS"]
+           "TRANSIENT_MARKERS", "OOM_MARKERS", "DEVICE_LOST_MARKERS",
+           "WORKER_LOST_MARKERS"]
 
 
 class DeviceLost(RuntimeError):
@@ -44,6 +51,20 @@ class DeviceLost(RuntimeError):
     """
 
     kind = "device_lost"
+
+
+class WorkerLost(RuntimeError):
+    """A serving worker process is gone (crash, eviction, missed
+    heartbeats past the fabric's lease). The process-group analogue of
+    :class:`DeviceLost`: retrying against the dead worker would fail
+    identically, so this is NOT transient; the recovery is structural —
+    the serving fabric (``serve/fabric.py``) re-places the worker's
+    tenants across the survivors and resumes its running queries from
+    their persisted checkpoints (``memory/persist.py``), cold re-running
+    only on a checkpoint mismatch. Classified ``worker_lost``.
+    """
+
+    kind = "worker_lost"
 
 
 class QueryInterrupted(RuntimeError):
@@ -151,6 +172,19 @@ DEVICE_LOST_MARKERS = (
     "lost device",
 )
 
+# Status words that indicate a serving WORKER PROCESS died, not the
+# program: missed-heartbeat declarations from the fabric coordinator and
+# the `worker` fault site surface under these. Checked BEFORE the
+# transient markers for the same reason as DEVICE_LOST: the recovery is
+# re-placement, never a retry against the dead worker.
+WORKER_LOST_MARKERS = (
+    "WORKER_LOST",
+    "worker lost",
+    "worker is lost",
+    "lost worker",
+    "worker process died",
+)
+
 
 def _extra_transient_markers() -> tuple:
     """Operator-extensible marker list: ``TFT_TRANSIENT_ERRORS`` is a
@@ -178,6 +212,16 @@ def is_device_lost(exc: BaseException) -> bool:
     return any(m in msg for m in DEVICE_LOST_MARKERS)
 
 
+def is_worker_lost(exc: BaseException) -> bool:
+    """True when a serving worker process is gone — NOT retried as-is;
+    the serving fabric (``serve/fabric.py``) re-places its tenants and
+    resumes its queries from their persisted checkpoints."""
+    if isinstance(exc, WorkerLost):
+        return True
+    msg = str(exc)
+    return any(m in msg for m in WORKER_LOST_MARKERS)
+
+
 def is_transient(exc: BaseException) -> bool:
     """True when retrying the same operation may legitimately succeed."""
     from .faults import InjectedFault
@@ -193,6 +237,8 @@ def is_transient(exc: BaseException) -> bool:
         return exc.retryable  # queue drains / bucket refills; sheds don't
     if is_device_lost(exc):
         return False  # same program, same dead device: shrink, don't retry
+    if is_worker_lost(exc):
+        return False  # same dead worker: re-place, don't retry
     if is_oom(exc):
         return False  # same program, same memory: split, don't retry
     if isinstance(exc, (ConnectionError, TimeoutError)):
@@ -221,6 +267,8 @@ def error_kind(exc: BaseException) -> str:
         return exc.kind
     if is_device_lost(exc):
         return "device_lost"
+    if is_worker_lost(exc):
+        return "worker_lost"
     if is_oom(exc):
         return "oom"
     if is_transient(exc):

@@ -37,6 +37,13 @@ preempt      ``engine.preempt.boundary`` pipelined block boundary — NOT
              converts the fault into a preempt request, so
              ``TFT_FAULTS=preempt:N`` deterministically parks a running
              query at its next N block boundaries (``docs/serving.md``)
+worker       ``engine.preempt.boundary`` (running query) and
+             ``serve.fabric`` heartbeat (idle worker) — like ``preempt``
+             it is NOT raised out of the query: the scope parks the
+             query (checkpoint persisted) and flags the worker as
+             crashed, so ``TFT_FAULTS=worker:1`` deterministically kills
+             one serving worker mid-query; the fabric declares it
+             ``worker_lost`` and resumes elsewhere (``docs/serving.md``)
 ========== ===========================================================
 
 Counting is deterministic (a lock-guarded integer per site, decremented
@@ -97,6 +104,11 @@ _OOM_MESSAGE = ("RESOURCE_EXHAUSTED: injected fault: out of memory "
 _DEVICE_MESSAGE = ("DEVICE_LOST: injected fault: device %d is lost "
                    "(chip failure simulated)")
 
+# the "worker" site must be caught by classify.is_worker_lost (fabric
+# re-placement + checkpoint resume), never the retry loop
+_WORKER_MESSAGE = ("WORKER_LOST: injected fault: worker process died "
+                   "(crash simulated)")
+
 
 def _arm_from_env() -> None:
     """Parse ``TFT_FAULTS="site:count,site:count"`` once per process."""
@@ -137,6 +149,11 @@ def arm(site: str, fail_n: int = 1, message: Optional[str] = None,
         if message is None:
             from .policy import env_int
             message = _DEVICE_MESSAGE % env_int("TFT_FAULT_DEVICE", 0)
+        if transient is None:
+            transient = False
+    elif site == "worker":
+        if message is None:
+            message = _WORKER_MESSAGE
         if transient is None:
             transient = False
     elif transient is None:

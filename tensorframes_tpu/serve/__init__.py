@@ -20,6 +20,14 @@ window — and this package composes them:
 - :class:`ServerStats` / :func:`serve_report` (:mod:`.stats`) — per-
   tenant outcome totals, live queue/in-flight gauges on the metrics
   endpoint, p99 from ``query_latency_seconds{tenant=...}``.
+- :class:`ServeFabric` (:mod:`.fabric`) — the multi-host tier: tenants
+  sharded across worker processes with heartbeat/lease health, a
+  classified ``worker_lost`` failure path (queued queries re-placed,
+  running queries resumed from persisted checkpoints on a survivor —
+  never wrong, never dropped), SLO-burn-driven re-placement, and
+  rolling restarts that come back warm from the durable tier
+  (``memory/persist.py``). ``TFT_FABRIC=0`` collapses it to the
+  single-process path bit-identically.
 
 Entry points: ``tft.submit(df, tenant=..., deadline=...)`` (the
 process-default scheduler) or an explicit ``QueryScheduler`` as a
@@ -27,6 +35,8 @@ context manager. See ``docs/serving.md``.
 """
 
 from .cache import SharedCompileCache, computation_signature
+from .fabric import (FabricQuery, FabricWorker, ServeFabric,
+                     fabric_enabled, live_fabric)
 from .scheduler import (QueryScheduler, SubmittedQuery, TenantQuota,
                         default_scheduler, live_scheduler,
                         set_default_scheduler, shutdown_default_scheduler)
@@ -38,4 +48,6 @@ __all__ = [
     "shutdown_default_scheduler", "live_scheduler",
     "SharedCompileCache", "computation_signature",
     "ServerStats", "serve_report",
+    "ServeFabric", "FabricQuery", "FabricWorker",
+    "live_fabric", "fabric_enabled",
 ]

@@ -332,6 +332,10 @@ class QueryScheduler:
         self._vtime = 0.0
         self._qid = itertools.count(1)
         self._open = True
+        # a lost worker's scheduler: stops picking work and orphans
+        # parked queries, but close() (full teardown, thread joins)
+        # still runs later from another thread — see mark_lost()
+        self._dying = False
         self._admission = admission
         self._preemption = (preemption if preemption is not None
                             else env_bool("TFT_SERVE_PREEMPT", True))
@@ -344,9 +348,20 @@ class QueryScheduler:
                                 max(1, self.workers)
                                 * _pipeline.pipeline_depth()))
         self.slot_pool = _pipeline.SlotPool(max(1, n_slots))
-        use_cache = (shared_cache if shared_cache is not None
-                     else env_bool("TFT_SERVE_SHARED_CACHE", True))
-        self.compile_cache = SharedCompileCache() if use_cache else None
+        if isinstance(shared_cache, SharedCompileCache):
+            # an explicit cache INSTANCE: the serving fabric hands every
+            # worker the same one, so structurally-identical computations
+            # compile once per fleet, not once per worker
+            self.compile_cache = shared_cache
+        else:
+            use_cache = (shared_cache if shared_cache is not None
+                         else env_bool("TFT_SERVE_SHARED_CACHE", True))
+            self.compile_cache = SharedCompileCache() if use_cache else None
+        # set by the serving fabric: worker_id tags this scheduler's
+        # flight records; on_worker_fault(self) fires when a running
+        # query's park was caused by the `worker` fault site
+        self.worker_id: Optional[str] = None
+        self.on_worker_fault = None
         for tname, quota in (quotas or {}).items():
             self._tenants[tname] = _Tenant(tname, quota)
         self._threads: List[threading.Thread] = []
@@ -386,6 +401,20 @@ class QueryScheduler:
     def __exit__(self, *exc) -> bool:
         self.close()
         return False
+
+    def mark_lost(self) -> None:
+        """Flag this scheduler as dying WITHOUT joining its threads.
+
+        The serving fabric's worker-fault hook runs on the victim's own
+        worker thread — a full :meth:`close` there would self-join.
+        This flips the kill switch synchronously instead: workers stop
+        picking queries, new submits are refused, and a parked query's
+        requeue takes the orphan path (a classified rejection the
+        fabric reads as *migrating*, not failed). A later :meth:`close`
+        from another thread still runs the full teardown."""
+        with self._cond:
+            self._dying = True
+            self._cond.notify_all()
 
     def close(self, timeout: float = 30.0) -> None:
         """Stop accepting, fail still-queued queries with a classified
@@ -486,7 +515,9 @@ class QueryScheduler:
     def submit(self, frame, fetches=None, *, tenant: str = "default",
                deadline: Optional[float] = None,
                est_rows: Optional[float] = None,
-               est_bytes: Optional[int] = None) -> SubmittedQuery:
+               est_bytes: Optional[int] = None,
+               query_id: Optional[str] = None,
+               _checkpoint=None) -> SubmittedQuery:
         """Queue one query: force ``frame`` (after applying ``fetches``
         via ``map_blocks`` when given) under the tenant's quotas.
 
@@ -494,6 +525,13 @@ class QueryScheduler:
         :class:`~..resilience.OverQuota` (rows/sec budget) — both
         classified, both *before* any work happens. Returns a
         :class:`SubmittedQuery` future otherwise.
+
+        ``query_id`` / ``_checkpoint`` are the serving fabric's
+        re-dispatch hooks (``serve/fabric.py``): a query migrated off a
+        lost worker re-submits under its ORIGINAL id carrying its
+        persisted checkpoint, so ``tft.why(query_id)`` shows one causal
+        chain across workers and the resume re-dispatches only the
+        blocks the dead worker never finished.
         """
         if fetches is None:
             def thunk(frame=frame):
@@ -509,7 +547,7 @@ class QueryScheduler:
             est_rows = est_rows if est_rows is not None else rows_guess
             est_bytes = est_bytes if est_bytes is not None else bytes_guess
         with self._cond:
-            if not self._open:
+            if not self._open or self._dying:
                 raise RuntimeError(
                     f"scheduler {self.name!r} is closed")
             t = self._tenant(tenant)
@@ -542,10 +580,12 @@ class QueryScheduler:
                 parts = max(1, getattr(frame, "num_partitions", 1) or 1)
                 est_stream = max(1, int(est_bytes / parts))
             q = SubmittedQuery(
-                f"{self.name}-q{next(self._qid)}", tenant, thunk,
-                est_rows, est_bytes,
+                query_id or f"{self.name}-q{next(self._qid)}", tenant,
+                thunk, est_rows, est_bytes,
                 time.monotonic() + dl if dl is not None else None,
                 est_stream_bytes=est_stream)
+            if _checkpoint is not None:
+                q._checkpoint = _checkpoint
             was_empty = not t.queue
             t.queue.append(q)
             self._queries[q.query_id] = q
@@ -680,7 +720,7 @@ class QueryScheduler:
     def _next(self, block: bool) -> Optional[SubmittedQuery]:
         with self._cond:
             while True:
-                if not self._open:
+                if not self._open or self._dying:
                     return None
                 t = self._pick_locked()
                 if t is not None:
@@ -715,7 +755,7 @@ class QueryScheduler:
         return True
 
     def _execute(self, q: SubmittedQuery) -> None:
-        with _flight.scope(q.query_id):
+        with _flight.scope(q.query_id, worker=self.worker_id):
             self._execute_scoped(q)
 
     def _execute_scoped(self, q: SubmittedQuery) -> None:
@@ -810,10 +850,24 @@ class QueryScheduler:
         scope = q._scope
         if scope is not None and scope.checkpoint is not None:
             q._checkpoint = scope.checkpoint
+        worker_fault = scope is not None and \
+            getattr(scope, "worker_fault", False)
         q._scope = None
+        if worker_fault and self.on_worker_fault is not None:
+            # the `worker` fault site fired during this query: tell the
+            # fabric BEFORE taking our lock (its handler may close this
+            # scheduler, which takes _cond — holding it here would
+            # deadlock); the query still requeues below so the fabric
+            # finds it in the dead worker's queue and re-places it
+            try:
+                self.on_worker_fault(self)
+            except Exception as e:
+                _log.warning("on_worker_fault hook failed: %s", e)
         with self._cond:
-            if not self._open:
-                # lost the race with close(): fail like any orphan
+            if not self._open or self._dying:
+                # lost the race with close()/mark_lost(): fail like any
+                # orphan — the fabric reads this rejection from a dead
+                # worker as "migrating" and re-dispatches elsewhere
                 self._queries.pop(q.query_id, None)
                 t.inflight -= 1
                 t.counts["rejected"] += 1
@@ -1000,6 +1054,12 @@ class QueryScheduler:
                 result: Any = None,
                 error: Optional[BaseException] = None) -> None:
         q._complete(result=result, error=error)
+        from ..memory import persist as _persist
+        if _persist.enabled():
+            # a TERMINAL state is the only point the durable checkpoint
+            # dies: close()'s orphan path keeps the file so the fabric
+            # can resume the query in another worker (serve/fabric.py)
+            _persist.discard_checkpoint(q.query_id)
         dur = q.finished_at - q.submitted_at  # end-to-end serving latency
         if error is None:
             outcome = "ok"
@@ -1028,6 +1088,24 @@ class QueryScheduler:
             t.counts[key] += 1
             gauge("serve.inflight", self._inflight_locked())
             self._cond.notify_all()
+
+    def request_park_all(self, reason: str = "drain") -> int:
+        """Ask every RUNNING query to park at its next block boundary
+        (their checkpoints write through to the durable tier when it is
+        on). The fabric's crash/drain primitive: called before
+        :meth:`close` so a simulated worker death leaves resumable
+        checkpoints instead of completed queries. Returns the number of
+        queries asked."""
+        with self._cond:
+            scopes = [sc for q in self._queries.values()
+                      for sc in (q._scope,)
+                      if q.state == "running" and sc is not None]
+        for sc in scopes:
+            sc.request_preempt(reason)
+        if scopes:
+            _log.info("scheduler %r: park requested for %d running "
+                      "query(ies) (%s)", self.name, len(scopes), reason)
+        return len(scopes)
 
     # -- introspection -----------------------------------------------------
     def snapshot(self) -> Dict[str, Dict[str, Any]]:

@@ -163,14 +163,25 @@ class ServerStats:
 def serve_report(scheduler=None) -> str:
     """The serving layer's ``explain()``: per-tenant queues, in-flight,
     outcome totals, p99, and shared-compile-cache behavior. Uses the
-    most recently created live scheduler when none is given."""
+    most recently created live scheduler when none is given. When a
+    :class:`~.fabric.ServeFabric` is live, its placement table
+    (worker epochs, lease state, tenant placement, durable-tier
+    footprint) is appended."""
     if scheduler is None:
         from .scheduler import live_scheduler
         scheduler = live_scheduler()
     if scheduler is None:
         return ("(no scheduler running — create a serve.QueryScheduler "
                 "or submit a query through tft.submit())")
-    return ServerStats(scheduler).render()
+    out = ServerStats(scheduler).render()
+    try:
+        from .fabric import live_fabric
+        fab = live_fabric()
+    except Exception:  # noqa: BLE001 - report must render regardless
+        fab = None
+    if fab is not None:
+        out = out + "\n\n" + fab.placement_report()
+    return out
 
 
 # ---------------------------------------------------------------------------

@@ -128,6 +128,14 @@ def pytest_configure(config):
                    "provider conformance (run-tests.sh --flight runs "
                    "this lane standalone)")
     config.addinivalue_line(
+        "markers", "fabric: multi-host serving-fabric suite — tenant "
+                   "sharding across workers, heartbeat/lease worker "
+                   "loss with checkpointed cross-worker resume "
+                   "(bit-identical), durable checkpoint/result tiers "
+                   "surviving rolling restarts warm, SLO-burn-driven "
+                   "re-placement, TFT_FABRIC=0 single-process parity "
+                   "(run-tests.sh --fabric runs this lane standalone)")
+    config.addinivalue_line(
         "markers", "timing: wall-clock-sensitive deadline assertions — "
                    "margins are widened for loaded machines "
                    "(TFT_TIMING_MARGIN multiplies the bounds; "

@@ -1,0 +1,488 @@
+"""Multi-host serving-fabric suite (tier-1; marker ``fabric``;
+``run-tests.sh --fabric``).
+
+The load-bearing contracts:
+
+- **never wrong, never dropped** — a worker crash mid-query resumes
+  the query from its PERSISTED checkpoint on a survivor, re-dispatching
+  only the blocks the dead worker never finished, bit-identical to an
+  undisturbed run; a checkpoint whose stream tag/total no longer match
+  discards to a cold re-run (the PR 13 contract, now cross-process);
+- **warm restarts** — a rolling restart of EVERY worker loses zero
+  queries and keeps the plan-fingerprint result cache warm from the
+  durable tier (zero-dispatch hits, counted separately as
+  ``result_cache_warm_hits``);
+- **explainable placement** — every place/re-place/rebalance decision
+  lands in the flight ring, so ``tft.why("tenant:x")`` reconstructs a
+  tenant's placement history with ``TFT_TRACE`` off;
+- **single-process parity** — ``TFT_FABRIC=0`` is bit-identical to the
+  plain scheduler path.
+
+Heartbeat/lease wall-clock bounds ride the ``timing`` lane with
+``timing_margin``; everything else avoids hard timing asserts.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import tensorframes_tpu as tft
+from tensorframes_tpu import io as tio
+from tensorframes_tpu.memory import checkpoint as _checkpoint
+from tensorframes_tpu.memory import persist as _persist
+from tensorframes_tpu.observability import flight as _flight
+from tensorframes_tpu.observability.slo import clear_slos, set_slo
+from tensorframes_tpu.plan import adaptive as _adaptive
+from tensorframes_tpu.resilience import WorkerLost, faults, is_worker_lost
+from tensorframes_tpu.serve import ServeFabric, live_fabric, serve_report
+from tensorframes_tpu.serve.fabric import fabric_enabled
+from tensorframes_tpu.utils.tracing import counters
+
+from conftest import timing_margin
+
+pytestmark = pytest.mark.fabric
+
+# shared across forcings: the result-cache fingerprint is keyed on the
+# computation OBJECT for in-memory identity, and on its structural
+# signature for the portable (cross-process) form — a fresh lambda per
+# call would defeat both
+DOUBLE = lambda x: {"y": x * 2.0}  # noqa: E731
+PLUS1 = lambda x: {"y": x + 1.0}  # noqa: E731
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch, tmp_path):
+    monkeypatch.setenv("TFT_RETRY_BASE_DELAY", "0.001")
+    monkeypatch.delenv("TFT_FABRIC", raising=False)
+    monkeypatch.delenv("TFT_FABRIC_WORKERS", raising=False)
+    monkeypatch.delenv("TFT_PERSIST_DIR", raising=False)
+    faults.reset()
+    clear_slos()
+    _adaptive.invalidate_results()
+    prev = _persist.configure(None)
+    yield
+    _persist.configure(prev)
+    faults.reset()
+    clear_slos()
+    _adaptive.invalidate_results()
+
+
+def _col(frame, name="y"):
+    return np.concatenate(
+        [np.asarray(b.columns[name]) for b in frame.blocks()])
+
+
+def _drain(fab):
+    for _ in range(3):
+        fab.tick()
+
+
+# ---------------------------------------------------------------------------
+# durable tier: unit round-trips
+# ---------------------------------------------------------------------------
+
+def test_persist_checkpoint_roundtrip(tmp_path):
+    _persist.configure(str(tmp_path))
+    cp = _checkpoint.QueryCheckpoint("q-rt")
+    blocks = [{"x": np.arange(4.0)}, {"x": np.arange(4.0, 8.0)}]
+    cp.park_stream(iter(blocks), total=4, tag="tag-a")
+    assert _persist.stats()["checkpoints"] == 1
+    cp.free()  # process memory dies; disk must not
+    assert _persist.stats()["checkpoints"] == 1
+    back = _persist.load_checkpoint("q-rt")
+    assert back is not None and back.parked_blocks == 2
+    vals = list(back.resume_stream(total=4, tag="tag-a"))
+    assert len(vals) == 2
+    np.testing.assert_array_equal(
+        np.asarray(vals[0]["x"]), np.arange(4.0))
+
+
+def test_persist_checkpoint_tag_mismatch_discards(tmp_path):
+    _persist.configure(str(tmp_path))
+    cp = _checkpoint.QueryCheckpoint("q-mm")
+    cp.park_stream(iter([{"x": np.arange(4.0)}]), total=3, tag="tag-a")
+    back = _persist.load_checkpoint("q-mm")
+    # the PR 13 contract, now cross-process: a drifted stream identity
+    # means the parked blocks describe a different query — discard
+    assert back.resume_stream(total=3, tag="tag-B") is None
+    assert back.parked_blocks == 0
+
+
+def test_persist_corrupt_checkpoint_is_cold_rerun(tmp_path):
+    _persist.configure(str(tmp_path))
+    cp = _checkpoint.QueryCheckpoint("q-corrupt")
+    cp.park_stream(iter([{"x": np.arange(4.0)}]), total=1, tag="t")
+    files = list((tmp_path / "checkpoints").iterdir())
+    assert len(files) == 1
+    files[0].write_bytes(b"not a pickle")
+    assert _persist.load_checkpoint("q-corrupt") is None
+    assert _persist.stats()["checkpoints"] == 0  # corrupt file removed
+
+
+def test_persist_result_budget_sweep(tmp_path, monkeypatch):
+    _persist.configure(str(tmp_path))
+    blocks = [{"x": np.arange(256.0)}]
+    _persist.save_result("fp-old", blocks)
+    size = _persist.stats()["result_bytes"]
+    # budget fits ~2 entries: writing a 3rd sweeps the oldest
+    monkeypatch.setenv("TFT_PERSIST_RESULT_BYTES", str(int(size * 2.5)))
+    time.sleep(0.02)  # mtime ordering
+    _persist.save_result("fp-mid", blocks)
+    time.sleep(0.02)
+    _persist.save_result("fp-new", blocks)
+    assert _persist.load_result("fp-old") is None
+    assert _persist.load_result("fp-new") is not None
+
+
+# ---------------------------------------------------------------------------
+# classification
+# ---------------------------------------------------------------------------
+
+def test_worker_lost_classified():
+    e = WorkerLost("worker process died")
+    assert is_worker_lost(e)
+    from tensorframes_tpu.resilience import error_kind, is_transient
+    assert error_kind(e) == "worker_lost"
+    assert not is_transient(e)
+
+
+# ---------------------------------------------------------------------------
+# the fabric: placement + basic serving
+# ---------------------------------------------------------------------------
+
+def test_fabric_places_tenants_least_loaded(tmp_path):
+    with ServeFabric(workers=2, monitor=False, probe=False,
+                     persist_dir=str(tmp_path), name="place") as fab:
+        f = tft.frame({"x": np.arange(8.0)}, num_partitions=2)
+        fab.submit(f, DOUBLE, tenant="a").result(timeout=30)
+        fab.submit(f, DOUBLE, tenant="b").result(timeout=30)
+        snap = fab.health_snapshot()
+        assert snap["placement"]["a"] != snap["placement"]["b"]
+        # sticky: a's second query lands on a's worker
+        before = snap["placement"]["a"]
+        fab.submit(f, DOUBLE, tenant="a").result(timeout=30)
+        assert fab.health_snapshot()["placement"]["a"] == before
+        assert live_fabric() is fab
+    assert live_fabric() is None
+
+
+def test_fabric_result_bit_identical_to_plain_scheduler(tmp_path):
+    f = tft.frame({"x": np.arange(32.0)}, num_partitions=4)
+    from tensorframes_tpu.serve import QueryScheduler
+    with QueryScheduler(workers=1, name="plain") as sched:
+        plain = _col(sched.submit(f, DOUBLE).result(timeout=30))
+    with ServeFabric(workers=2, monitor=False, probe=False,
+                     persist_dir=str(tmp_path), name="fabeq") as fab:
+        fabbed = _col(fab.submit(f, DOUBLE, tenant="a").result(timeout=30))
+    np.testing.assert_array_equal(plain, fabbed)
+
+
+def test_fabric_disabled_single_process_path(tmp_path, monkeypatch):
+    monkeypatch.setenv("TFT_FABRIC", "0")
+    assert not fabric_enabled()
+    with ServeFabric(workers=4, monitor=False,
+                     persist_dir=str(tmp_path), name="off") as fab:
+        assert len(fab._workers) == 1  # collapses regardless of ask
+        f = tft.frame({"x": np.arange(16.0)}, num_partitions=4)
+        got = _col(fab.submit(f, DOUBLE, tenant="a").result(timeout=30))
+        np.testing.assert_array_equal(got, np.arange(16.0) * 2.0)
+
+
+# ---------------------------------------------------------------------------
+# worker crash: the failure matrix
+# ---------------------------------------------------------------------------
+
+def test_worker_crash_mid_query_resumes_elsewhere_bit_identical(tmp_path):
+    with ServeFabric(workers=2, monitor=False, probe=False,
+                     persist_dir=str(tmp_path), name="crash") as fab:
+        f = tft.frame({"x": np.arange(64.0)}, num_partitions=8)
+        faults.arm("worker", fail_n=1)
+        fq = fab.submit(f, DOUBLE, tenant="alice")
+        got = _col(fq.result(timeout=60))
+        np.testing.assert_array_equal(got, np.arange(64.0) * 2.0)
+        assert fq.attempts == 2  # original + one re-dispatch
+        snap = fab.health_snapshot()
+        assert snap["lost"] == 1 and snap["live"] == 1
+        # the survivor resumed from the PERSISTED checkpoint: the
+        # resume re-dispatched fewer blocks than the query has
+        chain = tft.why(fq.query_id)
+        assert "fabric.resume_dispatch" in chain
+        assert "resume from the persisted checkpoint" in chain
+        assert "preempt.park" in chain  # the crash-side park
+        recs = [r for r in _flight.for_query(fq.query_id)
+                if r["kind"] == "fabric.resume_dispatch"]
+        assert recs and recs[0]["from_checkpoint"]
+        assert 0 < recs[0]["resumed_blocks"] < 8
+        # and the tenant was re-placed off the corpse
+        assert "fabric.replace" in tft.why("tenant:alice")
+
+
+def test_worker_crash_discarded_checkpoint_cold_rerun(tmp_path):
+    """A checkpoint that does not survive (deleted under the fabric)
+    degrades to a cold re-run on the survivor — same answer."""
+    with ServeFabric(workers=2, monitor=False, probe=False,
+                     persist_dir=str(tmp_path), name="cold") as fab:
+        f = tft.frame({"x": np.arange(64.0)}, num_partitions=8)
+        real_load = _persist.load_checkpoint
+        _persist.load_checkpoint = lambda qid: None  # disk wiped
+        try:
+            faults.arm("worker", fail_n=1)
+            fq = fab.submit(f, DOUBLE, tenant="a")
+            got = _col(fq.result(timeout=60))
+        finally:
+            _persist.load_checkpoint = real_load
+        np.testing.assert_array_equal(got, np.arange(64.0) * 2.0)
+        recs = [r for r in _flight.for_query(fq.query_id)
+                if r["kind"] == "fabric.resume_dispatch"]
+        assert recs and not recs[0]["from_checkpoint"]
+
+
+def test_idle_worker_fault_consumed_at_heartbeat(tmp_path):
+    """`TFT_FAULTS=worker:1` with NO running query: the next heartbeat
+    consumes the fault, the lease expires, the worker is declared lost
+    — and serving continues on the survivor."""
+    with ServeFabric(workers=2, monitor=False, probe=False,
+                     persist_dir=str(tmp_path), name="idle") as fab:
+        faults.arm("worker", fail_n=1)
+        for _ in range(fab.missed_hb + 2):
+            fab.tick()
+        snap = fab.health_snapshot()
+        assert snap["lost"] == 1
+        assert not faults.active("worker")  # consumed
+        f = tft.frame({"x": np.arange(8.0)}, num_partitions=2)
+        got = _col(fab.submit(f, DOUBLE, tenant="a").result(timeout=30))
+        np.testing.assert_array_equal(got, np.arange(8.0) * 2.0)
+
+
+def test_queued_queries_replaced_not_dropped(tmp_path):
+    """Queries still QUEUED on a crashed worker re-place and re-run
+    cold: zero lost, zero duplicated."""
+    with ServeFabric(workers=2, monitor=False, probe=False,
+                     persist_dir=str(tmp_path), name="queue") as fab:
+        f = tft.frame({"x": np.arange(16.0)}, num_partitions=2)
+        fab.submit(f, DOUBLE, tenant="a").result(timeout=30)
+        widx = fab._placement["a"]
+        # pile queries onto a's worker, then kill it before they drain
+        fqs = [fab.submit(f, PLUS1, tenant="a") for _ in range(3)]
+        fab._workers[widx].fault_pending = True
+        fab._workers[widx].scheduler.mark_lost()
+        outs = [_col(fq.result(timeout=60)) for fq in fqs]
+        for got in outs:
+            np.testing.assert_array_equal(got, np.arange(16.0) + 1.0)
+        assert all(fq.done() and fq.error is None for fq in fqs)
+
+
+def test_no_survivors_is_classified_worker_lost(tmp_path):
+    with ServeFabric(workers=1, monitor=False, probe=False,
+                     persist_dir=str(tmp_path), name="alone") as fab:
+        f = tft.frame({"x": np.arange(16.0)}, num_partitions=4)
+        faults.arm("worker", fail_n=1)
+        fq = fab.submit(f, DOUBLE, tenant="a")
+        with pytest.raises(WorkerLost):
+            fq.result(timeout=60)
+
+
+# ---------------------------------------------------------------------------
+# durable result cache across restarts
+# ---------------------------------------------------------------------------
+
+def test_rolling_restart_keeps_result_cache_warm(tmp_path):
+    pq = str(tmp_path / "t.parquet")
+    tio.write_parquet(
+        tft.frame({"x": np.arange(32.0)}, num_partitions=4), pq)
+    with ServeFabric(workers=2, monitor=False, probe=False,
+                     persist_dir=str(tmp_path / "persist"),
+                     name="roll") as fab:
+        f = tio.read_parquet(pq)
+        # two sightings admit (two-touch), the admit persists
+        outs = [fab.submit(f, DOUBLE, tenant="t1").result(timeout=30)
+                for _ in range(2)]
+        a = _col(outs[0])
+        assert counters.get("persist.result_writes") >= 1
+        warm0 = counters.get("plan.result_cache_warm_hits")
+        # restart EVERY worker: in-memory caches die with each epoch
+        assert fab.rolling_restart() == 2
+        assert all(w.epoch == 1 for w in fab._workers)
+        dispatches0 = counters.get("pipeline.dispatches")
+        got = _col(fab.submit(f, DOUBLE, tenant="t1").result(timeout=30))
+        np.testing.assert_array_equal(a, got)
+        # served WARM from the durable tier: counted separately, and
+        # with zero new pipeline dispatches
+        assert counters.get("plan.result_cache_warm_hits") == warm0 + 1
+        assert counters.get("pipeline.dispatches") == dispatches0
+        # warm hit re-admits into memory: the NEXT hit is a plain hit
+        hits0 = counters.get("plan.result_cache_hits")
+        fab.submit(f, DOUBLE, tenant="t1").result(timeout=30)
+        assert counters.get("plan.result_cache_hits") == hits0 + 1
+
+
+def test_rolling_restart_loses_zero_inflight_queries(tmp_path):
+    with ServeFabric(workers=2, monitor=False, probe=False,
+                     persist_dir=str(tmp_path), name="migrate") as fab:
+        f = tft.frame({"x": np.arange(24.0)}, num_partitions=3)
+        fqs = [fab.submit(f, PLUS1, tenant=t)
+               for t in ("a", "b", "c")]
+        assert fab.rolling_restart() == 2
+        for fq in fqs:
+            got = _col(fq.result(timeout=60))
+            np.testing.assert_array_equal(got, np.arange(24.0) + 1.0)
+        assert "fabric.worker_restart" in tft.why(fqs[0].query_id) or \
+            counters.get("fabric.worker_restarts") >= 2
+
+
+def test_restart_probe_gates_admission(tmp_path):
+    with ServeFabric(workers=2, monitor=False, probe=False,
+                     persist_dir=str(tmp_path), name="gate") as fab:
+        w = fab._workers[0]
+        ok = fab.restart_worker(0)
+        assert ok and w.epoch == 1 and w.alive
+        assert any(r["kind"] == "fabric.admit"
+                   for r in _flight.recent()
+                   if r.get("worker") == "w0")
+
+
+def test_shared_compile_cache_spans_workers(tmp_path):
+    """One SharedCompileCache instance serves every worker and every
+    epoch: tenant B's identical computation on another worker hits."""
+    with ServeFabric(workers=2, monitor=False, probe=False,
+                     persist_dir=str(tmp_path), name="cc") as fab:
+        f = tft.frame({"x": np.arange(8.0)}, num_partitions=2)
+        # structurally identical but DISTINCT computation objects: the
+        # interner's per-object short-circuit must not mask the test
+        fab.submit(f, lambda x: {"y": x * 2.0},
+                   tenant="a").result(timeout=30)
+        fab.submit(f, lambda x: {"y": x * 2.0},
+                   tenant="b").result(timeout=30)
+        assert fab.health_snapshot()["placement"]["a"] != \
+            fab.health_snapshot()["placement"]["b"]
+        st = fab.compile_cache.stats()
+        assert st["hits"] >= 1
+        for w in fab._workers:
+            assert w.scheduler.compile_cache is fab.compile_cache
+
+
+# ---------------------------------------------------------------------------
+# SLO-burn re-placement
+# ---------------------------------------------------------------------------
+
+def test_slo_burn_replaces_hot_tenant(tmp_path):
+    with ServeFabric(workers=2, monitor=False, probe=False,
+                     persist_dir=str(tmp_path), name="burn") as fab:
+        f = tft.frame({"x": np.arange(8.0)}, num_partitions=2)
+        set_slo("hot", objective_ms=0.0001)     # impossible: burns
+        set_slo("cool", objective_ms=60000.0)   # trivially met
+        for fn in (DOUBLE, PLUS1, DOUBLE, PLUS1):
+            fab.submit(f, fn, tenant="hot").result(timeout=30)
+            fab.submit(f, fn, tenant="cool").result(timeout=30)
+        before = dict(fab._placement)
+        for _ in range(3 * fab.rebalance_ticks):
+            fab.tick()
+        after = dict(fab._placement)
+        assert before["hot"] != after["hot"]
+        assert before["cool"] == after["cool"]
+        # observable via tft.why(), tracing off
+        chain = tft.why("tenant:hot")
+        assert "fabric.rebalance" in chain and "SLO burn" in chain
+        # stale evidence never ping-pongs: more ticks, no new queries
+        for _ in range(4 * fab.rebalance_ticks):
+            fab.tick()
+        assert fab._placement["hot"] == after["hot"]
+
+
+# ---------------------------------------------------------------------------
+# observability surfaces
+# ---------------------------------------------------------------------------
+
+def test_health_and_doctor_show_fabric(tmp_path):
+    with ServeFabric(workers=2, monitor=False, probe=False,
+                     persist_dir=str(tmp_path), name="obs") as fab:
+        f = tft.frame({"x": np.arange(8.0)}, num_partitions=2)
+        fab.submit(f, DOUBLE, tenant="a").result(timeout=30)
+        h = tft.health()
+        assert h["fabric"]["running"] and h["fabric"]["workers"] == 2
+        assert h["fabric"]["persist"]["enabled"]
+        assert "fabric" in tft.doctor()
+        rep = serve_report(fab._workers[0].scheduler)
+        assert "placement" in rep
+    assert not tft.health()["fabric"].get("running", False)
+
+
+def test_lost_worker_raises_health_warning(tmp_path):
+    with ServeFabric(workers=2, monitor=False, probe=False,
+                     persist_dir=str(tmp_path), name="warn") as fab:
+        faults.arm("worker", fail_n=1)
+        for _ in range(fab.missed_hb + 2):
+            fab.tick()
+        warns = tft.health()["warnings"]
+        assert any("worker(s) declared lost" in w for w in warns)
+
+
+def test_flight_records_carry_worker_and_dumps_merge(tmp_path):
+    with ServeFabric(workers=2, monitor=False, probe=False,
+                     persist_dir=str(tmp_path), name="wid") as fab:
+        f = tft.frame({"x": np.arange(8.0)}, num_partitions=2)
+        fq = fab.submit(f, DOUBLE, tenant="a")
+        fq.result(timeout=30)
+        recs = _flight.for_query(fq.query_id)
+        workers = {r.get("worker") for r in recs if r.get("worker")}
+        assert workers <= {"w0", "w1"} and workers
+    # per-worker dumps: header carries worker=, records re-attribute
+    p0 = str(tmp_path / "w0.jsonl")
+    _flight.dump(p0, reason="test", worker="w0")
+    with open(p0) as fh:
+        head = json.loads(fh.readline())
+    assert head["worker"] == "w0"
+    merged = _flight.load_dumps([p0])
+    assert merged and all(r.get("worker") for r in merged)
+
+
+def test_doctor_merges_per_worker_dumps(tmp_path):
+    _flight.record("serve.shed", tenant="t", est_bytes=1, headroom=0,
+                   budget_s=1)
+    p = str(tmp_path / "wX.jsonl")
+    _flight.dump(p, reason="test", worker="wX")
+    d = tft.doctor(flight_dumps=[p])
+    assert "per-worker dump" in d and "w=wX" in d
+
+
+# ---------------------------------------------------------------------------
+# the worker fault site without a fabric: park + same-process resume
+# ---------------------------------------------------------------------------
+
+def test_worker_fault_site_without_fabric_still_completes():
+    """No fabric: the `worker` site parks the query and the SAME
+    scheduler resumes it (there is no coordinator to kill the process),
+    so the site degrades to a preempt/resume — never a wrong answer."""
+    from tensorframes_tpu.serve import QueryScheduler
+    with QueryScheduler(workers=1, name="solo") as sched:
+        f = tft.frame({"x": np.arange(32.0)}, num_partitions=4)
+        faults.arm("worker", fail_n=1)
+        q = sched.submit(f, DOUBLE)
+        got = _col(q.result(timeout=30))
+        np.testing.assert_array_equal(got, np.arange(32.0) * 2.0)
+        assert q.preemptions >= 1
+
+
+# ---------------------------------------------------------------------------
+# timing lane: heartbeat/lease wall-clock bounds
+# ---------------------------------------------------------------------------
+
+@pytest.mark.timing
+def test_monitor_declares_lost_within_lease_bound(tmp_path):
+    hb_ms = 20.0
+    with ServeFabric(workers=2, monitor=True, probe=False,
+                     heartbeat_ms=hb_ms, missed_hb=3,
+                     persist_dir=str(tmp_path), name="lease") as fab:
+        faults.arm("worker", fail_n=1)
+        # lease math: fault consumed on a beat, lost after 3 misses —
+        # generously 20 beat intervals, margin-scaled
+        deadline = time.monotonic() + timing_margin(
+            20 * (hb_ms / 1000.0) + 1.0)
+        while time.monotonic() < deadline:
+            if fab.health_snapshot()["lost"] == 1:
+                break
+            time.sleep(hb_ms / 1000.0)
+        assert fab.health_snapshot()["lost"] == 1
