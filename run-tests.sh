@@ -149,6 +149,17 @@ if [ "${1:-}" = "--fabric" ]; then
   exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m fabric "$@"
 fi
 
+# --shuffle: run only the hash-repartition exchange lane
+# (tests/test_shuffle.py: placement/conservation properties, the
+# partitioned hash join vs the broadcast oracle, shuffle daggregate
+# parity, TFT_SHUFFLE=0 bit-identity, device-loss recovery
+# mid-exchange) — fast, CPU-only (8 virtual devices), no native build
+if [ "${1:-}" = "--shuffle" ]; then
+  shift
+  echo "== shuffle lane (pytest -m shuffle, CPU) =="
+  exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m shuffle "$@"
+fi
+
 # --timing: run only the wall-clock-sensitive deadline tests, serially
 # (they flake under concurrent suite load; TFT_TIMING_MARGIN widens
 # their assertion bounds further on badly oversubscribed boxes)

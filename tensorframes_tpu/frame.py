@@ -544,10 +544,15 @@ class TensorFrame:
              strategy: Optional[str] = None, mesh=None,
              indicator: Optional[str] = None) -> "TensorFrame":
         """Join this frame against ``other`` (lazy). Strategies: a
-        broadcast hash join for small build sides (default), or a mesh
-        sort-merge join for large-large (``strategy="sort_merge"`` /
-        auto when ``mesh=`` is given and the build side is big). See
-        ``docs/joins.md``."""
+        broadcast hash join for small build sides (default), a
+        shuffle-partitioned hash join for big builds on a multi-shard
+        mesh (``strategy="partitioned"`` / auto when ``mesh=`` is given
+        and the build side is over ``TFT_BROADCAST_LIMIT_BYTES`` —
+        string keys included), or a mesh sort-merge join
+        (``strategy="sort_merge"`` / auto for numeric keys when
+        ``TFT_SHUFFLE=0``). The auto-routing decision is
+        flight-recorded (``tft.why()``) and rendered by ``explain()``.
+        See ``docs/joins.md``."""
         from .relational.join import join as _join
         return _join(self, other, on, how=how, strategy=strategy,
                      mesh=mesh, indicator=indicator)

@@ -20,7 +20,8 @@ import numpy as np
 
 __all__ = ["array_nbytes", "column_nbytes", "block_nbytes",
            "blocks_estimate", "schema_row_bytes", "frame_estimate",
-           "dist_frame_estimate", "propagate_hints"]
+           "dist_frame_estimate", "exchange_buffer_bytes",
+           "propagate_hints"]
 
 from .spill import array_nbytes
 
@@ -141,6 +142,27 @@ def dist_frame_estimate(frame) -> Tuple[Optional[float], Optional[int]]:
         return float(frame.num_rows), total
     except Exception:
         return None, None
+
+
+def exchange_buffer_bytes(cell_specs: Sequence[Tuple[Tuple[int, ...], Any]],
+                          shards: int, cap: int,
+                          rowid_bytes: int = 0) -> int:
+    """Device bytes a ``dexchange`` dispatch admits against the ledger:
+    every shard scatters into ``shards`` static buckets of ``cap`` rows
+    per column, and the ``all_to_all`` holds send + receive sides at
+    once — ``shards * shards * cap`` rows of every exchanged column
+    (plus the optional carried row-id lane), times two.
+
+    ``cell_specs`` is ``[(cell_shape, dtype), ...]`` for the tensor
+    columns riding the exchange.
+    """
+    per_row = int(rowid_bytes)
+    for cell, dt in cell_specs:
+        n = 1
+        for d in cell:
+            n *= int(d)
+        per_row += n * int(np.dtype(dt).itemsize)
+    return 2 * shards * shards * cap * per_row
 
 
 def propagate_hints(src_frame, out_schema

@@ -37,7 +37,7 @@ ANOMALY_KINDS = (
     "mesh.rebalance", "plan.oom_fallback", "dplan.fallback",
     "pipeline.sync_fallback", "engine.oom_split", "preempt.park",
     "fabric.worker_lost", "fabric.worker_crash", "fabric.replace",
-    "fabric.admit_probe_failed",
+    "fabric.admit_probe_failed", "mesh.exchange_skew",
 )
 
 
@@ -153,6 +153,20 @@ def _detail(r: Dict[str, Any]) -> str:
                 f"{r.get('threshold')} for {r.get('streak')} "
                 f"dispatches): rows re-partitioned {r.get('before')} "
                 f"-> {r.get('after')}")
+    if k == "mesh.exchange_skew":
+        return (f"exchange partition imbalance {r.get('ratio')} "
+                f"(> TFT_SKEW_WARN={r.get('threshold')}) during "
+                f"{r.get('op')!r}: {r.get('rows')} row(s), per-shard "
+                f"{r.get('per_shard')}")
+    if k == "relational.join_route":
+        est = r.get("est_build_bytes")
+        est_s = _fmt_bytes(est) if est is not None else "unknown"
+        return (f"join auto-routed to {r.get('strategy')!r} "
+                f"({r.get('reason')}): est build {est_s} vs "
+                f"TFT_BROADCAST_LIMIT_BYTES="
+                f"{_fmt_bytes(r.get('limit') or 0)}, keys "
+                f"{r.get('keys')}, how={r.get('how')}, shuffle "
+                f"{'on' if r.get('shuffle') else 'off'}")
     if k == "mesh.salt":
         return (f"{r.get('count')} hot key group(s) (> "
                 f"{r.get('fraction')} of rows, TFT_HOT_KEY_FRACTION) "

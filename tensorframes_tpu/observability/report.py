@@ -247,6 +247,33 @@ def frame_report(df) -> str:
                 report += (f"\n  hot key  : {{{kv}}} — {frac}, salted "
                            f"across {h['salt_slots']} slot(s) "
                            f"(frame.hot_keys())")
+        route = getattr(df, "_join_route", None)
+        if route:
+            # the join auto-routing decision (also in the flight ring
+            # as relational.join_route — tft.why() renders it)
+            est = route.get("est_build_bytes")
+            est_s = _fmt_bytes(est) if est is not None else "unknown"
+            report += (f"\n  join     : auto-routed to "
+                       f"{route['strategy']!r} ({route['reason']}) — "
+                       f"est build {est_s} vs limit "
+                       f"{_fmt_bytes(route['limit'])}, shuffle "
+                       f"{'on' if route.get('shuffle') else 'off'}")
+        pinfo = getattr(df, "_partitioned_info", None)
+        if pinfo:
+            report += (f"\n  shuffle  : partitioned build across "
+                       f"{pinfo['shards']} shard(s) — max per-device "
+                       f"build {_fmt_bytes(pinfo['max_build_bytes'])} "
+                       f"of {_fmt_bytes(pinfo['global_build_bytes'])} "
+                       f"global")
+        ex = getattr(df, "_exchange_skew", None) \
+            or getattr(df, "_exchange", None)
+        if ex:
+            flag = (" OVER TFT_SKEW_WARN"
+                    if ex["ratio"] > ex["threshold"] else "")
+            report += (f"\n  exchange : partition imbalance "
+                       f"{ex['ratio']:.2f} (threshold "
+                       f"{ex['threshold']:.2f}{flag}); per-shard rows "
+                       f"{ex['per_shard']}")
         return report
 
     t = getattr(df, "_trace", None)

@@ -15,6 +15,7 @@ from .distributed import (
     dreduce_blocks, dsort)
 from .collectives import COMBINERS
 from .elastic import admit_devices, grow_mesh, probe_device
+from .exchange import dexchange, shuffle_daggregate, shuffle_enabled
 from .ring import ring_attention, ring_allreduce
 from .cluster import cluster_mesh, distribute_local, initialize
 
@@ -24,6 +25,7 @@ __all__ = [
     "dmap_blocks", "dreduce_blocks", "dsort",
     "COMBINERS",
     "admit_devices", "grow_mesh", "probe_device",
+    "dexchange", "shuffle_daggregate", "shuffle_enabled",
     "ring_attention", "ring_allreduce",
     "cluster_mesh", "distribute_local", "initialize",
 ]

@@ -377,6 +377,14 @@ class DistributedFrame:
                 f"  rebalance: skew {rb['ratio']:.2f} during {rb['op']}; "
                 f"per-shard rows {rb['before']} -> {rb['after']} "
                 f"(proportional to observed device throughput)")
+        ex = getattr(self, "_exchange", None)
+        if ex:
+            flag = (" OVER TFT_SKEW_WARN"
+                    if ex["ratio"] > ex["threshold"] else "")
+            lines.append(
+                f"  exchange: partition imbalance {ex['ratio']:.2f} "
+                f"(threshold {ex['threshold']:.2f}{flag}); per-shard "
+                f"rows {ex['per_shard']}")
         for f in self.schema:
             col = self.columns[f.name]
             if isinstance(col, np.ndarray):
@@ -1913,6 +1921,18 @@ def _daggregate(fetches, dist: DistributedFrame, keys,
         ids_dev, uniques, num_groups, salt_plan = _monoid_group_plan(
             dist, keys)
         uniq_dev = count_dev = None
+        # high-cardinality keys: the dense per-shard tables below hold
+        # EVERY group on EVERY shard — beyond TFT_SHUFFLE_AGG_GROUPS,
+        # hash-repartition instead so each device aggregates only its
+        # own key range (O(groups/shards) state; parallel/exchange.py)
+        from .exchange import (shuffle_agg_groups_threshold,
+                               shuffle_enabled)
+        thr = shuffle_agg_groups_threshold()
+        if (thr is not None and shuffle_enabled()
+                and num_groups > thr and mesh.num_data_shards > 1):
+            from .exchange import _shuffle_daggregate_impl
+            counters.inc("mesh.shuffle_agg_routes")
+            return _shuffle_daggregate_impl(fetches, dist, keys)
     if salt_plan is not None:
         prog_ids, prog_groups = salt_plan[0], salt_plan[1]
     else:

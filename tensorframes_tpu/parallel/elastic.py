@@ -840,6 +840,20 @@ _MESH_FAMILIES = (
      "Lazy distributed chains forced as one fused GSPMD program."),
     ("dplan.fallbacks", "tft_dplan_fallbacks_total",
      "Fused mesh programs that fell back to the per-op replay."),
+    ("mesh.exchange_dispatches", "tft_mesh_exchange_dispatches_total",
+     "Hash-repartition exchanges dispatched (parallel/exchange.py)."),
+    ("mesh.exchange_rows", "tft_mesh_exchange_rows_total",
+     "Rows routed through the shuffle exchange."),
+    ("mesh.exchange_bytes", "tft_mesh_exchange_bytes_total",
+     "Device bytes admitted for exchange send+receive buffers."),
+    ("mesh.exchange_skew_events", "tft_mesh_exchange_skew_events_total",
+     "Exchanges whose partition-size imbalance crossed TFT_SKEW_WARN "
+     "(flight-recorded as mesh.exchange_skew)."),
+    ("mesh.shuffle_daggregates", "tft_mesh_shuffle_daggregates_total",
+     "Shuffle-partitioned aggregations run."),
+    ("mesh.shuffle_agg_routes", "tft_mesh_shuffle_agg_routes_total",
+     "daggregate calls auto-routed to the shuffle path by the "
+     "TFT_SHUFFLE_AGG_GROUPS threshold."),
 )
 
 

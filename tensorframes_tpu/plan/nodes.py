@@ -482,8 +482,11 @@ class JoinNode(PlanNode):
     chains fuse over the join result like any source, column pruning
     reaches INTO the join through :meth:`read_blocks` (build columns
     the chain never references are not gathered, probe passthrough
-    columns not materialized), and :meth:`estimate` prices join output
-    per column for serve admission / quotas.
+    columns not materialized — for the partitioned strategy the pruned
+    columns also never ride the shuffle exchange), and :meth:`estimate`
+    prices join output per column for serve admission / quotas.
+    ``strategy`` is ``"broadcast"``, ``"sort_merge"``, or
+    ``"partitioned"`` (the shuffle-exchange hash join).
     """
 
     kind = "join"
@@ -520,9 +523,10 @@ class JoinNode(PlanNode):
         follow-on): a broadcast BuildTable prices the per-probe-row
         expansion from its unique-key count (``build_rows /
         num_groups`` — exactly 1 for unique keys, so 1:1 left joins
-        stay exact); a sort-merge join over forced sides prices
-        ``|L|·|R| / max(V(L), V(R))`` with HLL ``approx_key_distinct``
-        probes. Anything unprobeable keeps the probe-side row count
+        stay exact); a sort-merge or partitioned join over forced
+        sides prices ``|L|·|R| / max(V(L), V(R))`` with HLL
+        ``approx_key_distinct`` probes (both carry their right node
+        here). Anything unprobeable keeps the probe-side row count
         (the prior upper-bound-ish heuristic)."""
         if not rows_l:
             return rows_l

@@ -10,12 +10,16 @@ rather than beside them:
   fused gather program per probe block through the resilient
   :class:`~..engine.executor.BlockExecutor`; a build side the memory
   ledger refuses to hold resident probes in budget-sized CHUNKS
-  instead) and a mesh sort-merge join for large-large (both sides
-  through ``dsort`` — columnsort all_to_all exchanges, ``elastic_call``
-  device-loss recovery, and the external-memory sort when the ledger
-  demands — then a host merge of the two key-sorted streams).
-  ``StreamingFrame.join`` enriches stream batches against a static
-  build table built ONCE at definition time.
+  instead), a mesh sort-merge join for large-large numeric keys (both
+  sides through ``dsort`` — columnsort all_to_all exchanges,
+  ``elastic_call`` device-loss recovery, and the external-memory sort
+  when the ledger demands — then a host merge of the two key-sorted
+  streams), and a shuffle-partitioned hash join (both sides
+  hash-repartitioned by key through ``parallel/exchange.py`` so every
+  shard builds and probes only its own key range — O(R/S) build memory
+  per device, string keys included). ``StreamingFrame.join`` enriches
+  stream batches against a static build table built ONCE at definition
+  time.
 
 - **Sketches** (:mod:`.sketch`): mergeable summaries for aggregates
   where exact answers don't scale — HyperLogLog distinct counts,
@@ -32,13 +36,15 @@ See ``docs/joins.md``.
 
 from __future__ import annotations
 
-from .join import BuildTable, broadcast_join, join, sort_merge_join
+from .join import (BuildTable, broadcast_join, join,
+                   partitioned_hash_join, sort_merge_join)
 from .sketch import (SketchCombiner, approx_distinct, approx_quantile,
                      approx_top_k, hll_sketch, quantile_sketch,
                      top_k_sketch)
 
 __all__ = [
-    "join", "broadcast_join", "sort_merge_join", "BuildTable",
+    "join", "broadcast_join", "sort_merge_join",
+    "partitioned_hash_join", "BuildTable",
     "SketchCombiner", "hll_sketch", "quantile_sketch", "top_k_sketch",
     "approx_distinct", "approx_quantile", "approx_top_k",
 ]

@@ -51,8 +51,8 @@ from ..shape import Shape, Unknown
 from ..utils.logging import get_logger
 from ..utils.tracing import counters, span
 
-__all__ = ["join", "broadcast_join", "sort_merge_join", "BuildTable",
-           "approx_key_distinct"]
+__all__ = ["join", "broadcast_join", "sort_merge_join",
+           "partitioned_hash_join", "BuildTable", "approx_key_distinct"]
 
 _log = get_logger("relational.join")
 
@@ -631,7 +631,8 @@ def sort_merge_join(left: TensorFrame, right: TensorFrame, on,
         if not left.schema[k].dtype.tensor:
             raise InvalidTypeError(
                 f"sort_merge_join key {k!r} must be numeric (the dsort "
-                f"contract); use the broadcast strategy for string keys")
+                f"contract); use the partitioned strategy for string "
+                f"keys")
     out_schema = join_schema(left.schema, right.schema, on, how,
                              indicator)
     counters.inc("relational.sort_merge_joins")
@@ -726,40 +727,302 @@ def sort_merge_join(left: TensorFrame, right: TensorFrame, on,
     return out
 
 
+# ---------------------------------------------------------------------------
+# partitioned hash join (shuffle exchange)
+# ---------------------------------------------------------------------------
+
+_PROW = "_tft_prow"  # the carried probe row id column (internal)
+
+
+def _partition_keys_ok(left_schema: Schema, right_schema: Schema,
+                       on: Sequence[str]) -> bool:
+    """Whether the exchange may hash these keys: both sides present,
+    scalar, same tensor-ness, and (for device keys) the same STORAGE
+    dtype — the device hash is a bit hash, so int32-vs-int64 key pairs
+    would place equal values on different shards."""
+    for k in on:
+        lf = left_schema.get(k)
+        rf = right_schema.get(k)
+        if lf is None or rf is None:
+            return False
+        if lf.sql_rank != 0 or rf.sql_rank != 0:
+            return False
+        if lf.dtype.tensor != rf.dtype.tensor:
+            return False
+        if lf.dtype.tensor and (np.dtype(lf.dtype.np_storage)
+                                != np.dtype(rf.dtype.np_storage)):
+            return False
+    return True
+
+
+def partitioned_hash_join(left: TensorFrame, right: TensorFrame, on,
+                          how: str = "inner", mesh=None,
+                          indicator: Optional[str] = None) -> TensorFrame:
+    """Shuffle-partitioned hash join (lazy): BOTH sides hash-repartition
+    by key through :func:`~..parallel.exchange.dexchange`, then every
+    shard builds a :class:`BuildTable` over ONLY its own key range and
+    probes only its own left rows — per-device build memory O(R/S)
+    instead of broadcast's O(R), and the probe side never collects onto
+    one device. Equal keys colocate by construction (placement is a pure
+    function of key value and shard count), so shard-local probes see
+    every match.
+
+    Output is bit-identical to :func:`broadcast_join`: a carried row id
+    restores probe order with one stable sort and the original block
+    boundaries are re-cut. String keys are supported (host-hashed
+    destinations); key STORAGE dtypes must match across sides. A
+    single-shard mesh or ``TFT_SHUFFLE=0`` falls back to broadcast —
+    bit-identical by the same construction.
+    """
+    if mesh is None:
+        raise ValueError("partitioned_hash_join needs a mesh; use "
+                         "broadcast_join for host-only frames")
+    on = _validate_on(left.schema, right.schema,
+                      [on] if isinstance(on, str) else list(on))
+    from ..engine.ops import InvalidTypeError
+    if not _partition_keys_ok(left.schema, right.schema, on):
+        raise InvalidTypeError(
+            f"partitioned_hash_join keys {on!r} have mismatched storage "
+            f"dtypes across sides; cast one side first")
+    from ..parallel import exchange as _ex
+    if not _ex.shuffle_enabled() or mesh.num_data_shards <= 1:
+        counters.inc("relational.partitioned_fallbacks")
+        return broadcast_join(left, right, on, how=how,
+                              indicator=indicator)
+    out_schema = join_schema(left.schema, right.schema, on, how,
+                             indicator)
+    counters.inc("relational.partitioned_joins")
+
+    def materialize(names: Sequence[str]) -> List[Block]:
+        out_set = set(names)
+        with span("join.partitioned"):
+            from ..parallel.distributed import distribute
+            lneeded = [f.name for f in left.schema
+                       if f.name in out_set or f.name in on]
+            rneeded = [f.name for f in right.schema
+                       if f.name in out_set or f.name in on]
+            lf = left.select(lneeded) \
+                if set(lneeded) != set(left.schema.names) else left
+            rf = right.select(rneeded) \
+                if set(rneeded) != set(right.schema.names) else right
+            lm = Block.concat(lf.blocks(), lf.schema)
+            block_sizes = [b.num_rows for b in lf.blocks()]
+            rm = Block.concat(rf.blocks(), rf.schema)
+            if lm.num_rows == 0 or rm.num_rows == 0:
+                # a degenerate side: broadcast IS the partitioned plan
+                # here (an empty exchange buys nothing) — bit-identical
+                counters.inc("relational.partitioned_fallbacks")
+                build = BuildTable(rf, on)
+                return [probe_block(build, b, how, list(names),
+                                    indicator=indicator)
+                        for b in lf.blocks()]
+            lcols = dict(lm.columns)
+            lcols[_PROW] = np.arange(lm.num_rows, dtype=np.int64)
+            lschema = Schema(list(lf.schema)
+                             + [Field(_PROW, _dt.int64)])
+            lex = _ex.dexchange(on, distribute(
+                TensorFrame.from_columns(lcols, schema=lschema), mesh))
+            rex = _ex.dexchange(on, distribute(
+                TensorFrame.from_columns(dict(rm.columns),
+                                         schema=rf.schema), mesh))
+            # a device lost during one exchange shrinks only that side;
+            # re-exchange the wider side at the narrower shard count so
+            # key ranges line up again (counts only ever decrease)
+            from ..parallel import elastic as _elastic
+            while (lex.mesh.num_data_shards
+                   != rex.mesh.num_data_shards):
+                if (lex.mesh.num_data_shards
+                        > rex.mesh.num_data_shards):
+                    lex = _ex.dexchange(
+                        on, _elastic.reshard(lex, rex.mesh))
+                else:
+                    rex = _ex.dexchange(
+                        on, _elastic.reshard(rex, lex.mesh))
+            S = lex.mesh.num_data_shards
+            lrp = lex.padded_rows // S
+            rrp = rex.padded_rows // S
+            lvalid = lex.per_shard_valid()
+            rvalid = rex.per_shard_valid()
+
+            def shard_cols(ex, schema, cols, rows_per, s, k):
+                out = {}
+                for n in cols:
+                    a = ex.host_read_padded(n)[s * rows_per:
+                                               s * rows_per + k]
+                    fld = schema[n]
+                    if isinstance(a, np.ndarray) and fld.dtype.tensor \
+                            and a.dtype != fld.dtype.np_storage \
+                            and fld.dtype is not _dt.bfloat16:
+                        a = a.astype(fld.dtype.np_storage)
+                    out[n] = a
+                return out
+
+            probe_names = list(names) + [_PROW]
+            parts: List[Block] = []
+            build_bytes: List[int] = []
+            for s in range(S):
+                lk = int(lvalid[s])
+                rk = int(rvalid[s])
+                if lk == 0:
+                    continue
+                rshard = TensorFrame.from_columns(
+                    shard_cols(rex, rex.schema, rneeded, rrp, s, rk),
+                    schema=rf.schema)
+                build = BuildTable(rshard, on)
+                build_bytes.append(int(build.dev_bytes))
+                lblk = Block(shard_cols(lex, lex.schema,
+                                        lneeded + [_PROW], lrp, s, lk),
+                             lk)
+                parts.append(probe_block(build, lblk, how, probe_names,
+                                         indicator=indicator))
+            part_schema = Schema([out_schema[n] for n in names
+                                  if out_schema.get(n) is not None]
+                                 + [Field(_PROW, _dt.int64)])
+            if parts:
+                cat = Block.concat(parts, part_schema)
+            else:
+                cat = Block({n: (np.empty(0, np.int64) if n == _PROW
+                                 else _empty_like(out_schema[n]))
+                             for n in part_schema.names}, 0)
+            # matches for one probe row all live on ONE shard (equal
+            # keys colocate), so a stable sort by the carried row id
+            # restores the exact broadcast probe order
+            prow = np.asarray(cat.columns[_PROW])
+            perm = np.argsort(prow, kind="stable")
+            prow_sorted = prow[perm]
+            cols = {n: (cat.columns[n][perm]
+                        if isinstance(cat.columns[n], np.ndarray)
+                        else [cat.columns[n][i] for i in perm])
+                    for n in part_schema.names if n != _PROW}
+            counters.inc("relational.partitioned_probe_rows",
+                         int(lm.num_rows))
+            out._partitioned_info = {
+                "shards": S,
+                "build_bytes": build_bytes,
+                "max_build_bytes": max(build_bytes, default=0),
+                "global_build_bytes": int(sum(build_bytes)),
+            }
+            ex_info = getattr(lex, "_exchange", None)
+            if ex_info is not None:
+                out._exchange_skew = ex_info
+            # re-cut the left frame's block boundaries
+            bounds = np.cumsum(np.asarray(block_sizes, np.int64))
+            splits = np.searchsorted(prow_sorted, bounds, side="left")
+            blocks: List[Block] = []
+            a = 0
+            for b in splits.tolist():
+                blocks.append(Block(
+                    {n: (c[a:b] if isinstance(c, np.ndarray)
+                         else list(c[a:b])) for n, c in cols.items()},
+                    b - a))
+                a = b
+            return blocks
+
+    rows_h, _ = _left_rows_hint(left)
+    out = TensorFrame(
+        out_schema, lambda: materialize(out_schema.names),
+        left.num_partitions,
+        plan=f"join[partitioned,{how}]({left._plan})",
+        rows_hint=rows_h if how == "left" else None)
+    _attach_join_node(out, left, right, on, how, "partitioned",
+                      materialize)
+    return out
+
+
+def _empty_like(field):
+    if not field.dtype.tensor:
+        return []
+    cell = ()
+    if field.block_shape is not None:
+        cell = tuple(d if isinstance(d, int) and d > 0 else 0
+                     for d in field.block_shape.dims[1:])
+    return np.empty((0,) + cell, field.dtype.np_storage)
+
+
+def _broadcast_limit() -> int:
+    try:
+        return int(os.environ.get("TFT_BROADCAST_LIMIT_BYTES",
+                                  _DEFAULT_BROADCAST_LIMIT))
+    except ValueError:
+        return _DEFAULT_BROADCAST_LIMIT
+
+
+def _route_join(left: TensorFrame, right: TensorFrame, on_l, mesh,
+                how: str) -> Tuple[str, Dict[str, object]]:
+    """``join()``'s auto-routing, returned with the decision record the
+    flight ring keeps (``tft.why()`` renders it like every other
+    autonomous decision): the chosen strategy, the estimated build
+    bytes it was judged on, and the limit it was judged against."""
+    from ..memory.estimate import frame_estimate
+    from ..parallel import exchange as _ex
+    limit = _broadcast_limit()
+    _, rbytes = frame_estimate(right)
+    oversized = mesh is not None and (rbytes is None or rbytes > limit)
+    tensor_keys = all(
+        left.schema.get(k) is not None and left.schema[k].dtype.tensor
+        for k in on_l)
+    shuffle_ok = (_ex.shuffle_enabled() and mesh is not None
+                  and getattr(mesh, "num_data_shards", 1) > 1
+                  and _partition_keys_ok(left.schema, right.schema,
+                                         on_l))
+    strategy = "broadcast"
+    reason = "no mesh" if mesh is None else "build fits"
+    if oversized:
+        if shuffle_ok:
+            # over the broadcast limit with a multi-shard mesh: shuffle
+            # both sides; works for string keys too (today's only
+            # distributed option for them)
+            strategy = "partitioned"
+            reason = "build over limit"
+        elif tensor_keys:
+            strategy = "sort_merge"
+            reason = ("build over limit (shuffle off)"
+                      if mesh is not None else "build over limit")
+        else:
+            # string keys without the shuffle path can only broadcast
+            reason = "string keys, shuffle off"
+    route = {"strategy": strategy, "reason": reason,
+             "est_build_bytes": (int(rbytes) if rbytes is not None
+                                 else None),
+             "limit": limit, "how": how,
+             "shuffle": bool(_ex.shuffle_enabled()),
+             "keys": list(on_l)}
+    return strategy, route
+
+
 def join(left: TensorFrame, right: TensorFrame, on,
          how: str = "inner", strategy: Optional[str] = None,
          mesh=None, indicator: Optional[str] = None) -> TensorFrame:
     """Join two frames (lazy). ``strategy=None`` auto-routes: broadcast
     for build sides estimated under ``TFT_BROADCAST_LIMIT_BYTES``
-    (default 64 MiB) or when no mesh is given; the mesh sort-merge join
-    otherwise. See ``docs/joins.md``."""
+    (default 64 MiB) or when no mesh is given; the shuffle-partitioned
+    hash join for oversized builds on a multi-shard mesh (string keys
+    included); the mesh sort-merge join when the shuffle is off
+    (``TFT_SHUFFLE=0``) and keys are numeric. The choice is
+    flight-recorded (``tft.why()``) and rendered by ``explain()``. See
+    ``docs/joins.md``."""
     on_l = [on] if isinstance(on, str) else list(on)
+    route = None
     if strategy is None:
-        strategy = "broadcast"
-        if mesh is not None and all(
-                left.schema.get(k) is not None
-                and left.schema[k].dtype.tensor for k in on_l):
-            # string keys can only broadcast (the dsort contract) —
-            # auto-routing must never pick a strategy that rejects a
-            # query broadcast can run
-            try:
-                limit = int(os.environ.get("TFT_BROADCAST_LIMIT_BYTES",
-                                           _DEFAULT_BROADCAST_LIMIT))
-            except ValueError:
-                limit = _DEFAULT_BROADCAST_LIMIT
-            from ..memory.estimate import frame_estimate
-            _, rbytes = frame_estimate(right)
-            if rbytes is None or rbytes > limit:
-                strategy = "sort_merge"
+        strategy, route = _route_join(left, right, on_l, mesh, how)
     if strategy == "broadcast":
-        return broadcast_join(left, right, on, how=how,
+        out = broadcast_join(left, right, on, how=how,
+                             indicator=indicator)
+    elif strategy == "sort_merge":
+        out = sort_merge_join(left, right, on, how=how, mesh=mesh,
                               indicator=indicator)
-    if strategy == "sort_merge":
-        return sort_merge_join(left, right, on, how=how, mesh=mesh,
-                               indicator=indicator)
-    raise ValueError(
-        f"unknown join strategy {strategy!r}; use 'broadcast' or "
-        f"'sort_merge'")
+    elif strategy == "partitioned":
+        out = partitioned_hash_join(left, right, on, how=how, mesh=mesh,
+                                    indicator=indicator)
+    else:
+        raise ValueError(
+            f"unknown join strategy {strategy!r}; use 'broadcast', "
+            f"'sort_merge', or 'partitioned'")
+    if route is not None:
+        from ..observability import flight as _flight
+        _flight.record("relational.join_route", **route)
+        out._join_route = route
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -772,6 +1035,16 @@ _REL_FAMILIES = (
     ("relational.sort_merge_joins",
      "tft_relational_sort_merge_joins_total",
      "Sort-merge joins defined."),
+    ("relational.partitioned_joins",
+     "tft_relational_partitioned_joins_total",
+     "Shuffle-partitioned hash joins defined."),
+    ("relational.partitioned_fallbacks",
+     "tft_relational_partitioned_fallbacks_total",
+     "Partitioned joins that fell back to broadcast (TFT_SHUFFLE=0, "
+     "single-shard mesh, or a degenerate empty side)."),
+    ("relational.partitioned_probe_rows",
+     "tft_relational_partitioned_probe_rows_total",
+     "Probe rows routed through the shuffle exchange."),
     ("relational.rows_joined", "tft_relational_rows_joined_total",
      "Join output rows produced."),
     ("relational.probe_dispatches",

@@ -136,6 +136,13 @@ def pytest_configure(config):
                    "re-placement, TFT_FABRIC=0 single-process parity "
                    "(run-tests.sh --fabric runs this lane standalone)")
     config.addinivalue_line(
+        "markers", "shuffle: hash-repartition exchange suite — "
+                   "placement/conservation properties, partitioned "
+                   "hash join vs the broadcast oracle, shuffle "
+                   "daggregate parity, TFT_SHUFFLE=0 bit-identity, "
+                   "device-loss recovery mid-exchange (run-tests.sh "
+                   "--shuffle runs this lane standalone)")
+    config.addinivalue_line(
         "markers", "timing: wall-clock-sensitive deadline assertions — "
                    "margins are widened for loaded machines "
                    "(TFT_TIMING_MARGIN multiplies the bounds; "

@@ -1,0 +1,455 @@
+"""Shuffle exchange suite: hash repartition + everything built on it.
+
+The acceptance spine (ISSUE 17 / ROADMAP item 3):
+
+- **exchange invariants**: every row lands on exactly one shard, the
+  shard is the one the host splitmix64 predicts (``hash(key) % S`` —
+  stable for a fixed shard count), string ride-alongs follow their
+  rows, received rows keep original global row order per shard, and
+  zero rows are lost or duplicated under an injected ``device:1`` loss
+  (``elastic_call`` shrink/reshard/re-run);
+- **partitioned hash join** is BIT-IDENTICAL to the broadcast oracle
+  across the equivalence suite — inner/left, duplicate keys,
+  multi-key, string ride-alongs, string KEYS, vector cells, empty
+  sides, filter-to-zero — with per-device build bytes O(R/S);
+- **shuffle daggregate** matches ``daggregate`` exactly for discrete
+  combiners, and the high-cardinality auto-route fires past
+  ``TFT_SHUFFLE_AGG_GROUPS``;
+- **TFT_SHUFFLE=0** restores the old routing (sort-merge for numeric
+  oversized builds, broadcast for string keys) bit-identically;
+- the routing decision is flight-recorded (``relational.join_route``)
+  and rendered by ``explain()``; exchange skew shows up as
+  ``mesh.exchange_*`` counters and an ``explain()`` imbalance line.
+
+No deadline-sensitive assertions here — nothing needs the ``timing``
+marker.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+import tensorframes_tpu as tft
+from tensorframes_tpu import memory as tmem
+from tensorframes_tpu import parallel as par
+from tensorframes_tpu import relational as rel
+from tensorframes_tpu.engine.ops import InvalidTypeError
+from tensorframes_tpu.observability import flight
+from tensorframes_tpu.parallel.exchange import (dexchange,
+                                                exchange_hash_host,
+                                                shuffle_daggregate)
+from tensorframes_tpu.relational.join import (broadcast_join, join,
+                                              partitioned_hash_join)
+from tensorframes_tpu.resilience import faults
+from tensorframes_tpu.utils.tracing import counters
+
+pytestmark = pytest.mark.shuffle
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    assert len(jax.devices()) == 8, "conftest must provide 8 CPU devices"
+    return par.local_mesh(8)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    faults.reset()
+    yield
+    faults.reset()
+    tmem._reset()
+
+
+def _snap(key):
+    return counters.snapshot().get(key, 0)
+
+
+def _rows(df):
+    """NaN-stable row tuples (left-join fills compare equal)."""
+    out = []
+    for r in df.collect():
+        row = []
+        for x in r:
+            a = np.asarray(x)
+            if a.dtype.kind == "f":
+                a = np.where(np.isnan(a), np.float64(1.25e300), a)
+            row.append(tuple(a.tolist()) if a.ndim else
+                       (a.item() if a.dtype.kind != "O" else x))
+        out.append(tuple(row))
+    return out
+
+
+def _shard_rows(ex, name):
+    """Per-shard valid slices of one column of an exchanged frame."""
+    S = ex.mesh.num_data_shards
+    rp = ex.padded_rows // S
+    col = ex.host_read_padded(name)
+    valid = ex.per_shard_valid()
+    return [col[s * rp: s * rp + int(valid[s])] for s in range(S)]
+
+
+def _frames(rng, nl=400, nr=160, multi=False, vec=False):
+    lk = rng.integers(0, 60, nl).astype(np.int64)
+    rk = rng.integers(0, 60, nr).astype(np.int64)
+    lc = {"k": lk, "lv": rng.standard_normal(nl),
+          "ltag": np.array([f"L{i}" for i in range(nl)], object)}
+    rc = {"k": rk, "rv": rng.standard_normal(nr),
+          "rtag": np.array([f"R{i}" for i in range(nr)], object)}
+    if multi:
+        lc["k2"] = rng.integers(0, 3, nl).astype(np.int64)
+        rc["k2"] = rng.integers(0, 3, nr).astype(np.int64)
+    if vec:
+        rc["rvec"] = rng.standard_normal((nr, 4))
+    return (tft.frame(lc, num_partitions=3),
+            tft.frame(rc, num_partitions=2))
+
+
+# ---------------------------------------------------------------------------
+# exchange placement / conservation properties
+# ---------------------------------------------------------------------------
+
+class TestExchangeInvariants:
+    def test_placement_matches_host_hash(self, mesh8, rng):
+        keys = rng.integers(-500, 500, 700).astype(np.int64)
+        df = tft.frame({"k": keys, "v": rng.standard_normal(700)})
+        ex = dexchange("k", par.distribute(df, mesh8))
+        pred = (exchange_hash_host([keys]) % np.uint64(8)).astype(int)
+        shards = _shard_rows(ex, "k")
+        # every row on exactly one shard — and the predicted one
+        assert sum(len(s) for s in shards) == 700
+        for s, got in enumerate(shards):
+            want = keys[pred == s]
+            assert np.array_equal(got, want), f"shard {s}"
+
+    def test_placement_stable_and_colocating(self, mesh8, rng):
+        # same values, different frames/order -> same shard per value
+        vals = rng.integers(0, 100, 300).astype(np.int64)
+        a = dexchange("k", par.distribute(tft.frame({"k": vals}), mesh8))
+        b = dexchange("k", par.distribute(
+            tft.frame({"k": vals[::-1].copy()}), mesh8))
+        for s in range(8):
+            sa = set(_shard_rows(a, "k")[s].tolist())
+            sb = set(_shard_rows(b, "k")[s].tolist())
+            assert sa == sb
+
+    def test_string_keys_and_ride_alongs(self, mesh8, rng):
+        n = 250
+        sk = np.array([f"key-{i % 37}" for i in range(n)], object)
+        tag = np.array([f"row{i}" for i in range(n)], object)
+        v = np.arange(n, dtype=np.int64)
+        ex = dexchange("s", par.distribute(
+            tft.frame({"s": sk, "v": v, "tag": tag}), mesh8))
+        vs = _shard_rows(ex, "v")
+        assert sum(len(x) for x in vs) == n
+        got_tags = []
+        for s in range(8):
+            ss = _shard_rows(ex, "s")[s]
+            ts = _shard_rows(ex, "tag")[s]
+            vv = vs[s]
+            # the string ride-alongs followed their rows
+            for si, ti, vi in zip(ss, ts, vv):
+                assert si == sk[vi] and ti == tag[vi]
+            got_tags.extend(ts)
+        assert sorted(got_tags) == sorted(tag.tolist())
+
+    def test_per_shard_original_order(self, mesh8, rng):
+        keys = rng.integers(0, 40, 500).astype(np.int64)
+        ex = dexchange("k", par.distribute(tft.frame(
+            {"k": keys, "i": np.arange(500, dtype=np.int64)}), mesh8))
+        for s in range(8):
+            idx = _shard_rows(ex, "i")[s]
+            assert np.all(np.diff(idx) > 0), \
+                f"shard {s} not in original row order"
+
+    def test_float_and_multi_key(self, mesh8, rng):
+        # -0.0 / 0.0 and NaN canonicalize to one destination
+        f = np.array([0.0, -0.0, np.nan, np.nan, 1.5, 1.5], np.float64)
+        g = np.array([1, 1, 2, 2, 3, 3], np.int64)
+        ex = dexchange(["f", "g"], par.distribute(
+            tft.frame({"f": f, "g": g}), mesh8))
+        assert int(ex.per_shard_valid().sum()) == 6
+        fs = _shard_rows(ex, "f")
+        for s in range(8):
+            gs = _shard_rows(ex, "g")[s]
+            # equal (f, g) pairs landed together: 0.0 with -0.0, NaN
+            # with NaN
+            if 1 in gs:
+                assert (gs == 1).sum() == 2
+            if 2 in gs:
+                assert np.isnan(fs[s][gs == 2]).all()
+                assert (gs == 2).sum() == 2
+
+    def test_device_loss_zero_lost_rows(self, mesh8, rng):
+        keys = rng.integers(0, 90, 640).astype(np.int64)
+        df = tft.frame({"k": keys,
+                        "i": np.arange(640, dtype=np.int64)})
+        lost0 = _snap("mesh.devices_lost")
+        with faults.inject("device", 1):
+            ex = dexchange("k", par.distribute(df, mesh8))
+        assert _snap("mesh.devices_lost") == lost0 + 1
+        S = ex.mesh.num_data_shards
+        assert S == 7  # shrunk
+        idx = np.concatenate(_shard_rows(ex, "i"))
+        assert sorted(idx.tolist()) == list(range(640))  # no loss/dup
+        # placement on the SURVIVING count matches the host hash
+        pred = (exchange_hash_host([keys]) % np.uint64(S)).astype(int)
+        for s in range(S):
+            got = _shard_rows(ex, "k")[s]
+            assert np.array_equal(got, keys[pred == s])
+
+    def test_single_shard_noop(self, rng):
+        m1 = par.local_mesh(1)
+        df = tft.frame({"k": np.arange(5, dtype=np.int64)})
+        dist = par.distribute(df, m1)
+        assert dexchange("k", dist) is dist
+
+    def test_skew_counters_and_explain(self, mesh8, rng):
+        flight.clear()
+        d0 = _snap("mesh.exchange_dispatches")
+        s0 = _snap("mesh.exchange_skew_events")
+        keys = np.zeros(400, np.int64)  # all rows -> one shard
+        ex = dexchange("k", par.distribute(tft.frame({"k": keys}),
+                                           mesh8))
+        assert _snap("mesh.exchange_dispatches") == d0 + 1
+        assert _snap("mesh.exchange_skew_events") == s0 + 1
+        assert _snap("mesh.exchange_rows") >= 400
+        recs = [r for r in flight.recent(kind="mesh.exchange_skew")]
+        assert recs and recs[-1]["rows"] == 400
+        text = ex.explain()
+        assert "exchange: partition imbalance" in text
+        assert "OVER TFT_SKEW_WARN" in text
+
+
+# ---------------------------------------------------------------------------
+# partitioned hash join vs the broadcast oracle
+# ---------------------------------------------------------------------------
+
+class TestPartitionedJoin:
+    @pytest.mark.parametrize("how", ["inner", "left"])
+    @pytest.mark.parametrize("multi", [False, True])
+    def test_broadcast_bit_identity(self, mesh8, rng, how, multi):
+        left, right = _frames(rng, multi=multi)
+        on = ["k", "k2"] if multi else "k"
+        b = broadcast_join(left, right, on, how=how)
+        p = partitioned_hash_join(left, right, on, how=how, mesh=mesh8)
+        assert b.schema.names == p.schema.names
+        assert _rows(b) == _rows(p)
+        assert [x.num_rows for x in b.blocks()] \
+            == [x.num_rows for x in p.blocks()]
+
+    def test_vector_cells_and_indicator(self, mesh8, rng):
+        left, right = _frames(rng, vec=True)
+        b = broadcast_join(left, right, "k", how="left", indicator="_m")
+        p = partitioned_hash_join(left, right, "k", how="left",
+                                  mesh=mesh8, indicator="_m")
+        assert _rows(b) == _rows(p)
+
+    @pytest.mark.parametrize("how", ["inner", "left"])
+    def test_string_keys(self, mesh8, rng, how):
+        ls = np.array([f"u{i % 23}" for i in range(300)], object)
+        rs = np.array([f"u{i % 31}" for i in range(120)], object)
+        left = tft.frame({"s": ls, "lv": rng.standard_normal(300)},
+                         num_partitions=2)
+        right = tft.frame({"s": rs, "rv": rng.standard_normal(120)})
+        b = broadcast_join(left, right, "s", how=how)
+        p = partitioned_hash_join(left, right, "s", how=how, mesh=mesh8)
+        assert _rows(b) == _rows(p)
+
+    def test_empty_sides_and_filter_to_zero(self, mesh8, rng):
+        left, right = _frames(rng)
+        r0 = tft.frame({"k": np.empty(0, np.int64),
+                        "rv": np.empty(0), "rtag": np.empty(0, object)})
+        b = broadcast_join(left, r0, "k", how="left")
+        p = partitioned_hash_join(left, r0, "k", how="left", mesh=mesh8)
+        assert _rows(b) == _rows(p)
+        lz = left.filter(lambda k: k < -1)  # keeps nothing
+        b = broadcast_join(lz, right, "k", how="inner")
+        p = partitioned_hash_join(lz, right, "k", how="inner",
+                                  mesh=mesh8)
+        assert _rows(b) == _rows(p) == []
+
+    def test_build_bytes_o_r_over_s(self, mesh8, rng):
+        left, right = _frames(rng, nl=1600, nr=1200)
+        p = partitioned_hash_join(left, right, "k", how="inner",
+                                  mesh=mesh8)
+        p.collect()
+        info = p._partitioned_info
+        assert info["shards"] == 8
+        # each device holds a fraction of the global build, not all
+        assert info["max_build_bytes"] * 2 < info["global_build_bytes"]
+        assert len(info["build_bytes"]) > 1
+
+    def test_device_loss_bit_identity(self, mesh8, rng):
+        left, right = _frames(rng, nl=500, nr=220)
+        oracle = _rows(broadcast_join(left, right, "k", how="inner"))
+        lost0 = _snap("mesh.devices_lost")
+        with faults.inject("device", 1):
+            p = partitioned_hash_join(left, right, "k", how="inner",
+                                      mesh=mesh8)
+            got = _rows(p)
+        assert got == oracle
+        assert _snap("mesh.devices_lost") == lost0 + 1
+
+    def test_mismatched_key_storage_raises(self, mesh8):
+        left = tft.frame({"k": np.arange(4, dtype=np.int64)})
+        right = tft.frame({"k": np.arange(4, dtype=np.int32),
+                           "v": np.arange(4.0)})
+        with pytest.raises(InvalidTypeError, match="cast one side"):
+            partitioned_hash_join(left, right, "k", mesh=mesh8)
+
+    def test_kill_switch_falls_back_to_broadcast(self, mesh8, rng,
+                                                 monkeypatch):
+        monkeypatch.setenv("TFT_SHUFFLE", "0")
+        left, right = _frames(rng)
+        f0 = _snap("relational.partitioned_fallbacks")
+        p = partitioned_hash_join(left, right, "k", how="inner",
+                                  mesh=mesh8)
+        assert _snap("relational.partitioned_fallbacks") == f0 + 1
+        assert p._plan_node.strategy == "broadcast"
+        assert _rows(p) == _rows(broadcast_join(left, right, "k",
+                                                how="inner"))
+
+
+# ---------------------------------------------------------------------------
+# join() auto-routing + observability
+# ---------------------------------------------------------------------------
+
+class TestJoinRouting:
+    def test_oversized_build_routes_partitioned(self, mesh8, rng,
+                                                monkeypatch):
+        monkeypatch.setenv("TFT_BROADCAST_LIMIT_BYTES", "1")
+        flight.clear()
+        left, right = _frames(rng)
+        out = join(left, right, "k", how="inner", mesh=mesh8)
+        assert out._plan_node.strategy == "partitioned"
+        assert out._join_route["strategy"] == "partitioned"
+        recs = [r for r in flight.recent(kind="relational.join_route")]
+        assert recs and recs[-1]["strategy"] == "partitioned"
+        assert recs[-1]["limit"] == 1
+        assert recs[-1]["est_build_bytes"] is not None
+
+    def test_oversized_string_keys_route_partitioned(self, mesh8, rng,
+                                                     monkeypatch):
+        # satellite 2: string-key builds over the limit now have a
+        # distributed option instead of falling back to broadcast
+        monkeypatch.setenv("TFT_BROADCAST_LIMIT_BYTES", "1")
+        ls = np.array([f"u{i % 9}" for i in range(60)], object)
+        rs = np.array([f"u{i % 11}" for i in range(40)], object)
+        left = tft.frame({"s": ls, "lv": rng.standard_normal(60)})
+        right = tft.frame({"s": rs, "rv": rng.standard_normal(40)})
+        out = join(left, right, "s", mesh=mesh8)
+        assert out._plan_node.strategy == "partitioned"
+        oracle = _rows(broadcast_join(left, right, "s"))
+        assert _rows(out) == oracle
+
+    def test_shuffle_off_restores_old_routing(self, mesh8, rng,
+                                              monkeypatch):
+        monkeypatch.setenv("TFT_BROADCAST_LIMIT_BYTES", "1")
+        monkeypatch.setenv("TFT_SHUFFLE", "0")
+        left, right = _frames(rng)
+        out = join(left, right, "k", mesh=mesh8)
+        assert out._plan_node.strategy == "sort_merge"
+        # string keys: broadcast (the pre-shuffle behavior)
+        ls = np.array([f"u{i % 9}" for i in range(30)], object)
+        left2 = tft.frame({"s": ls})
+        right2 = tft.frame({"s": ls[:10].copy(),
+                            "rv": rng.standard_normal(10)})
+        out2 = join(left2, right2, "s", mesh=mesh8)
+        assert out2._plan_node.strategy == "broadcast"
+
+    def test_small_build_stays_broadcast(self, mesh8, rng):
+        left, right = _frames(rng)
+        out = join(left, right, "k", mesh=mesh8)
+        assert out._plan_node.strategy == "broadcast"
+        assert out._join_route["reason"] == "build fits"
+
+    def test_sort_merge_string_error_names_partitioned(self, mesh8):
+        left = tft.frame({"s": np.array(["a", "b"], object)})
+        right = tft.frame({"s": np.array(["a"], object),
+                           "v": np.arange(1.0)})
+        with pytest.raises(InvalidTypeError, match="partitioned"):
+            rel.sort_merge_join(left, right, "s", mesh=mesh8)
+
+    def test_unknown_strategy_lists_partitioned(self, mesh8, rng):
+        left, right = _frames(rng)
+        with pytest.raises(ValueError, match="'partitioned'"):
+            join(left, right, "k", strategy="nope", mesh=mesh8)
+
+    def test_explain_renders_route(self, mesh8, rng, monkeypatch):
+        monkeypatch.setenv("TFT_BROADCAST_LIMIT_BYTES", "1")
+        left, right = _frames(rng)
+        out = join(left, right, "k", mesh=mesh8)
+        out.collect()
+        text = out.explain()
+        assert "auto-routed to 'partitioned'" in text
+        assert "shuffle  : partitioned build across" in text
+
+
+# ---------------------------------------------------------------------------
+# shuffle daggregate
+# ---------------------------------------------------------------------------
+
+class TestShuffleAggregate:
+    def test_matches_daggregate(self, mesh8, rng):
+        n = 900
+        keys = rng.integers(-40, 40, n).astype(np.int64)
+        df = tft.frame({"k": keys,
+                        "a": rng.integers(0, 1000, n).astype(np.int64),
+                        "b": rng.integers(0, 1000, n).astype(np.int64)})
+        fetches = {"a": "sum", "b": "min"}
+        r1 = par.daggregate(fetches, par.distribute(df, mesh8), ["k"])
+        r2 = shuffle_daggregate(fetches, par.distribute(df, mesh8),
+                                ["k"])
+        assert r1.schema.names == r2.schema.names
+        assert _rows(r1) == _rows(r2)
+
+    def test_string_keys_match(self, mesh8, rng):
+        n = 400
+        g = np.array([f"g{i % 19}" for i in range(n)], object)
+        df = tft.frame({"g": g,
+                        "v": rng.integers(0, 100, n).astype(np.int64)})
+        r1 = par.daggregate({"v": "max"}, par.distribute(df, mesh8),
+                            ["g"])
+        r2 = shuffle_daggregate({"v": "max"},
+                                par.distribute(df, mesh8), ["g"])
+        assert _rows(r1) == _rows(r2)
+
+    def test_auto_route_threshold(self, mesh8, rng, monkeypatch):
+        n = 600
+        keys = np.arange(n, dtype=np.int64)  # every row its own group
+        df = tft.frame({"k": keys,
+                        "v": rng.integers(0, 9, n).astype(np.int64)})
+        monkeypatch.setenv("TFT_SHUFFLE_AGG_GROUPS", "100")
+        a0 = _snap("mesh.shuffle_agg_routes")
+        r = par.daggregate({"v": "sum"}, par.distribute(df, mesh8),
+                           ["k"])
+        assert _snap("mesh.shuffle_agg_routes") == a0 + 1
+        monkeypatch.setenv("TFT_SHUFFLE", "0")
+        r0 = par.daggregate({"v": "sum"}, par.distribute(df, mesh8),
+                            ["k"])
+        assert _rows(r) == _rows(r0)
+
+    def test_kill_switch_delegates(self, mesh8, rng, monkeypatch):
+        monkeypatch.setenv("TFT_SHUFFLE", "0")
+        keys = rng.integers(0, 10, 100).astype(np.int64)
+        df = tft.frame({"k": keys,
+                        "v": rng.integers(0, 9, 100).astype(np.int64)})
+        s0 = _snap("mesh.shuffle_daggregates")
+        r = shuffle_daggregate({"v": "sum"},
+                               par.distribute(df, mesh8), ["k"])
+        assert _snap("mesh.shuffle_daggregates") == s0  # delegated
+        r1 = par.daggregate({"v": "sum"}, par.distribute(df, mesh8),
+                            ["k"])
+        assert _rows(r) == _rows(r1)
+
+    def test_device_loss_recovers(self, mesh8, rng):
+        n = 500
+        keys = rng.integers(0, 30, n).astype(np.int64)
+        df = tft.frame({"k": keys,
+                        "v": rng.integers(0, 50, n).astype(np.int64)})
+        oracle = _rows(par.daggregate({"v": "sum"},
+                                      par.distribute(df, mesh8), ["k"]))
+        with faults.inject("device", 1):
+            got = _rows(shuffle_daggregate(
+                {"v": "sum"}, par.distribute(df, mesh8), ["k"]))
+        assert got == oracle

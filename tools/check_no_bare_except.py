@@ -25,7 +25,11 @@
    error is a data-loss bug with no trace), the parallel layer's
    elastic recovery depends on device-loss errors REACHING its
    classifier (a swallowed mesh error turns a recoverable loss into
-   silent corruption or a later hang), and the memory layer's spill /
+   silent corruption or a later hang — and that includes the shuffle
+   exchange, ``parallel/exchange.py``: a swallowed error between its
+   two all_to_all phases would silently lose or duplicate rows, and
+   its row-conservation check exists precisely to turn that into a
+   loud failure), and the memory layer's spill /
    fault-back path moves user data between device and host (a silently
    swallowed spill error is silent data loss), the plan layer's
    fall-back-to-per-op decisions must be LOGGED (a silently swallowed
