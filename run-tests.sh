@@ -160,6 +160,17 @@ if [ "${1:-}" = "--shuffle" ]; then
   exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m shuffle "$@"
 fi
 
+# --sentinel: run only the performance-regression sentinel lane
+# (tests/test_sentinel.py: timeline ring + TFT_TIMELINE=0 bypass
+# bit-identity, cost attribution, rolling baselines + persistence,
+# the scripted TFT_FAULTS=perf:1 regression drill) — fast, CPU-only,
+# no native build needed
+if [ "${1:-}" = "--sentinel" ]; then
+  shift
+  echo "== sentinel lane (pytest -m sentinel, CPU) =="
+  exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m sentinel "$@"
+fi
+
 # --timing: run only the wall-clock-sensitive deadline tests, serially
 # (they flake under concurrent suite load; TFT_TIMING_MARGIN widens
 # their assertion bounds further on badly oversubscribed boxes)

@@ -35,7 +35,8 @@ from .utils.tracing import dump_stats
 from .schema import Field, Schema
 from .frame import Block, GroupedFrame, Row, TensorFrame
 from . import observability
-from .observability import doctor, health, last_query_report, why
+from .observability import doctor, health, last_query_report, regressions, why
+from .observability.timeline import timeline
 from .computation import Computation, TensorSpec, analyze_graph
 from .api import (
     aggregate, analyze, block, explain, filter_rows, frame, map_blocks,
@@ -85,6 +86,8 @@ __all__ = [
     "why",
     "health",
     "doctor",
+    "timeline",
+    "regressions",
     "dump_stats",
     "memory",
     "relational",

@@ -63,9 +63,11 @@ slot so depth tuning becomes visual.
 from __future__ import annotations
 
 import threading
+import time
 from collections import deque
 from typing import Callable, List, Optional, Sequence, TypeVar
 
+from ..observability import baseline as _baseline
 from ..observability import device as _obs_device
 from ..observability import events as _obs
 from ..resilience import check_deadline, env_int
@@ -307,10 +309,14 @@ def run_pipelined(blocks: Sequence[B],
             return True
         counters.inc("pipeline.slot_waits")
         t0 = trace.clock() if trace is not None else 0.0
+        # measured always-on (contended path only): the sentinel's cost
+        # vector attributes this wait in seconds, not just a count
+        w0 = time.perf_counter()
         while not pool.try_acquire(timeout=0.05):
             check_deadline("pipeline.slot")
             if window:
                 drain_one()
+        _baseline.note_wait(time.perf_counter() - w0)
         if trace is not None:
             trace.add("slot_wait", ts=t0, dur=trace.clock() - t0)
         return True

@@ -26,6 +26,12 @@ Two artifact families, one directory (``TFT_PERSIST_DIR`` or
   worker can serve a zero-dispatch warm hit for a plan it has never
   executed. The result dir is byte-budgeted (``TFT_PERSIST_RESULT_BYTES``)
   and swept oldest-first.
+- **baselines** (``<dir>/baselines/<fp>.perf``): the performance
+  sentinel's rolling per-fingerprint cost baselines
+  (``observability/baseline.py``), keyed by the same portable
+  fingerprints as results — a restarted worker's regression detector
+  stays calibrated instead of re-warming from zero. Tiny (a few
+  hundred bytes each), so no sweep; they age out with the directory.
 
 Durability here is best-effort by design: every write/read failure is
 logged and counted, never raised — a broken disk must degrade the
@@ -48,7 +54,7 @@ from ..utils.tracing import counters
 
 __all__ = ["configure", "root", "enabled", "save_checkpoint",
            "load_checkpoint", "discard_checkpoint", "save_result",
-           "load_result", "stats"]
+           "load_result", "save_baseline", "load_baseline", "stats"]
 
 _log = get_logger("memory.persist")
 
@@ -57,6 +63,7 @@ _override: Optional[str] = None  # configure() beats the env knob
 
 _CKPT_DIR = "checkpoints"
 _RES_DIR = "results"
+_BL_DIR = "baselines"
 
 # result-dir byte budget before the oldest-first sweep (default 512 MiB)
 _DEFAULT_RESULT_BYTES = 512 * 1024 * 1024
@@ -313,6 +320,46 @@ def load_result(fingerprint: str) -> Optional[List[Any]]:
     return blocks
 
 
+# -- performance-sentinel baselines ---------------------------------------
+
+def save_baseline(fingerprint: str, payload: dict) -> bool:
+    """Persist one plan fingerprint's rolling cost baseline
+    (``observability/baseline.py`` owns the payload shape). Best-effort
+    like everything here: a failure degrades that fingerprint's
+    regression detector to an in-memory re-warm after restart."""
+    d = _subdir(_BL_DIR)
+    if d is None:
+        return False
+    try:
+        blob = pickle.dumps({"version": 1, "baseline": payload},
+                            protocol=pickle.HIGHEST_PROTOCOL)
+    except Exception as e:
+        counters.inc("persist.write_errors")
+        _log.warning("baseline %s not picklable: %s", fingerprint[:16], e)
+        return False
+    path = os.path.join(d, _safe_name(fingerprint) + ".perf")
+    if not _atomic_write(path, blob):
+        return False
+    counters.inc("persist.baseline_writes")
+    return True
+
+
+def load_baseline(fingerprint: str) -> Optional[dict]:
+    """The persisted baseline payload for ``fingerprint``, or ``None``
+    (cold — the detector re-warms from live completions)."""
+    d = _subdir(_BL_DIR)
+    if d is None:
+        return None
+    rec = _read(os.path.join(d, _safe_name(fingerprint) + ".perf"))
+    if not isinstance(rec, dict) or rec.get("version") != 1:
+        return None
+    payload = rec.get("baseline")
+    if not isinstance(payload, dict):
+        return None
+    counters.inc("persist.baseline_loads")
+    return payload
+
+
 # -- introspection --------------------------------------------------------
 
 def _dir_stats(kind: str, suffix: str) -> Tuple[int, int]:
@@ -336,6 +383,7 @@ def stats() -> dict:
     """Tier snapshot for ``tft.health()``: what is on disk right now."""
     ckpt_n, ckpt_b = _dir_stats(_CKPT_DIR, ".ckpt")
     res_n, res_b = _dir_stats(_RES_DIR, ".res")
+    bl_n, bl_b = _dir_stats(_BL_DIR, ".perf")
     return {
         "enabled": enabled(),
         "dir": root(),
@@ -343,4 +391,6 @@ def stats() -> dict:
         "checkpoint_bytes": ckpt_b,
         "results": res_n,
         "result_bytes": res_b,
+        "baselines": bl_n,
+        "baseline_bytes": bl_b,
     }

@@ -42,6 +42,14 @@ package adds the query dimension on top of the existing primitives
   (``tft_serve_slo_*``, ``serve_report()`` lines, burn callbacks).
 - :mod:`.health` — ``tft.health()``: one machine-readable snapshot
   across ledger, mesh, serve, caches, streams, SLOs.
+- :mod:`.timeline` — the ALWAYS-ON telemetry timeline: a bounded ring
+  of periodic counter/gauge/histogram snapshots (``tft.timeline()``
+  answers "what changed in the last N minutes" without an external
+  Prometheus; ``TFT_TIMELINE=0`` bypasses the whole sentinel).
+- :mod:`.baseline` — per-query cost attribution keyed by plan
+  fingerprint, rolling EWMA+MAD baselines (persisted via the durable
+  tier), and the ``perf.regression`` detector
+  (``TFT_REGRESSION_SIGMA``; ``tft.regressions()``).
 
 Everything is zero-cost-when-off: with tracing disabled
 (``TFT_TRACE`` unset), :func:`query_trace` yields ``None`` and every
@@ -61,6 +69,9 @@ from .events import (DEVICE_TRACK_BASE, Event, QueryTrace, add_event,
 from . import device
 from . import flight
 from . import slo
+from . import timeline
+from . import baseline
+from .baseline import perf_stats, regressions
 from .decisions import doctor, why
 from .health import health
 from .metrics import metrics_port, metrics_text, serve_metrics, stop_metrics
@@ -75,6 +86,7 @@ __all__ = [
     "frame_report", "last_query_report", "render",
     "flight", "slo", "why", "doctor", "health",
     "SLO", "set_slo", "slo_status", "on_burn",
+    "timeline", "baseline", "regressions", "perf_stats",
 ]
 
 _log = get_logger("observability")
@@ -85,11 +97,13 @@ from .events import _on_span as _span_observer  # noqa: E402
 
 _tracing.set_span_observer(_span_observer)
 
-# the flight recorder's and SLO layer's metrics families register once
-# the provider registry exists (deferred: flight/slo are imported by
-# metrics' own import chain)
+# the flight recorder's, SLO layer's, and performance sentinel's
+# metrics families register once the provider registry exists
+# (deferred: flight/slo are imported by metrics' own import chain)
 flight._register_metrics()
 slo._register_metrics()
+timeline._register_metrics()
+baseline._register_metrics()
 
 
 def _maybe_autostart() -> None:

@@ -38,6 +38,7 @@ ANOMALY_KINDS = (
     "pipeline.sync_fallback", "engine.oom_split", "preempt.park",
     "fabric.worker_lost", "fabric.worker_crash", "fabric.replace",
     "fabric.admit_probe_failed", "mesh.exchange_skew",
+    "perf.regression",
 )
 
 
@@ -257,6 +258,14 @@ def _detail(r: Dict[str, Any]) -> str:
         return (f"worker {r.get('worker')} (epoch {r.get('epoch')}) "
                 f"FAILED its admission probe ({r.get('error')}); not "
                 f"admitted")
+    if k == "perf.regression":
+        return (f"PERF REGRESSION: latency {r.get('latency_s')}s vs "
+                f"baseline {r.get('baseline_latency_s')}s "
+                f"({r.get('latency_sigma')} sigma > "
+                f"TFT_REGRESSION_SIGMA) over {r.get('runs')} warm "
+                f"run(s) of plan {r.get('fingerprint')}…; most-moved: "
+                f"{r.get('component')} {r.get('baseline')} -> "
+                f"{r.get('observed')} ({r.get('sigma')} sigma)")
     skip = {"seq", "ts", "kind", "query"}
     kv = " ".join(f"{k2}={v!r}" for k2, v in r.items() if k2 not in skip)
     return kv or k
@@ -358,6 +367,15 @@ def doctor(max_per_kind: int = 5,
         f"  flight   : {'on' if fl['enabled'] else 'OFF'} · "
         f"{fl['records']}/{fl['capacity']} decision(s) buffered · "
         f"{fl['dumps']} dump(s)")
+    perf = snap.get("perf") or {}
+    tls = perf.get("timeline") or {}
+    lines.append(
+        f"  perf     : {'on' if perf.get('enabled') else 'OFF'} · "
+        f"{perf.get('warm_baselines', 0)}/{perf.get('baselines', 0)} "
+        f"baseline(s) warm over "
+        f"{perf.get('completions_total', 0)} completion(s) · "
+        f"{perf.get('regressions_total', 0)} regression(s) · timeline "
+        f"{tls.get('samples', 0)}/{tls.get('capacity', 0)} sample(s)")
     res = snap["resilience"]
     lines.append(
         f"  engine   : {res['retries']} retri(es), {res['giveups']} "
@@ -396,4 +414,33 @@ def doctor(max_per_kind: int = 5,
                              f"{_detail(r)}")
     else:
         lines.append("  recent anomalous decisions: none recorded")
+    # perf regressions grouped by plan fingerprint ACROSS workers: the
+    # same plan regressing on several workers is one fleet-wide story
+    # (a knob change, an eviction), not N separate ones — the merged
+    # per-worker dumps make that read off directly
+    by_fp: Dict[str, List[Dict[str, Any]]] = {}
+    for r in pool:
+        if r.get("kind") == "perf.regression" and r.get("fingerprint"):
+            by_fp.setdefault(str(r["fingerprint"]), []).append(r)
+    if by_fp:
+        lines.append(f"  perf regressions by plan fingerprint "
+                     f"({source}):")
+        now = time.time()
+        for fp in sorted(by_fp):
+            recs = by_fp[fp]
+            workers = sorted({str(r["worker"]) for r in recs
+                              if r.get("worker")})
+            comps = sorted({str(r.get("component")) for r in recs})
+            w_s = f", worker(s) {', '.join(workers)}" if workers else ""
+            lines.append(f"    plan {fp}… ({len(recs)} "
+                         f"regression(s){w_s}; component(s) "
+                         f"{', '.join(comps)}):")
+            for r in recs[-max_per_kind:]:
+                q = f" [{r['query']}]" if r.get("query") else ""
+                lines.append(
+                    f"      -{now - r.get('ts', now):7.1f}s{q} "
+                    f"{r.get('component')}: {r.get('baseline')} -> "
+                    f"{r.get('observed')} ({r.get('sigma')} sigma; "
+                    f"latency {r.get('latency_s')}s vs "
+                    f"{r.get('baseline_latency_s')}s)")
     return "\n".join(lines)

@@ -485,6 +485,18 @@ def _emit_slow(rec: Dict[str, Any]) -> None:
     else the logger. A slow query also triggers a flight-recorder dump
     when ``TFT_FLIGHT_DUMP`` is set — the decisions that made it slow
     are in the ring right now."""
+    try:
+        # the performance sentinel's live cost preview: the in-flight
+        # cost vector, the plan fingerprint, and the worst deviation
+        # against the stored baseline — a slow-query line should be
+        # self-diagnosing without a follow-up tft.why(). Lazy import:
+        # baseline imports flight, which this module already rides.
+        from . import baseline as _baseline
+        ctx = _baseline.slow_context()
+        if ctx is not None:
+            rec = {**rec, **ctx}
+    except Exception as e:
+        _log.debug("slow-query cost enrichment failed: %s", e)
     line = json.dumps(rec, default=str)
     _flight.maybe_dump("slow_query")
     path = os.environ.get("TFT_TRACE_FILE")

@@ -25,6 +25,7 @@ from typing import Any, Dict, List
 
 from ..utils import tracing
 from ..utils.logging import get_logger
+from . import baseline as _baseline
 from . import flight as _flight
 from . import slo as _slo
 
@@ -242,6 +243,12 @@ def _warnings(snap: Dict[str, Any]) -> List[str]:
             warns.append(
                 f"stream: {name!r} skipped {s['batches_skipped']} "
                 f"poisoned batch(es)")
+    perf = snap.get("perf") or {}
+    for r in perf.get("recent_regressions", []):
+        warns.append(
+            f"perf: query {r['query']} regressed {r['sigma']}x sigma "
+            f"past its baseline (plan {r['fingerprint']}…, most-moved "
+            f"{r['component']}) — tft.regressions() has the record")
     return warns
 
 
@@ -263,6 +270,7 @@ def health() -> Dict[str, Any]:
         "streams": _stream_section(),
         "slo": _slo.slo_status(),
         "flight": _flight.stats(),
+        "perf": _baseline.perf_stats(),
         "resilience": {
             "giveups": sum(v for k, v in counts.items()
                            if k.startswith("retry.")

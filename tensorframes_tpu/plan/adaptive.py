@@ -178,6 +178,11 @@ def record_stream_feedback(key: str, blocks: int, rows: int,
             fb.occupancy = float(occupancy)
         while len(_feedback) > _FEEDBACK_CAP:
             _feedback.popitem(last=False)
+    # one hook covers every feedback site (plan/execute forcings and
+    # all three plan/dist fused-stage paths): the same measured wall
+    # that calibrates layouts also attributes the serving query's cost
+    from ..observability import baseline as _baseline
+    _baseline.note_stage_wall(wall_s)
 
 
 def stream_feedback(key: str) -> Optional[StreamFeedback]:
@@ -564,6 +569,48 @@ def portable_fingerprint(frame) -> Optional[str]:
         return None
     raw = repr((tuple(parts), getattr(frame, "_version", 0)))
     return hashlib.sha256(raw.encode()).hexdigest()
+
+
+def query_fingerprint(frame) -> Optional[Tuple[str, bool]]:
+    """``(digest, portable)`` identity of a frame's chain for the
+    performance sentinel's cost baselines
+    (``observability/baseline.py``), or ``None`` when the chain has no
+    usable identity. Portable (parquet-rooted) chains reuse
+    :func:`portable_fingerprint` verbatim, so the baseline key matches
+    the durable result tier's and survives restarts. In-memory-rooted
+    chains get a process-local digest: structural computation
+    signatures where available, the source frame's SCHEMA and row
+    estimate at the leaf — stable across repeated re-submissions of
+    the same logical query (fresh frame objects per request, same
+    shape of data: the recurring-query case the sentinel exists for),
+    never persisted."""
+    pfp = portable_fingerprint(frame)
+    if pfp is not None:
+        return pfp, True
+    node = getattr(frame, "_plan_node", None)
+    if node is None:
+        return None
+    parts: List[tuple] = []
+    depth = 0
+    while node is not None and depth < 256:
+        fp = _portable_node_fp(node)
+        if fp is None:
+            if node.kind == "source" and node.frame is not None:
+                f = node.frame
+                try:
+                    rows = f.estimated_rows()
+                except Exception:  # noqa: BLE001 - lazy source
+                    rows = None
+                fp = ("src", repr(getattr(f, "schema", None)), rows)
+            else:
+                return None  # join/exotic leaf: ambiguous, no baseline
+        parts.append(fp)
+        node = node.input
+        depth += 1
+    if node is not None or len(parts) < 2:
+        return None
+    raw = repr((tuple(parts), getattr(frame, "_version", 0)))
+    return hashlib.sha256(raw.encode()).hexdigest(), False
 
 
 def _warm_lookup(frame, key, validators, comps) -> Optional[List]:

@@ -307,6 +307,11 @@ def _run(plan: ExecPlan, leaf_blocks, frame=None) -> List:
         layout = _adaptive.choose_layout(
             plan, leaf_blocks, _pipeline.pipeline_depth(None), tag)
     t0 = _time.perf_counter()
+    # the regression drill's deterministic slowdown (TFT_FAULTS=perf:N)
+    # lands INSIDE the measured forcing wall, so the sentinel attributes
+    # it to stage_wall_s like any real stage-level slowdown
+    from ..resilience import faults as _faults
+    _faults.slowdown("perf")
     if layout is not None:
         out = _run_adaptive(plan, layout, frame)
     else:

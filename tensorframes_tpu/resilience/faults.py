@@ -44,6 +44,14 @@ worker       ``engine.preempt.boundary`` (running query) and
              crashed, so ``TFT_FAULTS=worker:1`` deterministically kills
              one serving worker mid-query; the fabric declares it
              ``worker_lost`` and resumes elsewhere (``docs/serving.md``)
+perf         ``plan.execute`` forcings and ``plan.dist`` fused-stage
+             dispatch — NEVER raises: :func:`slowdown` consumes the
+             budget and SLEEPS ``TFT_FAULT_PERF_S`` seconds (default
+             0.05) inside the timed stage, so
+             ``TFT_FAULTS=perf:1`` deterministically makes the next
+             forcing slower with correct stage attribution — the
+             performance-regression sentinel's drill
+             (``docs/observability.md``)
 ========== ===========================================================
 
 Counting is deterministic (a lock-guarded integer per site, decremented
@@ -61,7 +69,8 @@ from typing import Dict, Iterator, Optional
 from ..utils.logging import get_logger
 from ..utils.tracing import counters
 
-__all__ = ["InjectedFault", "inject", "check", "arm", "reset", "active"]
+__all__ = ["InjectedFault", "inject", "check", "arm", "reset", "active",
+           "slowdown"]
 
 _log = get_logger("resilience.faults")
 
@@ -203,6 +212,32 @@ def check(site: str) -> None:
     _log.info("injecting fault at site %r (%d more scripted)",
               site, left - 1)
     raise InjectedFault(site, message, transient=transient)
+
+
+def slowdown(site: str = "perf") -> float:
+    """The sleep-shaped sibling of :func:`check`: while the site's
+    budget lasts, sleep ``TFT_FAULT_PERF_S`` seconds (default 0.05)
+    INSIDE the caller's timed region and return the duration slept —
+    never raises, so the query completes normally, just slower. This is
+    how the regression sentinel's drill injects a deterministic,
+    correctly-attributed slowdown (``TFT_FAULTS=perf:1``). Returns 0.0
+    on the disarmed path (one memoized env read + a locked dict
+    lookup, same as :func:`check`)."""
+    _arm_from_env()
+    with _state.lock:
+        left = _state.budgets.get(site, 0)
+        if left <= 0:
+            return 0.0
+        _state.budgets[site] = left - 1
+    from .policy import env_float
+    dur = max(env_float("TFT_FAULT_PERF_S", 0.05), 0.0)
+    counters.inc(f"faults.{site}.injected")
+    _log.info("injecting %.3fs slowdown at site %r (%d more scripted)",
+              dur, site, left - 1)
+    if dur:
+        import time
+        time.sleep(dur)
+    return dur
 
 
 @contextlib.contextmanager

@@ -86,6 +86,7 @@ from typing import Any, Callable, Dict, List, Mapping, Optional, Tuple
 from ..engine import executor as _executor
 from ..engine import pipeline as _pipeline
 from ..engine import preempt as _preempt
+from ..observability import baseline as _baseline
 from ..observability import device as _obs_device
 from ..observability import events as _obs
 from ..observability import flight as _flight
@@ -755,8 +756,13 @@ class QueryScheduler:
         return True
 
     def _execute(self, q: SubmittedQuery) -> None:
+        # the cost capture rides INSIDE the flight scope: the sentinel's
+        # regression record correlates to the same query id, and a
+        # preempted run that requeues discards its partial capture at
+        # this context exit (partial runs must not calibrate baselines)
         with _flight.scope(q.query_id, worker=self.worker_id):
-            self._execute_scoped(q)
+            with _baseline.capture(q.query_id, tenant=q.tenant):
+                self._execute_scoped(q)
 
     def _execute_scoped(self, q: SubmittedQuery) -> None:
         # everything inside runs under the flight-recorder correlation
@@ -840,6 +846,9 @@ class QueryScheduler:
                 raise
             self._finish(q, t, error=e)
             return
+        # fingerprint the result chain while the frame is in hand — the
+        # sentinel keys this completion's cost vector by it in _finish
+        _baseline.note_result_frame(result)
         self._finish(q, t, result=result)
 
     def _requeue_preempted(self, q: SubmittedQuery, t: _Tenant) -> None:
@@ -1074,20 +1083,36 @@ class QueryScheduler:
                 key = "rejected"
             else:
                 key = "failed"
-        histograms.observe("query_latency_seconds", dur, op="serve",
-                           tenant=t.name, outcome=outcome)
-        counters.inc(f"serve.{key}")
-        _flight.record("serve.finish", query=q.query_id, tenant=t.name,
-                       outcome=key, latency_s=round(dur, 6))
-        # SLO burn-rate callbacks evaluate off the completion path
-        # (throttled per tenant; docs/observability.md)
-        _slo.note_completion(t.name)
+        # tenant bookkeeping BEFORE the observability tail: the future
+        # resolved at q._complete above, so a caller holding result()
+        # may read snapshot() at any moment — the counts must already
+        # reflect this completion (the baseline finalize below walks
+        # counter registries and can take milliseconds under load)
         with self._cond:
             self._queries.pop(q.query_id, None)
             t.inflight -= 1
             t.counts[key] += 1
             gauge("serve.inflight", self._inflight_locked())
             self._cond.notify_all()
+        histograms.observe("query_latency_seconds", dur, op="serve",
+                           tenant=t.name, outcome=outcome)
+        counters.inc(f"serve.{key}")
+        # close out the cost capture: fold the vector into the plan
+        # fingerprint's baseline and run the regression check (only
+        # "completed" calibrates; the capture contextvar is still live
+        # because _finish runs inside _execute's capture scope). The
+        # baseline gets EXECUTION latency, not end-to-end: queue wait
+        # under a burst is a scheduling condition the SLO layer already
+        # watches — folding it in makes every congested query look like
+        # a plan regression
+        run_s = dur if q.started_at is None \
+            else q.finished_at - q.started_at
+        _baseline.finalize(latency_s=run_s, outcome=key)
+        _flight.record("serve.finish", query=q.query_id, tenant=t.name,
+                       outcome=key, latency_s=round(dur, 6))
+        # SLO burn-rate callbacks evaluate off the completion path
+        # (throttled per tenant; docs/observability.md)
+        _slo.note_completion(t.name)
 
     def request_park_all(self, reason: str = "drain") -> int:
         """Ask every RUNNING query to park at its next block boundary

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional
 
+from ..observability import baseline as _baseline
 from ..observability import slo as _slo
 from ..observability.metrics import (_escape_label as _escape,
                                      register_metrics_provider,
@@ -151,6 +152,16 @@ class ServerStats:
                     f"    preempted {s.get('preempted', 0)} "
                     f"(checkpointed + resumed) · "
                     f"cancelled {s.get('cancelled', 0)}")
+            regs = [r for r in _baseline.regressions()
+                    if r.get("tenant") == name]
+            if regs:
+                last = regs[-1]
+                lines.append(
+                    f"    PERF: {len(regs)} regression(s) flagged · "
+                    f"last: plan {last['fingerprint'][:16]}… "
+                    f"{last['component']} {last['baseline']:g} -> "
+                    f"{last['observed']:g} ({last['sigma']:g} sigma; "
+                    f"tft.regressions())")
         cc = self.compile_cache()
         if cc is not None:
             lines.append(

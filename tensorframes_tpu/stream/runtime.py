@@ -56,8 +56,10 @@ from typing import Any, Callable, Dict, List, Optional
 
 from ..engine import pipeline as _pipeline
 from ..frame import TensorFrame
+from ..observability import baseline as _baseline
 from ..observability import events as _obs
 from ..observability import metrics as _metrics
+from ..observability import timeline as _timeline
 from ..resilience import (QueryInterrupted, check_deadline,
                           default_policy, env_bool, env_int, error_kind,
                           faults)
@@ -444,6 +446,9 @@ class StreamHandle:
         counters.inc("stream.batches")
         counters.inc("stream.rows", rows)
         gauge("stream.batch_seconds", dt)
+        # batch boundaries are the timeline's beat on streaming-only
+        # processes (interval-gated; off-interval cost is one compare)
+        _timeline.maybe_sample()
         for frame in outputs:
             self._deliver(frame)
 
@@ -467,8 +472,12 @@ class StreamHandle:
         counters.inc("stream.slot_waits")
         tr = _obs.current_trace()
         t0 = tr.clock() if tr is not None else 0.0
+        # measured always-on (contended path only) for the sentinel's
+        # per-query slot_wait_s attribution
+        w0 = time.perf_counter()
         while not pool.try_acquire(timeout=0.05):
             check_deadline("stream.slot")
+        _baseline.note_wait(time.perf_counter() - w0)
         if tr is not None:
             tr.add("slot_wait", ts=t0, dur=tr.clock() - t0)
         return pool

@@ -208,6 +208,14 @@ class Counters:
         with self._lock:
             return self._counts.get(name, 0)
 
+    def get_many(self, names) -> Dict[str, int]:
+        """Read several counters under one lock acquisition without
+        copying the whole registry (the per-query cost capture reads a
+        fixed family set on every serve completion)."""
+        with self._lock:
+            g = self._counts.get
+            return {n: g(n, 0) for n in names}
+
     def snapshot(self) -> Dict[str, int]:
         with self._lock:
             return dict(self._counts)
@@ -282,6 +290,14 @@ class Histograms:
     def snapshot(self) -> Dict[tuple, Dict[str, object]]:
         with self._lock:
             return {k: v.as_dict() for k, v in self._hists.items()}
+
+    def family_sum(self, family: str) -> float:
+        """Summed observations across every label set of one family,
+        without materializing bucket copies (the per-query cost capture
+        reads the ``compile_seconds`` total on every serve completion)."""
+        with self._lock:
+            return sum(h.sum for (fam, _), h in self._hists.items()
+                       if fam == family)
 
     def reset(self) -> None:
         with self._lock:

@@ -143,6 +143,14 @@ def pytest_configure(config):
                    "device-loss recovery mid-exchange (run-tests.sh "
                    "--shuffle runs this lane standalone)")
     config.addinivalue_line(
+        "markers", "sentinel: performance-regression sentinel suite — "
+                   "telemetry timeline ring + TFT_TIMELINE=0 bypass "
+                   "bit-identity, per-query cost attribution, rolling "
+                   "plan-fingerprint baselines with persistence, the "
+                   "scripted regression drill (TFT_FAULTS=perf:1) "
+                   "through every operator surface (run-tests.sh "
+                   "--sentinel runs this lane standalone)")
+    config.addinivalue_line(
         "markers", "timing: wall-clock-sensitive deadline assertions — "
                    "margins are widened for loaded machines "
                    "(TFT_TIMING_MARGIN multiplies the bounds; "

@@ -562,7 +562,8 @@ class TestMetricsConformance:
             providers = metrics.registered_providers()
             # the sweep must actually cover the fleet
             for expected in ("flight", "serve.slo", "plan.adaptive",
-                             "mesh", "memory", "relational", "stream"):
+                             "mesh", "memory", "relational", "stream",
+                             "perf", "timeline"):
                 assert expected in providers, providers
             assert any(p.startswith("serve:") for p in providers)
             text = metrics.metrics_text()

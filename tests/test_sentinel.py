@@ -1,0 +1,353 @@
+"""Performance-regression sentinel suite (tier-1; marker ``sentinel``;
+``run-tests.sh --sentinel``).
+
+The load-bearing contracts:
+
+- the telemetry timeline is ALWAYS-ON, bounded, and opportunistic (no
+  background thread): query finishes and stream batch boundaries take
+  interval-gated snapshots, ``tft.timeline(family)`` answers deltas and
+  rates over a window, and ``TFT_TIMELINE=0`` bypasses sampling, cost
+  capture, AND regression detection bit-identically;
+- every served completion assembles a cost vector (latency, compile
+  delta, fused-stage wall, slot waits, spill/fault bytes, dispatches)
+  keyed by the plan fingerprint, folded into a rolling EWMA + MAD
+  baseline; portable (parquet-rooted) baselines round-trip through the
+  ``memory/persist.py`` durable tier;
+- the scripted drill: K warm runs of a fingerprinted query, then ONE
+  ``TFT_FAULTS=perf:1`` slowdown injected inside the measured stage
+  wall, must flag EXACTLY ONE ``perf.regression`` naming that
+  fingerprint and ``stage_wall_s`` as the most-moved component —
+  with ``TFT_TRACE`` off — and surface it through ``tft.regressions()``,
+  ``tft.why()``, ``tft.doctor()``, ``tft.health()`` warnings, and
+  ``serve_report()``.
+
+Sleep-based assertions are ``timing``-marked with ``timing_margin()``
+per the tier-1 flake note.
+"""
+
+import numpy as np
+import pytest
+
+import tensorframes_tpu as tft
+from conftest import timing_margin
+from tensorframes_tpu.memory import persist
+from tensorframes_tpu.observability import baseline, flight, timeline
+from tensorframes_tpu.resilience import faults
+from tensorframes_tpu.serve import QueryScheduler
+from tensorframes_tpu.serve.stats import serve_report
+from tensorframes_tpu.utils import tracing
+
+pytestmark = pytest.mark.sentinel
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    for var in ("TFT_TIMELINE", "TFT_TIMELINE_INTERVAL_S",
+                "TFT_TIMELINE_SAMPLES", "TFT_BASELINE_SAMPLES",
+                "TFT_BASELINE_MIN", "TFT_REGRESSION_SIGMA",
+                "TFT_REGRESSION_MIN_FRAC", "TFT_REGRESSION_MIN_S",
+                "TFT_FAULT_PERF_S", "TFT_FAULTS", "TFT_FLIGHT",
+                "TFT_PERSIST_DIR"):
+        monkeypatch.delenv(var, raising=False)
+    tracing.disable()
+    faults.reset()
+    flight.clear()
+    baseline.clear()
+    timeline.clear()
+    yield
+    faults.reset()
+    flight.clear()
+    baseline.clear()
+    timeline.clear()
+    tracing.disable()
+
+
+def _frame(n=256, offset=0.0):
+    return tft.frame({"x": np.arange(float(n)) + offset},
+                     num_partitions=4)
+
+
+def _fused(n=256, offset=0.0):
+    # two chained map_blocks so the forcing takes the FUSED plan path
+    # (plan/execute._run) — where the perf fault site and the
+    # stage-wall feedback hook both live
+    return _frame(n, offset).map_blocks(lambda x: {"y": x * 2.0 + 1.0}) \
+                            .map_blocks(lambda y: {"z": y - 3.0})
+
+
+def _run_one(sched, frame, tenant="drill"):
+    fut = sched.submit(frame, tenant=tenant)
+    sched.step()
+    return fut.result(timeout=timing_margin(30))
+
+
+# ---------------------------------------------------------------------------
+# timeline ring
+# ---------------------------------------------------------------------------
+
+class TestTimeline:
+    def test_sample_now_and_query(self, monkeypatch):
+        monkeypatch.setenv("TFT_TIMELINE_INTERVAL_S", "0")
+        tracing.counters.inc("sentineltest.widgets", 5)
+        assert timeline.sample_now()
+        tracing.counters.inc("sentineltest.widgets", 7)
+        assert timeline.sample_now()
+        tl = tft.timeline("sentineltest.widgets")
+        assert tl["samples"] >= 2
+        # the delta between the two snapshots is exactly the increment
+        assert tl["deltas"][-1]["delta"] == 7
+        assert tl["total_delta"] >= 7
+        assert "sentineltest.widgets" in timeline.families()
+
+    def test_prefix_aggregation(self, monkeypatch):
+        monkeypatch.setenv("TFT_TIMELINE_INTERVAL_S", "0")
+        assert timeline.sample_now()
+        tracing.counters.inc("sentineltest.a", 3)
+        tracing.counters.inc("sentineltest.b", 4)
+        assert timeline.sample_now()
+        tl = tft.timeline("sentineltest")  # prefix sums a + b
+        assert tl["deltas"][-1]["delta"] == 7
+
+    def test_ring_is_bounded(self, monkeypatch):
+        monkeypatch.setenv("TFT_TIMELINE_INTERVAL_S", "0")
+        monkeypatch.setenv("TFT_TIMELINE_SAMPLES", "4")
+        timeline.clear()
+        for _ in range(10):
+            assert timeline.sample_now()
+        st = timeline.stats()
+        assert st["samples"] == 4
+        assert st["capacity"] == 4
+        assert st["taken_total"] == 10
+        assert st["dropped_total"] == 6
+
+    def test_interval_gates_maybe_sample(self, monkeypatch):
+        monkeypatch.setenv("TFT_TIMELINE_INTERVAL_S", "3600")
+        timeline.clear()
+        assert timeline.maybe_sample()
+        for _ in range(5):
+            assert not timeline.maybe_sample()  # inside the interval
+        assert timeline.stats()["samples"] == 1
+
+    def test_window_filter(self, monkeypatch):
+        monkeypatch.setenv("TFT_TIMELINE_INTERVAL_S", "0")
+        assert timeline.sample_now()
+        assert timeline.sample_now()
+        assert len(timeline.recent_samples()) >= 2
+        assert timeline.recent_samples(window_s=0.0) == []
+
+
+# ---------------------------------------------------------------------------
+# TFT_TIMELINE=0: whole-sentinel bypass, bit-identical results
+# ---------------------------------------------------------------------------
+
+class TestBypass:
+    def test_disabled_takes_no_samples(self, monkeypatch):
+        monkeypatch.setenv("TFT_TIMELINE", "0")
+        assert not timeline.enabled()
+        assert not baseline.enabled()
+        assert not timeline.sample_now()
+        assert not timeline.maybe_sample()
+        assert timeline.stats()["samples"] == 0
+        assert tft.timeline("anything")["samples"] == 0
+
+    def test_disabled_capture_is_a_noop(self, monkeypatch):
+        monkeypatch.setenv("TFT_TIMELINE", "0")
+        with baseline.capture("bypass-q", tenant="t"):
+            baseline.note_stage_wall(1.0)
+            baseline.note_wait(1.0)
+            assert baseline.slow_context() is None
+            assert baseline.finalize(latency_s=9.9) is None
+        assert baseline.perf_stats()["baselines"] == 0
+        assert baseline.perf_stats()["completions_total"] == 0
+
+    def test_disabled_results_bit_identical(self, monkeypatch):
+        with QueryScheduler(workers=0, name="byp-on") as s:
+            on = _run_one(s, _fused()).blocks()
+        monkeypatch.setenv("TFT_TIMELINE", "0")
+        with QueryScheduler(workers=0, name="byp-off") as s:
+            off = _run_one(s, _fused()).blocks()
+        assert len(on) == len(off)
+        for a, b in zip(on, off):
+            for name in a.columns:
+                np.testing.assert_array_equal(
+                    np.asarray(a.columns[name]),
+                    np.asarray(b.columns[name]))
+
+
+# ---------------------------------------------------------------------------
+# cost attribution + baselines
+# ---------------------------------------------------------------------------
+
+class TestBaselines:
+    def test_completion_builds_a_baseline(self, monkeypatch):
+        monkeypatch.setenv("TFT_BASELINE_MIN", "2")
+        with QueryScheduler(workers=0, name="bl") as s:
+            for _ in range(3):
+                _run_one(s, _fused())
+        st = baseline.perf_stats()
+        assert st["baselines"] == 1  # same logical plan every time
+        assert st["warm_baselines"] == 1
+        assert st["completions_total"] == 3
+
+    def test_vector_components_present(self):
+        with QueryScheduler(workers=0, name="vec") as s:
+            _run_one(s, _fused())
+        (bl,) = baseline._baselines.values()
+        for comp in baseline.COMPONENTS:
+            assert comp in bl.window, comp
+        # the fused forcing's stage wall was attributed
+        assert bl.window["stage_wall_s"][-1] > 0.0
+        assert bl.window["latency_s"][-1] > 0.0
+
+    def test_failed_runs_do_not_calibrate(self):
+        with QueryScheduler(workers=0, name="fail") as s:
+            faults.arm("dispatch", 100, transient=False)
+            fut = s.submit(_fused(), tenant="t")
+            s.step()
+            with pytest.raises(Exception):
+                fut.result(timeout=timing_margin(30))
+        assert baseline.perf_stats()["baselines"] == 0
+
+    def test_fingerprint_stable_across_resubmission(self):
+        from tensorframes_tpu.plan.adaptive import query_fingerprint
+        fp1 = query_fingerprint(_fused())
+        fp2 = query_fingerprint(_fused())
+        assert fp1 is not None and fp1 == fp2
+        # a different chain gets a different fingerprint
+        fp3 = query_fingerprint(
+            _frame().map_blocks(lambda x: {"w": x * x})
+                    .map_blocks(lambda w: {"v": w + 1.0}))
+        assert fp3 is not None and fp3 != fp1
+
+    def test_portable_baseline_persists(self, tmp_path):
+        prev = persist.configure(str(tmp_path))
+        try:
+            bl = baseline.Baseline("f" * 64, portable=True)
+            bl.update({c: 1.0 for c in baseline.COMPONENTS})
+            baseline._save_persisted(bl)
+            assert persist.stats()["baselines"] == 1
+            loaded = baseline.Baseline.from_payload(
+                persist.load_baseline("f" * 64))
+            assert loaded is not None
+            assert loaded.count == 1
+            assert list(loaded.window["latency_s"]) == [1.0]
+            # process-local fingerprints never touch disk
+            local = baseline.Baseline("e" * 64, portable=False)
+            local.update({c: 1.0 for c in baseline.COMPONENTS})
+            baseline._save_persisted(local)
+            assert persist.stats()["baselines"] == 1
+        finally:
+            persist.configure(prev)
+
+    def test_regression_math_guards(self):
+        bl = baseline.Baseline("a" * 64, portable=False)
+        for _ in range(8):
+            bl.update({c: (1.0 if c == "latency_s" else 0.0)
+                       for c in baseline.COMPONENTS})
+        z, med = bl.deviation("latency_s", 1.0)
+        assert med == 1.0 and z == 0.0
+        # far beyond any MAD floor: sigma is huge
+        z, _ = bl.deviation("latency_s", 5.0)
+        assert z > 100
+
+
+# ---------------------------------------------------------------------------
+# the scripted regression drill
+# ---------------------------------------------------------------------------
+
+@pytest.mark.timing
+class TestRegressionDrill:
+    def test_drill_flags_exactly_one_regression(self, monkeypatch):
+        # K warm runs, then one injected slowdown INSIDE the measured
+        # stage wall — TFT_TRACE stays off the whole way (the sentinel
+        # must not depend on tracing)
+        monkeypatch.setenv("TFT_BASELINE_MIN", "3")
+        slow_s = timing_margin(0.5)
+        monkeypatch.setenv("TFT_FAULT_PERF_S", str(slow_s))
+        with QueryScheduler(workers=0, name="drill") as s:
+            for _ in range(6):
+                out = _run_one(s, _fused())
+            from tensorframes_tpu.plan.adaptive import query_fingerprint
+            expected_fp = query_fingerprint(_fused())[0]
+            assert baseline.perf_stats()["warm_baselines"] == 1
+            faults.arm("perf", 1)
+            _run_one(s, _fused())
+            regs = tft.regressions()
+            assert len(regs) == 1, regs
+            reg = regs[0]
+            assert reg["fingerprint"] == expected_fp
+            assert reg["component"] == "stage_wall_s"
+            assert reg["observed"] >= slow_s
+            assert reg["latency_s"] > reg["baseline_latency_s"]
+            assert reg["tenant"] == "drill"
+            # one flight anomaly, input-leading in tft.why()
+            recs = flight.recent("perf.regression")
+            assert len(recs) == 1
+            assert recs[0]["query"] == reg["query"]
+            assert recs[0]["component"] == "stage_wall_s"
+            why = tft.why(reg["query"])
+            assert "PERF REGRESSION" in why
+            assert "stage_wall_s" in why
+            # health warning names the most-moved component
+            warns = [w for w in tft.health()["warnings"]
+                     if w.startswith("perf:")]
+            assert len(warns) == 1
+            assert "stage_wall_s" in warns[0]
+            # serve_report per-tenant row
+            report = serve_report(s)
+            assert "PERF: 1 regression(s)" in report
+            assert expected_fp[:16] in report
+            # a healthy follow-up run (same warm scheduler: no fresh
+            # compile to pay) does NOT flag again — the rolling window
+            # is MAD-robust to the one slow outlier it absorbed
+            _run_one(s, _fused())
+            assert len(tft.regressions()) == 1
+        # doctor groups by fingerprint
+        doc = tft.doctor()
+        assert "perf regressions by plan fingerprint" in doc
+        assert expected_fp[:16] in doc
+
+    def test_drill_quiet_when_disabled(self, monkeypatch):
+        monkeypatch.setenv("TFT_TIMELINE", "0")
+        monkeypatch.setenv("TFT_BASELINE_MIN", "3")
+        monkeypatch.setenv("TFT_FAULT_PERF_S",
+                           str(timing_margin(0.3)))
+        with QueryScheduler(workers=0, name="quiet") as s:
+            for _ in range(4):
+                _run_one(s, _fused())
+            faults.arm("perf", 1)
+            _run_one(s, _fused())
+        assert tft.regressions() == []
+        assert flight.recent("perf.regression") == []
+
+
+# ---------------------------------------------------------------------------
+# slow-query enrichment + metrics
+# ---------------------------------------------------------------------------
+
+class TestSurfaces:
+    def test_slow_context_carries_cost_vector(self):
+        with baseline.capture("slowq", tenant="t"):
+            baseline.note_stage_wall(0.25)
+            ctx = baseline.slow_context()
+        assert ctx is not None
+        assert ctx["cost"]["stage_wall_s"] == 0.25
+        for comp in baseline.COMPONENTS:
+            assert comp in ctx["cost"]
+
+    def test_metrics_providers_render(self):
+        from tensorframes_tpu.observability import metrics
+        providers = metrics.registered_providers()
+        assert "perf" in providers
+        assert "timeline" in providers
+        text = metrics.metrics_text()
+        assert "tft_perf_baselines" in text
+        assert "tft_perf_regressions_total" in text
+        assert "tft_timeline_samples_total" in text
+
+    def test_perf_stats_shape(self):
+        st = baseline.perf_stats()
+        for key in ("enabled", "baselines", "warm_baselines",
+                    "completions_total", "regressions_total",
+                    "recent_regressions", "timeline"):
+            assert key in st

@@ -10,10 +10,14 @@
 2. No ``except Exception: pass`` under ``tensorframes_tpu/observability/``,
    — a rule that now covers the always-on flight-recorder layer
    (``observability/flight.py``, ``decisions.py``, ``slo.py``,
-   ``health.py``): a silently swallowed ring write, dump, SLO burn
-   evaluation, or health probe would erase exactly the post-mortem
-   evidence the layer exists to keep (a flight recorder that loses its
-   own records without a log line is worse than none) —
+   ``health.py``) and the performance sentinel
+   (``observability/timeline.py``, ``baseline.py``): a silently
+   swallowed ring write, dump, SLO burn evaluation, health probe,
+   timeline sample, or baseline update/persist would erase exactly the
+   post-mortem evidence the layer exists to keep (a flight recorder
+   that loses its own records without a log line is worse than none,
+   and a regression detector that silently stops calibrating reports
+   "all fast" forever) —
    ``tensorframes_tpu/serve/``, ``tensorframes_tpu/stream/``, or
    ``tensorframes_tpu/parallel/``: the observability layer is the last
    place a failure may vanish silently — an event sink or metrics
