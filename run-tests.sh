@@ -171,6 +171,18 @@ if [ "${1:-}" = "--sentinel" ]; then
   exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m sentinel "$@"
 fi
 
+# --chaos: run only the chaos/invariant lanes (tests/test_chaos.py:
+# seeded multi-site schedules + replay, cross-cutting invariant
+# auditors in strict and always-on modes, poison-query quarantine,
+# persist checksums, the bounded mixed-workload acceptance drill) —
+# fast, CPU-only (8 virtual devices), no native build needed
+if [ "${1:-}" = "--chaos" ]; then
+  shift
+  echo "== chaos lane (pytest -m 'chaos or invariants', CPU) =="
+  exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'chaos or invariants' "$@"
+fi
+
 # --timing: run only the wall-clock-sensitive deadline tests, serially
 # (they flake under concurrent suite load; TFT_TIMING_MARGIN widens
 # their assertion bounds further on badly oversubscribed boxes)

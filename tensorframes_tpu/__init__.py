@@ -50,7 +50,7 @@ from . import serve
 from . import stream
 from .relational import (approx_distinct, approx_quantile, approx_top_k,
                          join)
-from .serve import serve_report
+from .serve import quarantine_status, serve_report, unquarantine
 
 __all__ = [
     "io",
@@ -98,6 +98,8 @@ __all__ = [
     "serve",
     "submit",
     "serve_report",
+    "unquarantine",
+    "quarantine_status",
     "stream",
     "__version__",
 ]

@@ -71,6 +71,7 @@ from ..observability import baseline as _baseline
 from ..observability import device as _obs_device
 from ..observability import events as _obs
 from ..resilience import check_deadline, env_int
+from ..resilience import invariants as _invariants
 from ..utils.logging import get_logger
 from ..utils.tracing import counters, gauge, span
 from . import preempt as _preempt
@@ -107,21 +108,40 @@ class SlotPool:
     honor the ambient resilience deadline.
     """
 
-    __slots__ = ("slots", "_sem")
+    __slots__ = ("slots", "_sem", "_leased", "_lock")
 
     def __init__(self, slots: int):
         if slots < 1:
             raise ValueError(f"SlotPool needs >= 1 slot, got {slots}")
         self.slots = int(slots)
         self._sem = threading.Semaphore(self.slots)
+        # explicit lease count alongside the semaphore: the invariant
+        # auditors (resilience/invariants.py) need to READ the balance
+        # — a Semaphore's internal value is not inspectable — so every
+        # acquire/release keeps this mirror
+        self._leased = 0
+        self._lock = threading.Lock()
 
     def try_acquire(self, timeout: float = 0.0) -> bool:
         if timeout <= 0:
-            return self._sem.acquire(blocking=False)
-        return self._sem.acquire(timeout=timeout)
+            got = self._sem.acquire(blocking=False)
+        else:
+            got = self._sem.acquire(timeout=timeout)
+        if got:
+            with self._lock:
+                self._leased += 1
+        return got
 
     def release(self) -> None:
+        with self._lock:
+            self._leased -= 1
         self._sem.release()
+
+    def leased(self) -> int:
+        """Currently-outstanding leases (negative = a release without
+        an acquire; the auditors flag both directions)."""
+        with self._lock:
+            return self._leased
 
 
 _slot_pool: Optional[SlotPool] = None
@@ -243,6 +263,11 @@ def run_pipelined(blocks: Sequence[B],
         restored = _preempt.resume_stream(scope, len(blocks), tag)
         if restored:
             start = len(restored)
+            # the restored prefix's filter counts were noted in the
+            # PRIOR attempt's row ledger: this attempt's can no longer
+            # balance, so it is voided rather than faked
+            _invariants.taint_rows(
+                f"resumed {start} restored block(s) of stream {tag!r}")
     if d <= 1 or len(blocks) - start <= 1:
         _last_occupancy = None  # a serial run has no window to measure
         if trace is None and scope is None:

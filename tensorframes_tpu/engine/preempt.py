@@ -182,12 +182,12 @@ def boundary(scope: PreemptionScope, progressed: bool = True) -> bool:
         raise QueryCancelled(
             f"query {scope.query_id} cancelled at a block boundary"
             + (f" ({scope.reason})" if scope.reason else ""))
-    if progressed and _faults.active("preempt"):
+    if progressed and _faults.may_fire("preempt"):
         try:
             _faults.check("preempt")
         except _faults.InjectedFault as e:
             scope.request_preempt(f"injected fault: {e}")
-    if progressed and _faults.active("worker"):
+    if progressed and _faults.may_fire("worker"):
         # the `worker` site kills the PROCESS, not just the query: park
         # like a preempt (checkpoint persists to the durable tier), and
         # flag the scope so the scheduler's requeue path reports the

@@ -375,6 +375,34 @@ class MemoryManager:
                     "spilled_bytes": spilled,
                     "spilled_buffers": spilled_n}
 
+    def audit(self) -> list:
+        """Ledger-balance + spillable-registry consistency check (the
+        built-in memory auditor, ``resilience/invariants.py``). Returns
+        violation messages: a negative in-flight reservation balance
+        means a double release; a resident entry whose spilled flag
+        disagrees with its byte accounting (device bytes while spilled,
+        host bytes while resident) means the registry and the spillable
+        have diverged — the fault-back path would restore from the
+        wrong side."""
+        out = []
+        with self._lock:
+            if self._inflight < 0:
+                out.append(f"memory ledger in-flight reservations went "
+                           f"negative ({self._inflight} B): a "
+                           f"reservation released twice")
+            for obj in list(self._live_locked()):
+                name = obj.mem_name()
+                spilled = obj.mem_is_spilled()
+                dev = int(obj.mem_device_bytes())
+                host = int(obj.mem_host_bytes())
+                if spilled and dev > 0:
+                    out.append(f"spillable {name!r} is marked spilled "
+                               f"but still counts {dev} device bytes")
+                if not spilled and host > 0:
+                    out.append(f"spillable {name!r} is marked resident "
+                               f"but still counts {host} host bytes")
+        return out
+
     def __repr__(self):
         lim = "unlimited" if self.limit is None else f"{self.limit} B"
         return (f"MemoryManager(limit={lim}, "

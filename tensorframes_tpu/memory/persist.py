@@ -37,6 +37,17 @@ Durability here is best-effort by design: every write/read failure is
 logged and counted, never raised — a broken disk must degrade the
 serving tier to cold re-runs, not crash the query that was being
 checkpointed. Corrupt or truncated files load as ``None`` (cold path).
+
+Every artifact is framed ``magic + sha256(payload) + payload`` and the
+digest is verified on load. Truncation usually breaks the pickle on
+its own, but single-bit rot inside a numpy buffer does NOT — the file
+still unpickles and silently restores WRONG data, which the serving
+tier would then hand out as a warm hit. The checksum closes that hole:
+a mismatch goes cold (counted ``memory.persist_corrupt``,
+flight-recorded, file removed), never wrong. The ``disk`` fault site
+(``resilience/faults.py``) injects both failure shapes here: a plain
+disk fault takes the read-failure path, one whose message mentions
+``corrupt`` flips a payload byte so the checksum path is exercised.
 """
 
 from __future__ import annotations
@@ -67,6 +78,36 @@ _BL_DIR = "baselines"
 
 # result-dir byte budget before the oldest-first sweep (default 512 MiB)
 _DEFAULT_RESULT_BYTES = 512 * 1024 * 1024
+
+# artifact framing: magic + sha256(payload) + payload. The magic keys
+# the container format (bump on layout change); the digest makes
+# bit-rot detectable before pickle can silently restore wrong data.
+_MAGIC = b"TFTP\x01"
+_DIGEST_LEN = 32
+
+
+def _pack(payload: bytes) -> bytes:
+    """Frame pickled ``payload`` with the magic + content checksum."""
+    return _MAGIC + hashlib.sha256(payload).digest() + payload
+
+
+def _corrupt(path: str, why: str) -> None:
+    """The checksum cold path: count, flight-record, remove, ``None``.
+    Distinct from ``persist.read_errors`` (I/O and unpickle failures)
+    because a digest mismatch means the bytes CHANGED after a good
+    write — the one failure shape that would otherwise restore wrong
+    data silently."""
+    counters.inc("memory.persist_corrupt")
+    from ..observability import flight as _flight
+    _flight.record("memory.persist_corrupt", path=os.path.basename(path),
+                   why=why)
+    _log.warning("persist artifact corrupt (%s): %s — treating as cold",
+                 path, why)
+    try:
+        os.unlink(path)
+    except OSError:
+        pass
+    return None
 
 
 def configure(path: Optional[str]) -> Optional[str]:
@@ -137,16 +178,51 @@ def _atomic_write(path: str, payload: bytes) -> bool:
 
 
 def _read(path: str) -> Optional[Any]:
+    from ..resilience import faults as _faults
+    data: Optional[bytes] = None
     try:
-        with open(path, "rb") as f:
-            return pickle.load(f)
+        try:
+            _faults.check("disk")
+        except _faults.InjectedFault as e:
+            if "corrupt" not in str(e):
+                raise
+            # corruption-shaped injection: read the real bytes, then
+            # flip one payload bit — the artifact still "reads fine"
+            # and must be caught by the checksum, not by luck
+            with open(path, "rb") as f:
+                buf = bytearray(f.read())
+            if buf:
+                buf[-1] ^= 0x01
+            data = bytes(buf)
+        if data is None:
+            with open(path, "rb") as f:
+                data = f.read()
     except FileNotFoundError:
         return None
     except Exception as e:
-        # corrupt / truncated / version-skewed: the cold path is correct
+        # I/O failure (including injected disk faults): cold path
         counters.inc("persist.read_errors")
         _log.warning("persist read failed (%s): %s — treating as cold",
                      path, e)
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        return None
+    if (not data.startswith(_MAGIC)
+            or len(data) < len(_MAGIC) + _DIGEST_LEN):
+        return _corrupt(path, "missing or truncated artifact header")
+    digest = data[len(_MAGIC):len(_MAGIC) + _DIGEST_LEN]
+    payload = data[len(_MAGIC) + _DIGEST_LEN:]
+    if hashlib.sha256(payload).digest() != digest:
+        return _corrupt(path, "sha256 content checksum mismatch")
+    try:
+        return pickle.loads(payload)
+    except Exception as e:
+        # checksum held but the pickle didn't: version/environment skew
+        counters.inc("persist.read_errors")
+        _log.warning("persist unpickle failed (%s): %s — treating as "
+                     "cold", path, e)
         try:
             os.unlink(path)
         except OSError:
@@ -193,7 +269,7 @@ def save_checkpoint(query_id: str, parked: Tuple[List[Tuple], int, str],
         _log.warning("checkpoint of %s not picklable: %s", query_id, e)
         return False
     path = os.path.join(d, _safe_name(query_id) + ".ckpt")
-    if not _atomic_write(path, payload):
+    if not _atomic_write(path, _pack(payload)):
         return False
     counters.inc("persist.checkpoint_writes")
     _log.debug("persisted checkpoint of %s: %d block(s), %d B -> %s",
@@ -293,7 +369,7 @@ def save_result(fingerprint: str, blocks: List[Any]) -> bool:
         _log.warning("result %s not picklable: %s", fingerprint[:16], e)
         return False
     path = os.path.join(d, _safe_name(fingerprint) + ".res")
-    if not _atomic_write(path, payload):
+    if not _atomic_write(path, _pack(payload)):
         return False
     counters.inc("persist.result_writes")
     _sweep_results(d)
@@ -338,7 +414,7 @@ def save_baseline(fingerprint: str, payload: dict) -> bool:
         _log.warning("baseline %s not picklable: %s", fingerprint[:16], e)
         return False
     path = os.path.join(d, _safe_name(fingerprint) + ".perf")
-    if not _atomic_write(path, blob):
+    if not _atomic_write(path, _pack(blob)):
         return False
     counters.inc("persist.baseline_writes")
     return True

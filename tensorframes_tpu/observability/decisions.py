@@ -38,7 +38,8 @@ ANOMALY_KINDS = (
     "pipeline.sync_fallback", "engine.oom_split", "preempt.park",
     "fabric.worker_lost", "fabric.worker_crash", "fabric.replace",
     "fabric.admit_probe_failed", "mesh.exchange_skew",
-    "perf.regression",
+    "perf.regression", "invariant.violation", "serve.quarantine",
+    "serve.quarantine_reject", "memory.persist_corrupt", "chaos.fire",
 )
 
 
@@ -383,6 +384,30 @@ def doctor(max_per_kind: int = 5,
         f"{res['sync_fallbacks']} sync fallback(s), "
         f"{res['plan_oom_fallbacks']}+{res['dplan_fallbacks']} plan "
         f"fallback(s)")
+    inv = snap.get("invariants") or {}
+    chaos = inv.get("chaos")
+    chaos_s = (f" · chaos seed {chaos['seed']} rate {chaos['rate']:g} "
+               f"({chaos['fired']} firing(s) over "
+               f"{'|'.join(chaos['sites'])})" if chaos else "")
+    lines.append(
+        f"  invariant: audits {'on' if inv.get('enabled', True) else 'OFF'}"
+        f"{' [strict]' if inv.get('strict') else ''} · "
+        f"{inv.get('audits', 0)} audit(s), "
+        f"{inv.get('violations', 0)} violation(s), "
+        f"{inv.get('rows_tainted', 0)} tainted row ledger(s)"
+        f"{chaos_s}")
+    quar = snap.get("quarantine") or {}
+    active_q = quar.get("active") or {}
+    if active_q:
+        lines.append(
+            f"  quarantine: {len(active_q)} plan(s) fast-rejected "
+            f"(after {quar.get('threshold')} permanent failures, TTL "
+            f"{quar.get('ttl_s'):g}s — tft.unquarantine() lifts):")
+        for fp, info in sorted(active_q.items()):
+            lines.append(
+                f"    {fp[:20]}… — {info['failures']} failure(s), "
+                f"lifts in {info['ttl_remaining_s']:.0f}s: "
+                f"{info['error'] or '?'}")
     if snap["warnings"]:
         lines.append("  WARNINGS :")
         for w in snap["warnings"]:

@@ -203,6 +203,39 @@ def _stream_section() -> Dict[str, Any]:
     return out
 
 
+def _invariants_section(counts: Dict[str, int]) -> Dict[str, Any]:
+    out: Dict[str, Any] = {
+        "enabled": True, "strict": False, "audits": 0, "violations": 0,
+        "rows_tainted": 0, "chaos": None,
+    }
+    try:
+        from ..resilience import invariants as _invariants
+        out["enabled"] = _invariants.enabled()
+        out["strict"] = _invariants.strict_mode()
+    except Exception as e:  # noqa: BLE001 - optional subsystem
+        _log.debug("health: invariants unavailable: %s", e)
+    out["audits"] = counts.get("invariants.audits", 0)
+    out["violations"] = counts.get("invariants.violations", 0)
+    out["rows_tainted"] = counts.get("invariants.rows.tainted", 0)
+    try:
+        from ..resilience import chaos as _chaos
+        sched = _chaos.active()
+        if sched is not None:
+            out["chaos"] = sched.stats()
+    except Exception as e:  # noqa: BLE001 - optional subsystem
+        _log.debug("health: chaos schedule unavailable: %s", e)
+    return out
+
+
+def _quarantine_section() -> Dict[str, Any]:
+    try:
+        from ..serve import quarantine as _quarantine
+        return _quarantine.status()
+    except Exception as e:  # noqa: BLE001 - optional subsystem
+        _log.debug("health: quarantine registry unavailable: %s", e)
+        return {"active": {}, "streaks": {}}
+
+
 def _warnings(snap: Dict[str, Any]) -> List[str]:
     warns: List[str] = []
     mem = snap["memory"]
@@ -249,6 +282,20 @@ def _warnings(snap: Dict[str, Any]) -> List[str]:
             f"perf: query {r['query']} regressed {r['sigma']}x sigma "
             f"past its baseline (plan {r['fingerprint']}…, most-moved "
             f"{r['component']}) — tft.regressions() has the record")
+    inv = snap.get("invariants") or {}
+    if inv.get("violations"):
+        warns.append(
+            f"invariants: {inv['violations']} cross-cutting invariant "
+            f"violation(s) recorded — accounting drifted somewhere; "
+            f"the flight ring's invariant.violation records name the "
+            f"auditor and quiesce point")
+    quar = snap.get("quarantine") or {}
+    for fp, info in (quar.get("active") or {}).items():
+        warns.append(
+            f"quarantine: plan {fp[:16]}… fast-rejected after "
+            f"{info['failures']} permanent failure(s) — lifts in "
+            f"{info['ttl_remaining_s']:.0f}s, or tft.unquarantine() "
+            f"now")
     return warns
 
 
@@ -271,6 +318,8 @@ def health() -> Dict[str, Any]:
         "slo": _slo.slo_status(),
         "flight": _flight.stats(),
         "perf": _baseline.perf_stats(),
+        "invariants": _invariants_section(counts),
+        "quarantine": _quarantine_section(),
         "resilience": {
             "giveups": sum(v for k, v in counts.items()
                            if k.startswith("retry.")

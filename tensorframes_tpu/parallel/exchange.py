@@ -64,6 +64,7 @@ from ..engine import ops as _ops
 from ..frame import TensorFrame
 from ..observability import flight as _flight
 from ..observability.events import current_trace, traced_query
+from ..resilience import invariants as _invariants
 from ..resilience.policy import env_bool, env_int
 from ..utils.logging import get_logger
 from ..utils.tracing import counters, span
@@ -501,9 +502,11 @@ def _dexchange(keys, dist):
     counters.inc("mesh.interstage_host_bytes", 4 * S)
     total = int(recv.sum())
     if total != dist.num_rows:
-        raise RuntimeError(
-            f"dexchange row conservation violated: {dist.num_rows} in, "
-            f"{total} out (per-shard {recv.tolist()})")
+        # raises in EVERY mode (resilience/invariants.py): rows lost
+        # across an all-to-all are never a count-and-continue condition
+        _invariants.conserve(
+            dist.num_rows, total,
+            f"dexchange (per-shard {recv.tolist()})")
 
     per_out = S * cap
     if want_rowid:

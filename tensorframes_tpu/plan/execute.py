@@ -26,11 +26,13 @@ Execution contract (what makes fused == unfused bit-identical):
 
 from __future__ import annotations
 
+import contextlib
 from typing import Dict, List, Optional
 
 import numpy as np
 
 from ..engine import preempt as _preempt
+from ..resilience import invariants as _invariants
 from ..shape import Unknown
 from ..utils.logging import get_logger
 from ..utils.tracing import counters, span
@@ -204,6 +206,10 @@ def _apply_stage_result(plan, st, env, out, n_rows, aux=None):
             record_selectivity(fnode.comp, mask.size, keep)
             tin, tout = fnode.observed or (0, 0)
             fnode.observed = (tin + int(mask.size), tout + keep)
+        # row-conservation ledger: the masked-out rows are FILTERED,
+        # not lost (noted before the keep==0 short-circuit so a
+        # drop-everything mask balances too)
+        _invariants.note_filtered(mask.size - keep)
         if keep == 0:
             empty = {k: _mask_value(v, mask, np.empty(0, np.int64))
                      for k, v in new_env.items()}
@@ -312,13 +318,22 @@ def _run(plan: ExecPlan, leaf_blocks, frame=None) -> List:
     # it to stage_wall_s like any real stage-level slowdown
     from ..resilience import faults as _faults
     _faults.slowdown("perf")
-    if layout is not None:
-        out = _run_adaptive(plan, layout, frame)
-    else:
-        out = _run_static(plan, leaf_blocks, tag)
+    rows_in = sum(b.num_rows for b in leaf_blocks)
+    # per-query row conservation (resilience/invariants.py): a
+    # row-local chain only ever drops rows through filter masks, so
+    # rows in == rows out + rows filtered must balance exactly; a
+    # preemption resume taints the ledger instead (the restored
+    # prefix's filter counts belong to the prior attempt)
+    ledger = (_invariants.row_ledger(rows_in, tag)
+              if plan.row_local_chain else contextlib.nullcontext())
+    with ledger:
+        if layout is not None:
+            out = _run_adaptive(plan, layout, frame)
+        else:
+            out = _run_static(plan, leaf_blocks, tag)
+        _invariants.note_emitted(sum(b.num_rows for b in out))
     _adaptive.record_stream_feedback(
-        tag, blocks=len(leaf_blocks),
-        rows=sum(b.num_rows for b in leaf_blocks),
+        tag, blocks=len(leaf_blocks), rows=rows_in,
         wall_s=_time.perf_counter() - t0,
         occupancy=_pipeline.last_occupancy())
     return out

@@ -21,6 +21,15 @@ can fail:
   (``with faults.inject("compile", fail_n=2): ...``) that the tier-1
   resilience suite uses to prove every retry/fallback path end-to-end on
   CPU — no real TPU failures required.
+- **chaos** (:mod:`.chaos`): seeded multi-site schedules over the fault
+  sites (``TFT_CHAOS="seed:42,rate:0.05,sites:device|worker|disk"``) —
+  probabilistic in distribution, fully replayable by seed — for proving
+  the contracts survive *composed* faults, not just single drills.
+- **invariants** (:mod:`.invariants`): cross-cutting auditors at
+  quiesce points (slot leases, memory ledger, row conservation,
+  scheduler/fabric accounting); violations raise a classified
+  :class:`InvariantViolation` in strict/chaos mode and flight-record +
+  count always-on.
 
 Consumers: ``parallel/cluster.py`` (bootstrap timeout, retry, graceful
 single-process degradation), ``engine/executor.py`` (dispatch retry,
@@ -34,9 +43,10 @@ degradation matrix — what falls back versus what fails fast — is
 documented in ``docs/resilience.md``.
 """
 
-from .classify import (AdmissionDeadline, DeviceLost, OverQuota,
-                       QueryCancelled, QueryInterrupted, QueryPreempted,
-                       QueueFull, ServeRejected, WorkerLost, error_kind,
+from .classify import (AdmissionDeadline, DeviceLost, InvariantViolation,
+                       OverQuota, QueryCancelled, QueryInterrupted,
+                       QueryPreempted, QueryQuarantined, QueueFull,
+                       ServeRejected, WorkerLost, error_kind,
                        is_device_lost, is_oom, is_permanent, is_transient,
                        is_worker_lost)
 from .faults import InjectedFault, inject
@@ -52,6 +62,7 @@ __all__ = [
     "is_transient", "is_oom", "is_permanent", "is_device_lost",
     "is_worker_lost", "error_kind",
     "ServeRejected", "QueueFull", "OverQuota", "AdmissionDeadline",
+    "QueryQuarantined", "InvariantViolation",
     "DeviceLost", "WorkerLost",
     "QueryInterrupted", "QueryPreempted", "QueryCancelled",
     "env_bool", "env_float", "env_int",

@@ -35,6 +35,7 @@ import os
 __all__ = ["is_transient", "is_oom", "is_permanent", "is_device_lost",
            "is_worker_lost", "error_kind",
            "ServeRejected", "QueueFull", "OverQuota", "AdmissionDeadline",
+           "QueryQuarantined", "InvariantViolation",
            "DeviceLost", "WorkerLost",
            "QueryInterrupted", "QueryPreempted", "QueryCancelled",
            "TRANSIENT_MARKERS", "OOM_MARKERS", "DEVICE_LOST_MARKERS",
@@ -140,6 +141,32 @@ class AdmissionDeadline(ServeRejected):
     kind = "deadline_admission"
     retryable = False
 
+
+class QueryQuarantined(ServeRejected):
+    """The query's plan fingerprint is quarantined: it failed
+    permanently ``TFT_QUARANTINE_AFTER`` times in a row, so the
+    scheduler fast-rejects it at submit instead of letting a
+    deterministically-crashing plan eat retries, checkpoints, and
+    worker restarts across the fabric (``serve/quarantine.py``). Not
+    retryable as-is — the quarantine expires after its TTL (one probe
+    re-admission) or is lifted manually with ``tft.unquarantine()``."""
+
+    kind = "quarantined"
+    retryable = False
+
+
+class InvariantViolation(RuntimeError):
+    """A cross-cutting invariant auditor found unbalanced books at a
+    quiesce point (``resilience/invariants.py``): a leaked slot lease,
+    an unbalanced memory reservation, rows lost across a plan or an
+    exchange, inconsistent scheduler accounting. Raised in strict
+    (chaos/test) mode; always-on mode flight-records and counts
+    instead. NOT transient and NOT retryable: the state the next
+    attempt would run on is exactly the state the auditor just proved
+    wrong. Classified ``invariant``."""
+
+    kind = "invariant"
+
 # XLA/PJRT status words + socket-layer phrases that indicate the failure
 # was environmental, not the program's fault.
 TRANSIENT_MARKERS = (
@@ -235,6 +262,10 @@ def is_transient(exc: BaseException) -> bool:
         return False
     if isinstance(exc, ServeRejected):
         return exc.retryable  # queue drains / bucket refills; sheds don't
+    if isinstance(exc, InvariantViolation):
+        # the books the next attempt would run on are the books the
+        # auditor just proved wrong — never spin a retry loop on them
+        return False
     if is_device_lost(exc):
         return False  # same program, same dead device: shrink, don't retry
     if is_worker_lost(exc):
@@ -264,7 +295,9 @@ def error_kind(exc: BaseException) -> str:
     if isinstance(exc, QueryInterrupted):
         return exc.kind  # preempted / cancelled
     if isinstance(exc, ServeRejected):
-        return exc.kind
+        return exc.kind  # rejected / over_quota / … / quarantined
+    if isinstance(exc, InvariantViolation):
+        return exc.kind  # "invariant"
     if is_device_lost(exc):
         return "device_lost"
     if is_worker_lost(exc):

@@ -20,6 +20,13 @@ window — and this package composes them:
 - :class:`ServerStats` / :func:`serve_report` (:mod:`.stats`) — per-
   tenant outcome totals, live queue/in-flight gauges on the metrics
   endpoint, p99 from ``query_latency_seconds{tenant=...}``.
+- :mod:`.quarantine` — poison-query containment: a plan fingerprint
+  that keeps failing permanently (``TFT_QUARANTINE_AFTER`` in a row)
+  flips to a classified
+  :class:`~..resilience.QueryQuarantined` fast-reject with a TTL
+  (``TFT_QUARANTINE_TTL_S``) and a manual ``tft.unquarantine()``
+  override, so one poison plan cannot starve its tenant's healthy
+  queries of slots.
 - :class:`ServeFabric` (:mod:`.fabric`) — the multi-host tier: tenants
   sharded across worker processes with heartbeat/lease health, a
   classified ``worker_lost`` failure path (queued queries re-placed,
@@ -37,6 +44,7 @@ context manager. See ``docs/serving.md``.
 from .cache import SharedCompileCache, computation_signature
 from .fabric import (FabricQuery, FabricWorker, ServeFabric,
                      fabric_enabled, live_fabric)
+from .quarantine import quarantine_status, unquarantine
 from .scheduler import (QueryScheduler, SubmittedQuery, TenantQuota,
                         default_scheduler, live_scheduler,
                         set_default_scheduler, shutdown_default_scheduler)
@@ -50,4 +58,5 @@ __all__ = [
     "ServerStats", "serve_report",
     "ServeFabric", "FabricQuery", "FabricWorker",
     "live_fabric", "fabric_enabled",
+    "unquarantine", "quarantine_status",
 ]

@@ -844,6 +844,33 @@ class ServeFabric:
             "persist": _persist.stats(),
         }
 
+    def audit_invariants(self, point: str = "inline") -> List[str]:
+        """Fabric no-orphan accounting (the built-in fabric auditor,
+        ``resilience/invariants.py``): every live fabric query is
+        placing, attached to a real worker, or done; placements point
+        at real workers; nothing is left unresolved once the fabric
+        closes (a non-done query after close is a future no tick will
+        ever settle)."""
+        out: List[str] = []
+        with self._lock:
+            n = len(self._workers)
+            for fq in self._queries.values():
+                wi = fq.worker_index
+                if wi is not None and not 0 <= wi < n:
+                    out.append(f"fabric {self.name!r}: query "
+                               f"{fq.query_id} placed on worker index "
+                               f"{wi} of {n}")
+                if not fq.done() and not self._open:
+                    out.append(f"fabric {self.name!r}: query "
+                               f"{fq.query_id} ({fq.state}) orphaned "
+                               f"at {point} — no tick will settle it")
+            for tenant, wi in self._placement.items():
+                if not 0 <= wi < n:
+                    out.append(f"fabric {self.name!r}: tenant "
+                               f"{tenant!r} placed on worker index "
+                               f"{wi} of {n}")
+        return out
+
     def placement_report(self) -> str:
         """The ``serve_report()`` placement table."""
         snap = self.health_snapshot()

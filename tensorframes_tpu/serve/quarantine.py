@@ -1,0 +1,186 @@
+"""Poison-query quarantine: per-fingerprint permanent-failure streaks.
+
+A deterministically-crashing query is worse than a slow one: every
+submission eats its full retry budget, parks checkpoints, survives
+worker restarts (the fabric dutifully resumes it on a survivor), and
+does it all again — across every tenant that submits the same shape.
+This registry tracks a **permanent-failure streak per plan
+fingerprint** (PR 18's portable fingerprints,
+:func:`~..plan.adaptive.query_fingerprint` — the same identity the
+performance sentinel and the durable result tier key on). After
+``TFT_QUARANTINE_AFTER`` consecutive permanent failures (default 3; 0
+disables) the fingerprint flips to quarantined: the scheduler
+fast-rejects it at submit with a classified
+:class:`~..resilience.QueryQuarantined` before it touches a queue,
+quota, or worker.
+
+Only **permanent** classifications count (``resilience.classify``):
+transient faults, OOM splits, preemptions, cancellations, and load
+rejections are the resilience layer doing its job, not evidence the
+plan is poison. Any success resets the streak.
+
+Release paths: the TTL (``TFT_QUARANTINE_TTL_S``, default 300s)
+expires a quarantine into ONE probe admission — the streak restarts at
+``threshold - 1``, so a still-poisonous plan re-quarantines on the
+probe's failure while a fixed one walks free — and
+``tft.unquarantine()`` lifts it manually (one fingerprint or all).
+Surfaced in ``tft.doctor()`` / ``health()`` / ``serve_report()``;
+every transition is flight-recorded.
+
+The registry is process-global on purpose: the in-process serving
+fabric's workers share it, so a plan quarantined on one worker is
+quarantined across the fabric.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+from ..resilience import env_float, env_int
+from ..resilience.classify import QueryQuarantined
+from ..utils.logging import get_logger
+from ..utils.tracing import counters
+
+__all__ = ["check", "note_failure", "note_success", "unquarantine",
+           "status", "quarantine_status", "reset", "QueryQuarantined"]
+
+_log = get_logger("serve.quarantine")
+
+_lock = threading.Lock()
+_streaks: Dict[str, int] = {}
+# fp -> {"until": monotonic, "failures": n, "error": str}
+_quarantined: Dict[str, dict] = {}
+
+
+def _threshold() -> int:
+    return env_int("TFT_QUARANTINE_AFTER", 3)
+
+
+def _ttl() -> float:
+    return env_float("TFT_QUARANTINE_TTL_S", 300.0)
+
+
+def check(fp: Optional[str]) -> None:
+    """Submit-time gate: raise :class:`QueryQuarantined` while ``fp``
+    is quarantined; expire an aged quarantine into one probe admission
+    (streak restarts at ``threshold - 1``)."""
+    if fp is None or _threshold() <= 0:
+        return
+    with _lock:
+        entry = _quarantined.get(fp)
+        if entry is None:
+            return
+        remaining = entry["until"] - time.monotonic()
+        if remaining <= 0:
+            # TTL expired: this submission is the probe
+            del _quarantined[fp]
+            _streaks[fp] = max(_threshold() - 1, 0)
+            failures = entry["failures"]
+        else:
+            failures = entry["failures"]
+            error = entry["error"]
+    from ..observability import flight as _flight
+    if remaining <= 0:
+        counters.inc("serve.quarantine_expired")
+        _flight.record("serve.quarantine_expire", fingerprint=fp,
+                       failures=failures)
+        _log.info("quarantine on %s expired; admitting one probe", fp)
+        return
+    counters.inc("serve.quarantined")
+    _flight.record("serve.quarantine_reject", fingerprint=fp,
+                   failures=failures, ttl_remaining_s=round(remaining, 1))
+    raise QueryQuarantined(
+        f"plan fingerprint {fp} is quarantined: {failures} consecutive "
+        f"permanent failures (last: {error}); expires in "
+        f"{remaining:.0f}s, or lift it with tft.unquarantine({fp!r})")
+
+
+def note_failure(fp: Optional[str], error: BaseException) -> None:
+    """Count one PERMANENT failure of ``fp``; quarantine at the
+    threshold. The caller has already classified — transient/OOM/
+    preempt/rejection outcomes must never reach here."""
+    threshold = _threshold()
+    if fp is None or threshold <= 0:
+        return
+    with _lock:
+        if fp in _quarantined:
+            return  # already quarantined (e.g. a racing in-flight run)
+        streak = _streaks.get(fp, 0) + 1
+        _streaks[fp] = streak
+        if streak < threshold:
+            quarantine = False
+        else:
+            quarantine = True
+            del _streaks[fp]
+            _quarantined[fp] = {"until": time.monotonic() + _ttl(),
+                                "failures": streak,
+                                "error": f"{type(error).__name__}: {error}"}
+    if not quarantine:
+        return
+    counters.inc("serve.quarantines")
+    from ..observability import flight as _flight
+    _flight.record("serve.quarantine", fingerprint=fp, failures=streak,
+                   ttl_s=_ttl(), error=str(error)[:200])
+    _log.warning(
+        "QUARANTINED plan fingerprint %s after %d consecutive permanent "
+        "failures (%s: %s); submissions fast-reject for %.0fs "
+        "(tft.unquarantine() lifts it)", fp, streak,
+        type(error).__name__, error, _ttl())
+
+
+def note_success(fp: Optional[str]) -> None:
+    """A completed run clears the fingerprint's streak."""
+    if fp is None:
+        return
+    with _lock:
+        _streaks.pop(fp, None)
+
+
+def unquarantine(fp: Optional[str] = None) -> int:
+    """Lift quarantines (and their streaks): one fingerprint, or every
+    one when ``fp`` is ``None``. Returns how many were active. Exported
+    as ``tft.unquarantine``."""
+    with _lock:
+        if fp is None:
+            lifted = list(_quarantined)
+            _quarantined.clear()
+            _streaks.clear()
+        else:
+            lifted = [fp] if _quarantined.pop(fp, None) is not None else []
+            _streaks.pop(fp, None)
+    if lifted:
+        counters.inc("serve.unquarantined", len(lifted))
+        from ..observability import flight as _flight
+        for f in lifted:
+            _flight.record("serve.unquarantine", fingerprint=f)
+            _log.info("quarantine on %s lifted manually", f)
+    return len(lifted)
+
+
+def status() -> dict:
+    """Registry snapshot for ``health()`` / ``doctor()`` /
+    ``serve_report()``."""
+    now = time.monotonic()
+    with _lock:
+        active = {fp: {"failures": e["failures"],
+                       "error": e["error"],
+                       "ttl_remaining_s": round(max(e["until"] - now, 0.0),
+                                                1)}
+                  for fp, e in _quarantined.items()}
+        streaks = dict(_streaks)
+    return {"threshold": _threshold(), "ttl_s": _ttl(),
+            "active": active, "streaks": streaks}
+
+
+def reset() -> None:
+    """Drop every streak and quarantine (tests)."""
+    with _lock:
+        _streaks.clear()
+        _quarantined.clear()
+
+
+# re-exported spelling for the package surface (``serve.quarantine_status``
+# / ``tft.quarantine_status`` — ``status`` alone is too generic there)
+quarantine_status = status

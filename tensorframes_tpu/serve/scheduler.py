@@ -95,9 +95,12 @@ from ..resilience import (AdmissionDeadline, DeadlineExceeded, OverQuota,
                           QueryCancelled, QueryPreempted, QueueFull,
                           ServeRejected, deadline as _deadline,
                           env_bool, env_float, env_int, error_kind)
+from ..resilience import invariants as _invariants
+from ..resilience.classify import InvariantViolation
 from ..utils.logging import get_logger
 from ..utils.tracing import counters, gauge, histograms
 from .cache import SharedCompileCache
+from . import quarantine as _quarantine
 
 __all__ = ["TenantQuota", "SubmittedQuery", "QueryScheduler",
            "default_scheduler", "set_default_scheduler",
@@ -106,7 +109,8 @@ __all__ = ["TenantQuota", "SubmittedQuery", "QueryScheduler",
 _log = get_logger("serve.scheduler")
 
 _OUTCOMES = ("submitted", "admitted", "rejected", "over_quota", "shed",
-             "completed", "failed", "preempted", "cancelled")
+             "quarantined", "completed", "failed", "preempted",
+             "cancelled")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -190,6 +194,7 @@ class SubmittedQuery:
     __slots__ = ("query_id", "tenant", "est_rows", "est_bytes",
                  "est_stream_bytes", "deadline_at", "submitted_at",
                  "started_at", "finished_at", "state", "preemptions",
+                 "fingerprint",
                  "_thunk", "_event", "_result", "_error", "_scope",
                  "_checkpoint", "_cancel_requested")
 
@@ -210,6 +215,9 @@ class SubmittedQuery:
         self.finished_at: Optional[float] = None
         self.state = "queued"
         self.preemptions = 0
+        # plan-fingerprint of the FULL query (frame + fetches), set at
+        # submit: the poison-query quarantine's streak key
+        self.fingerprint: Optional[str] = None
         self._thunk = thunk
         self._event = threading.Event()
         self._result: Any = None
@@ -303,6 +311,14 @@ def _estimate(frame) -> Tuple[Optional[float], Optional[int]]:
 # read the most recent; entries remove themselves on close)
 _live_lock = threading.Lock()
 _live: List["QueryScheduler"] = []
+
+
+def live_schedulers() -> List["QueryScheduler"]:
+    """Every not-yet-closed scheduler, oldest first (the invariant
+    auditors walk all of them — overlapping schedulers each keep their
+    own books)."""
+    with _live_lock:
+        return list(_live)
 
 
 def live_scheduler() -> Optional["QueryScheduler"]:
@@ -440,6 +456,13 @@ class QueryScheduler:
                 f"{q.query_id} ran"))
         for t in self._threads:
             t.join(timeout=timeout)
+        # quiesce-point audit while the hooks are still installed: every
+        # query accounted for, every slot lease returned. Guarded to
+        # our own pool — an out-of-order close under a NEWER scheduler
+        # must not read that scheduler's live leases as our leak.
+        if _invariants.enabled() and \
+                _pipeline.current_slot_pool() is self.slot_pool:
+            _invariants.audit("scheduler.close")
         # hook teardown, out-of-order safe: restore the previous hook
         # only while still the installed owner; otherwise unlink this
         # scheduler from the restore chain (any live scheduler whose
@@ -547,11 +570,34 @@ class QueryScheduler:
             rows_guess, bytes_guess = _estimate(frame)
             est_rows = est_rows if est_rows is not None else rows_guess
             est_bytes = est_bytes if est_bytes is not None else bytes_guess
+        # fingerprint the FULL query (frame + fetches) for the poison
+        # quarantine's streak key; a chain with no usable identity
+        # (fp None) is simply never quarantined
+        fp: Optional[str] = None
+        try:
+            from ..plan import adaptive as _adaptive
+            fp_frame = frame if fetches is None else \
+                frame.map_blocks(fetches)
+            got = _adaptive.query_fingerprint(fp_frame)
+            if got is not None:
+                fp = got[0]
+        except Exception as e:
+            _log.debug("query fingerprint failed at submit: %s", e)
         with self._cond:
             if not self._open or self._dying:
                 raise RuntimeError(
                     f"scheduler {self.name!r} is closed")
             t = self._tenant(tenant)
+            if query_id is None and _checkpoint is None:
+                # a fabric re-dispatch (original id / checkpoint in
+                # hand) is a MIGRATION, not a fresh submission: it must
+                # not fast-reject mid-flight
+                try:
+                    _quarantine.check(fp)
+                except _quarantine.QueryQuarantined:
+                    t.counts["quarantined"] += 1
+                    gauge("serve.queue_depth", self._queued_locked())
+                    raise
             if len(t.queue) >= t.max_queue:
                 t.counts["rejected"] += 1
                 counters.inc("serve.rejected")
@@ -585,6 +631,7 @@ class QueryScheduler:
                 thunk, est_rows, est_bytes,
                 time.monotonic() + dl if dl is not None else None,
                 est_stream_bytes=est_stream)
+            q.fingerprint = fp
             if _checkpoint is not None:
                 q._checkpoint = _checkpoint
             was_empty = not t.queue
@@ -1062,6 +1109,16 @@ class QueryScheduler:
     def _finish(self, q: SubmittedQuery, t: _Tenant,
                 result: Any = None,
                 error: Optional[BaseException] = None) -> None:
+        # cross-cutting audit at the query-finish quiesce point
+        # (resilience/invariants.py): in strict (chaos/test) mode a
+        # violation fails THIS query, classified 'invariant', instead
+        # of resolving its future green over books just proven wrong
+        if _invariants.enabled():
+            try:
+                _invariants.audit("serve.finish")
+            except InvariantViolation as iv:
+                if error is None:
+                    result, error = None, iv
         q._complete(result=result, error=error)
         from ..memory import persist as _persist
         if _persist.enabled():
@@ -1094,6 +1151,13 @@ class QueryScheduler:
             t.counts[key] += 1
             gauge("serve.inflight", self._inflight_locked())
             self._cond.notify_all()
+        # poison-query streaks: only PERMANENT failures count — the
+        # resilience layer's own outcomes (transient retries, OOM
+        # splits, preempts, sheds) are not evidence the plan is poison
+        if key == "completed":
+            _quarantine.note_success(q.fingerprint)
+        elif key == "failed" and outcome == "permanent":
+            _quarantine.note_failure(q.fingerprint, error)
         histograms.observe("query_latency_seconds", dur, op="serve",
                            tenant=t.name, outcome=outcome)
         counters.inc(f"serve.{key}")
@@ -1133,6 +1197,54 @@ class QueryScheduler:
         return len(scopes)
 
     # -- introspection -----------------------------------------------------
+    def audit_invariants(self, point: str = "inline") -> List[str]:
+        """No-orphan accounting, one consistent read (the built-in
+        scheduler auditor, ``resilience/invariants.py``): every live
+        query is queued, running, or mid-``_finish``; queue lengths,
+        inflight counts, and the live-query table all agree; nothing
+        has gone negative. At a ``*.close`` point the table must be
+        EMPTY — anything left is an orphan whose future never
+        resolves."""
+        out: List[str] = []
+        with self._cond:
+            queued = running = finishing = 0
+            for q in self._queries.values():
+                if q.state == "queued":
+                    queued += 1
+                elif q.state == "running":
+                    running += 1
+                else:
+                    # terminal state, not yet popped: the short window
+                    # inside a concurrent _finish — balanced below, an
+                    # orphan only at close
+                    finishing += 1
+            in_queues = sum(len(t.queue) for t in self._tenants.values())
+            inflight = sum(t.inflight for t in self._tenants.values())
+            if queued != in_queues:
+                out.append(
+                    f"scheduler {self.name!r}: {queued} queued query(ies)"
+                    f" vs {in_queues} queue entries")
+            if inflight != running + finishing:
+                out.append(
+                    f"scheduler {self.name!r}: inflight accounting "
+                    f"{inflight} != {running} running + {finishing} "
+                    f"finishing")
+            for t in self._tenants.values():
+                if t.inflight < 0:
+                    out.append(f"tenant {t.name!r}: negative inflight "
+                               f"({t.inflight})")
+                for k, v in t.counts.items():
+                    if v < 0:
+                        out.append(f"tenant {t.name!r}: negative "
+                                   f"{k!r} count ({v})")
+            if point.endswith(".close") and not self._open \
+                    and self._queries:
+                out.append(
+                    f"scheduler {self.name!r}: {len(self._queries)} "
+                    f"query(ies) orphaned at {point}: "
+                    f"{sorted(self._queries)[:5]}")
+        return out
+
     def snapshot(self) -> Dict[str, Dict[str, Any]]:
         """Per-tenant live state + outcome totals (one consistent read)."""
         with self._cond:

@@ -138,6 +138,10 @@ class ServerStats:
                 f"    rejected {s['rejected']} (queue full) · "
                 f"over_quota {s['over_quota']} · shed {s['shed']} "
                 f"(admission)")
+            if s.get("quarantined"):
+                lines.append(
+                    f"    quarantined {s['quarantined']} (poison-plan "
+                    f"fast-reject; tft.unquarantine() lifts)")
             slo = _slo.slo_status(name).get(name)
             if slo is not None and slo["total"]:
                 lines.append(
@@ -168,6 +172,17 @@ class ServerStats:
                 f"  shared compile cache: {cc['entries']} entries · "
                 f"{cc['hits']} hit(s) / {cc['misses']} miss(es) · "
                 f"{cc['uncacheable']} uncacheable")
+        try:
+            from . import quarantine as _quarantine
+            q = _quarantine.status()
+        except Exception:  # noqa: BLE001 - report must render regardless
+            q = {"active": {}}
+        for fp, info in sorted((q.get("active") or {}).items()):
+            lines.append(
+                f"  QUARANTINE: plan {fp[:20]}… — {info['failures']} "
+                f"permanent failure(s), lifts in "
+                f"{info['ttl_remaining_s']:.0f}s "
+                f"(tft.unquarantine() lifts now)")
         return "\n".join(lines)
 
 
@@ -239,7 +254,13 @@ def _provider_lines(scheduler) -> List[str]:
             ("tft_serve_checkpoint_discards_total",
              "serve.checkpoint_discards",
              "Preemption checkpoints discarded on resume (plan changed "
-             "under the query; re-ran from scratch).")):
+             "under the query; re-ran from scratch)."),
+            ("tft_serve_quarantines_total", "serve.quarantines",
+             "Plan fingerprints quarantined after a permanent-failure "
+             "streak (poison-query fast-reject)."),
+            ("tft_serve_quarantine_rejects_total", "serve.quarantined",
+             "Submissions fast-rejected because their plan fingerprint "
+             "is quarantined.")):
         lines.append(f"# HELP {fam} {help_s}")
         lines.append(f"# TYPE {fam} counter")
         lines.append(f"{fam} {snap_c.get(key, 0)}")

@@ -60,6 +60,7 @@ from ..observability import baseline as _baseline
 from ..observability import events as _obs
 from ..observability import metrics as _metrics
 from ..observability import timeline as _timeline
+from ..resilience import invariants as _invariants
 from ..resilience import (QueryInterrupted, check_deadline,
                           default_policy, env_bool, env_int, error_kind,
                           faults)
@@ -248,6 +249,11 @@ class StreamHandle:
             block = self._fill_batch(block)
         processed_before = self._batches
         self._process(block)
+        # batch-boundary quiesce point (resilience/invariants.py):
+        # between batches every lease is back in the pool and the
+        # ledger balances; catching a leak HERE names the batch that
+        # caused it instead of whichever query closes last
+        _invariants.audit("stream.batch")
         if self._batcher is not None and self._last_batch_s is not None \
                 and self._batches > processed_before:
             # only a batch that actually EXECUTED feeds the sizer: a

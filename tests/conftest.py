@@ -151,6 +151,20 @@ def pytest_configure(config):
                    "through every operator surface (run-tests.sh "
                    "--sentinel runs this lane standalone)")
     config.addinivalue_line(
+        "markers", "chaos: seeded multi-site chaos-schedule suite — "
+                   "reproducible fault composition over the existing "
+                   "sites (TFT_CHAOS), the bounded mixed-workload "
+                   "acceptance drill (bit-identity vs fault-free, zero "
+                   "leaks, every failure classified), poison-query "
+                   "quarantine, persist checksums (run-tests.sh --chaos "
+                   "runs this lane standalone)")
+    config.addinivalue_line(
+        "markers", "invariants: cross-cutting invariant-auditor suite — "
+                   "slot-lease balance, ledger reservation balance, "
+                   "row conservation, checkpoint cursor consistency, "
+                   "scheduler/fabric accounting; strict vs always-on "
+                   "modes (run-tests.sh --chaos runs this lane too)")
+    config.addinivalue_line(
         "markers", "timing: wall-clock-sensitive deadline assertions — "
                    "margins are widened for loaded machines "
                    "(TFT_TIMING_MARGIN multiplies the bounds; "

@@ -47,7 +47,13 @@
    nobody can find — and the engine layer now carries the preemption
    token path (``engine/preempt.py``): a silently swallowed error
    between a park and its resume is a lost checkpoint, i.e. silently
-   re-run work. Handle it or log it (``_log.debug`` is enough).
+   re-run work — and the resilience layer itself is now strict too
+   (``resilience/chaos.py``, ``invariants.py``): a chaos scheduler
+   that silently drops a firing breaks seed-replay determinism, and an
+   invariant auditor that swallows its own error is the one watchdog
+   that must never sleep on the job (a crashed auditor is REPORTED as
+   a violation, never ignored). Handle it or log it (``_log.debug`` is
+   enough).
 
 AST-based, so strings and comments never false-positive.
 """
@@ -60,7 +66,8 @@ ROOT = pathlib.Path(__file__).resolve().parent.parent / "tensorframes_tpu"
 # packages where `except Exception: pass` (silent swallow) is also banned
 STRICT_ROOTS = (ROOT / "observability", ROOT / "serve", ROOT / "stream",
                 ROOT / "parallel", ROOT / "memory", ROOT / "plan",
-                ROOT / "relational", ROOT / "engine")
+                ROOT / "relational", ROOT / "engine",
+                ROOT / "resilience")
 
 
 def _is_exception_name(node) -> bool:
