@@ -1566,6 +1566,89 @@ def _child(platform: str) -> None:
     finally:
         os.environ.pop("TFT_INVARIANTS", None)
 
+    # secondary metric (never costs the headline): the ALWAYS-ON
+    # durable query history (docs/observability.md) on the same serve
+    # mixed workload, same protocol as the flight recorder above: the
+    # ON path (archive armed via TFT_HISTORY_DIR) within 2% of
+    # TFT_HISTORY=0 (the single-env-check bypass), order-flipped
+    # interleaved pairs, medians, wall-clock budgeted. The layer meets
+    # it with one json.dumps + one O_APPEND write() per QUERY at
+    # finish, never per-block.
+    history_secondary = None
+    hist_budget_s = 40.0
+    hist_t0 = time.perf_counter()
+    import tempfile as _hi_tempfile
+    hist_dir = _hi_tempfile.mkdtemp(prefix="tft-bench-history-")
+    try:
+        from statistics import median as _hi_median
+
+        from tensorframes_tpu.observability import history as _hi_mod
+        from tensorframes_tpu.serve import (QueryScheduler as _HiSched,
+                                            TenantQuota as _HiQuota)
+
+        os.environ["TFT_HISTORY_DIR"] = hist_dir
+        hi_sizes = {"small": 10_000, "medium": 50_000}
+        hi_frames = {t: [tft.frame({"x": np.arange(float(n)) + k},
+                                   num_partitions=4)
+                         for k in range(4)]
+                     for t, n in hi_sizes.items()}
+
+        def _hi_round(sched) -> float:
+            t0 = time.perf_counter()
+            futs = [sched.submit(fr, lambda x: {"z": x + 3.0}, tenant=t)
+                    for t in hi_sizes for fr in hi_frames[t]]
+            for f in futs:
+                f.result(timeout=60)
+            return time.perf_counter() - t0
+
+        def _hi_bypassed(sched) -> float:
+            os.environ["TFT_HISTORY"] = "0"
+            try:
+                return _hi_round(sched)
+            finally:
+                os.environ.pop("TFT_HISTORY", None)
+
+        hrec0 = _hi_mod.stats()["records_written"]
+        with _HiSched(quotas={t: _HiQuota(max_queue=1024)
+                              for t in hi_sizes},
+                      workers=2, name="histbench") as sched:
+            sched.submit(hi_frames["small"][0],
+                         lambda x: {"z": x + 3.0},
+                         tenant="small").result(timeout=60)
+            hi_samples = {"on": [], "bypass": []}
+            rounds = 0
+            hi_pair_budget = hist_budget_s * 0.9
+            while rounds < 60 and (
+                    time.perf_counter() - hist_t0 < hi_pair_budget
+                    or rounds < 2):
+                if rounds % 2:
+                    hi_samples["on"].append(_hi_round(sched))
+                    hi_samples["bypass"].append(_hi_bypassed(sched))
+                else:
+                    hi_samples["bypass"].append(_hi_bypassed(sched))
+                    hi_samples["on"].append(_hi_round(sched))
+                rounds += 1
+        hi_on = _hi_median(hi_samples["on"])
+        hi_byp = _hi_median(hi_samples["bypass"])
+        hi_pct = (hi_on - hi_byp) / hi_byp * 100.0
+        hi_stats = _hi_mod.stats()
+        history_secondary = {
+            "queries_per_round": sum(len(v) for v in hi_frames.values()),
+            "rounds": rounds,
+            "bypass_round_s": round(hi_byp, 6),
+            "on_round_s": round(hi_on, 6),
+            "always_on_overhead_pct": round(hi_pct, 2),
+            "within_2pct": bool(hi_pct < 2.0),
+            "records_archived": hi_stats["records_written"] - hrec0,
+            "archive_bytes": hi_stats["bytes"],
+        }
+    except Exception as e:  # noqa: BLE001 - headline must survive
+        history_secondary = {"error": str(e)[:300]}
+    finally:
+        os.environ.pop("TFT_HISTORY", None)
+        os.environ.pop("TFT_HISTORY_DIR", None)
+        shutil.rmtree(hist_dir, ignore_errors=True)
+
     # reference structure: Rows materialized in and out per block
     schema = df.schema
     t0 = time.perf_counter()
@@ -1607,6 +1690,7 @@ def _child(platform: str) -> None:
         "flight_recorder_overhead": flight_secondary,
         "sentinel_overhead": sentinel_secondary,
         "invariant_overhead": invariant_secondary,
+        "history_overhead": history_secondary,
     }
 
     if plat == "tpu":
@@ -1667,6 +1751,23 @@ def _child(platform: str) -> None:
                 rec["matmul_mfu"] = round(matmul_tflops / peak, 4)
         except Exception as e:  # noqa: BLE001 - headline must survive
             rec["secondary_error"] = str(e)[:300]
+
+    # ROADMAP item 2 (TPU validation): every figure names the silicon
+    # it ran on. The headline AND each dict-valued secondary carry
+    # platform / device_kind / chip_mode, so a CPU-fallback secondary
+    # quoted in isolation can never pass for chip numbers.
+    try:
+        kind = jax.devices()[0].device_kind
+    except Exception:  # noqa: BLE001 - headline must survive
+        kind = "unknown"
+    chip_mode = "tpu" if plat == "tpu" else "cpu-fallback"
+    rec["device_kind"] = kind
+    rec["chip_mode"] = chip_mode
+    for sec in rec.values():
+        if isinstance(sec, dict):
+            sec.setdefault("platform", plat)
+            sec.setdefault("device_kind", kind)
+            sec.setdefault("chip_mode", chip_mode)
     print(json.dumps(rec))
 
 

@@ -183,6 +183,18 @@ if [ "${1:-}" = "--chaos" ]; then
     -m 'chaos or invariants' "$@"
 fi
 
+# --history: run only the durable query-history/post-mortem lane
+# (tests/test_history.py: checksummed segment framing + rotation +
+# retention, corrupt/truncated segments going cold under fault
+# injection, history filters + cross-worker stitching, unclean-
+# shutdown markers + tft.postmortem(), cross-restart tft.why(),
+# flight-dump pruning) — fast, CPU-only, no native build needed
+if [ "${1:-}" = "--history" ]; then
+  shift
+  echo "== history lane (pytest -m history, CPU) =="
+  exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m history "$@"
+fi
+
 # --timing: run only the wall-clock-sensitive deadline tests, serially
 # (they flake under concurrent suite load; TFT_TIMING_MARGIN widens
 # their assertion bounds further on badly oversubscribed boxes)

@@ -36,6 +36,7 @@ from .schema import Field, Schema
 from .frame import Block, GroupedFrame, Row, TensorFrame
 from . import observability
 from .observability import doctor, health, last_query_report, regressions, why
+from .observability.history import history, postmortem
 from .observability.timeline import timeline
 from .computation import Computation, TensorSpec, analyze_graph
 from .api import (
@@ -87,6 +88,8 @@ __all__ = [
     "health",
     "doctor",
     "timeline",
+    "history",
+    "postmortem",
     "regressions",
     "dump_stats",
     "memory",

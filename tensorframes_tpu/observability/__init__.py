@@ -36,7 +36,15 @@ package adds the query dimension on top of the existing primitives
   query id with ``TFT_TRACE`` off; JSONL auto-dumps on slow query /
   giveup / device loss / exit (``TFT_FLIGHT_DUMP``).
 - :mod:`.decisions` — ``tft.why(query_id)`` (one query's causal chain
-  from the ring) and ``tft.doctor()`` (process triage).
+  from the ring, the on-disk flight dumps, or the durable history)
+  and ``tft.doctor()`` (process triage).
+- :mod:`.history` — the ALWAYS-ON durable query log: every finished
+  query folds into checksummed append-only segments on disk
+  (``TFT_HISTORY_DIR``; free under a fabric's durable tier), queried
+  by ``tft.history()`` across restarts; unclean shutdowns are
+  detected at startup and ``tft.postmortem()`` merges the last
+  flight dump, the history tail, and timeline rates into one triage
+  report (``TFT_HISTORY=0`` bypasses the whole layer).
 - :mod:`.slo` — per-tenant latency objectives + error-budget burn
   rates from the existing serve latency histograms
   (``tft_serve_slo_*``, ``serve_report()`` lines, burn callbacks).
@@ -71,6 +79,7 @@ from . import flight
 from . import slo
 from . import timeline
 from . import baseline
+from . import history
 from .baseline import perf_stats, regressions
 from .decisions import doctor, why
 from .health import health
@@ -87,6 +96,7 @@ __all__ = [
     "flight", "slo", "why", "doctor", "health",
     "SLO", "set_slo", "slo_status", "on_burn",
     "timeline", "baseline", "regressions", "perf_stats",
+    "history",
 ]
 
 _log = get_logger("observability")
@@ -104,6 +114,7 @@ flight._register_metrics()
 slo._register_metrics()
 timeline._register_metrics()
 baseline._register_metrics()
+history._register_metrics()
 
 
 def _maybe_autostart() -> None:

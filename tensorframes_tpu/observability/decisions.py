@@ -40,6 +40,7 @@ ANOMALY_KINDS = (
     "fabric.admit_probe_failed", "mesh.exchange_skew",
     "perf.regression", "invariant.violation", "serve.quarantine",
     "serve.quarantine_reject", "memory.persist_corrupt", "chaos.fire",
+    "history.unclean_shutdown", "history.segment_corrupt",
 )
 
 
@@ -259,6 +260,16 @@ def _detail(r: Dict[str, Any]) -> str:
         return (f"worker {r.get('worker')} (epoch {r.get('epoch')}) "
                 f"FAILED its admission probe ({r.get('error')}); not "
                 f"admitted")
+    if k == "history.unclean_shutdown":
+        return (f"UNCLEAN SHUTDOWN: pid {r.get('pid')} "
+                + (f"(worker {r['worker']}) " if r.get("worker") else "")
+                + f"died without its clean-exit hook (history dir "
+                f"{r.get('dir')}); tft.postmortem() has the triage "
+                f"report")
+    if k == "history.segment_corrupt":
+        return (f"history segment {r.get('segment')} went COLD "
+                f"({r.get('why')}); unlinked — fewer records, never "
+                f"wrong ones")
     if k == "perf.regression":
         return (f"PERF REGRESSION: latency {r.get('latency_s')}s vs "
                 f"baseline {r.get('baseline_latency_s')}s "
@@ -272,30 +283,104 @@ def _detail(r: Dict[str, Any]) -> str:
     return kv or k
 
 
-def why(query_id, scheduler=None) -> str:
-    """Reconstruct the decision chain of one query from the flight
-    ring — with ``TFT_TRACE`` off, after the fact. ``query_id`` is the
-    serving id (``SubmittedQuery.query_id``, e.g. ``"serve-q17"``) or
-    any id the work ran under a :func:`~.flight.scope` for; a
-    ``SubmittedQuery`` object is also accepted. Lines render oldest
-    first with offsets from the first decision."""
-    qid = getattr(query_id, "query_id", query_id)
-    recs = _flight.for_query(str(qid))
-    if not recs:
-        if not _flight.enabled():
-            return (f"(flight recorder disabled — TFT_FLIGHT=0; no "
-                    f"decisions recorded for {qid})")
-        return (f"(no decisions recorded for query {qid!r} — it ran "
-                f"before the flight ring's horizon, under no flight "
-                f"scope, or never ran; the ring holds "
-                f"{_flight.stats()['records']} decision(s))")
-    t0 = recs[0]["ts"]
+def _dumped_records_for(qid: str) -> List[Dict[str, Any]]:
+    """The query's decisions recovered from the on-disk
+    ``TFT_FLIGHT_DUMP`` snapshots (current file + its ``.1``
+    rotation), for queries the live ring has already forgotten."""
+    import os
+    base = os.environ.get("TFT_FLIGHT_DUMP")
+    if not base:
+        return []
+    paths = [p for p in (base, base + ".1") if os.path.exists(p)]
+    if not paths:
+        return []
+    try:
+        merged = _flight.load_dumps(paths)
+    except Exception:  # noqa: BLE001 - post-mortem salvages what it can
+        return []
+    return [r for r in merged if str(r.get("query")) == qid]
+
+
+def _render_chain(qid, recs: List[Dict[str, Any]], source: str) -> str:
+    t0 = recs[0].get("ts", 0)
     lines = [f"query {qid} · {len(recs)} decision(s) recorded "
-             f"(flight ring; TFT_TRACE-independent)"]
+             f"({source}; TFT_TRACE-independent)"]
     for r in recs:
-        lines.append(f"  +{r['ts'] - t0:8.3f}s {r['kind']:<24} "
-                     f"{_detail(r)}")
+        w = f" w={r['worker']}" if r.get("worker") else ""
+        lines.append(f"  +{r.get('ts', t0) - t0:8.3f}s "
+                     f"{r['kind']:<24}{w} {_detail(r)}")
     return "\n".join(lines)
+
+
+def why(query_id, scheduler=None) -> str:
+    """Reconstruct the decision chain of one query — with ``TFT_TRACE``
+    off, after the fact, and (since the durable history layer) across a
+    process restart. ``query_id`` is the serving id
+    (``SubmittedQuery.query_id``, e.g. ``"serve-q17"``) or any id the
+    work ran under a :func:`~.flight.scope` for; a ``SubmittedQuery``
+    object is also accepted. Sources in order: the live flight ring,
+    the on-disk ``TFT_FLIGHT_DUMP`` snapshots, then the durable query
+    history (:func:`~.history.causal_chain`) — so a query that finished
+    before a crash still answers from the archive. Lines render oldest
+    first with offsets from the first decision."""
+    qid = str(getattr(query_id, "query_id", query_id))
+    recs = _flight.for_query(qid)
+    if recs:
+        return _render_chain(qid, recs, "flight ring")
+    dumped = _dumped_records_for(qid)
+    if dumped:
+        return _render_chain(
+            qid, dumped, "recovered from flight dump(s) on disk — the "
+            "live ring has moved past it")
+    from . import history as _history
+    rec, decs = _history.causal_chain(qid)
+    if rec is not None:
+        lines = [f"query {qid} · durable history (ring and dumps hold "
+                 f"no trace; archived record survives restarts)"]
+        workers = rec.get("workers") or (
+            [rec["worker"]] if rec.get("worker") else [])
+        head = f"  outcome {rec.get('outcome')!r}"
+        if rec.get("total_s") is not None:
+            head += f" after {rec['total_s']:.3f}s end-to-end"
+        if rec.get("tenant"):
+            head += f" · tenant {rec['tenant']!r}"
+        if workers:
+            head += f" · worker(s) {' -> '.join(workers)}"
+        if rec.get("migrations"):
+            head += f" · {rec['migrations']} migration(s)"
+        lines.append(head)
+        if rec.get("summary"):
+            lines.append(f"  {rec['summary']}")
+        if rec.get("error"):
+            lines.append(f"  error: {rec['error']}"
+                         + (f" (classified {rec['error_kind']})"
+                            if rec.get("error_kind") else ""))
+        cost = rec.get("cost") or {}
+        if cost:
+            parts = [f"{k}={v}" for k, v in sorted(cost.items())
+                     if isinstance(v, (int, float)) and v]
+            if parts:
+                lines.append("  cost: " + " ".join(parts[:8]))
+        if decs:
+            t0 = decs[0].get("ts", rec.get("ts", 0))
+            lines.append(f"  {len(decs)} archived decision(s)"
+                         + (f" (+{rec['decisions_dropped']} dropped by "
+                            f"the digest cap, TFT_HISTORY_DECISIONS)"
+                            if rec.get("decisions_dropped") else "")
+                         + ":")
+            for r in decs:
+                w = f" w={r['worker']}" if r.get("worker") else ""
+                lines.append(f"    +{r.get('ts', t0) - t0:8.3f}s "
+                             f"{r['kind']:<24}{w} {_detail(r)}")
+        return "\n".join(lines)
+    if not _flight.enabled():
+        return (f"(flight recorder disabled — TFT_FLIGHT=0; no "
+                f"decisions recorded for {qid})")
+    return (f"(no decisions recorded for query {qid!r} — it ran "
+            f"before the flight ring's horizon, under no flight "
+            f"scope, or never ran; the ring holds "
+            f"{_flight.stats()['records']} decision(s), the dump and "
+            f"the durable history hold no trace of it)")
 
 
 def doctor(max_per_kind: int = 5,
@@ -368,6 +453,18 @@ def doctor(max_per_kind: int = 5,
         f"  flight   : {'on' if fl['enabled'] else 'OFF'} · "
         f"{fl['records']}/{fl['capacity']} decision(s) buffered · "
         f"{fl['dumps']} dump(s)")
+    hs = snap.get("history") or {}
+    if hs.get("enabled"):
+        lines.append(
+            f"  history  : {hs.get('segments', 0)} segment(s) "
+            f"({_fmt_bytes(hs.get('bytes') or 0)}) · "
+            f"{hs.get('records_written', 0)} record(s) archived this "
+            f"process · {hs.get('corrupt_segments', 0)} cold segment(s)"
+            + (" · UNCLEAN SHUTDOWN detected — tft.postmortem()"
+               if hs.get("unclean") else ""))
+    else:
+        lines.append("  history  : OFF (no TFT_HISTORY_DIR and no "
+                     "durable tier; tft.history() empty)")
     perf = snap.get("perf") or {}
     tls = perf.get("timeline") or {}
     lines.append(

@@ -215,6 +215,18 @@ class QueryTrace:
         path = os.environ.get("TFT_TRACE_FILE")
         if path:
             self._write_jsonl(path, dicts)
+        # durable query history: traced forcings archive too (the
+        # serve scheduler archives its own richer record under the
+        # serving id; a trace's "qN" id is a distinct entry). Skip the
+        # serve op — its scheduler fold point already covers it.
+        if self.op != "serve":
+            from . import history as _history
+            _history.record_finish(
+                self.query_id, outcome="error" if error else "ok",
+                error=error, run_s=self.duration,
+                total_s=self.duration, source="trace",
+                summary=self.op,
+                decisions=_flight.for_query(self.query_id))
         ms = _slow_query_threshold_ms()
         if ms is not None and self.duration * 1000.0 >= ms:
             s = self.summary()

@@ -84,6 +84,7 @@ _ring: "deque[Dict[str, Any]]" = deque(
     maxlen=_env_int("TFT_FLIGHT_RING", 4096))
 _recorded = 0  # lifetime total (the ring drops, this does not)
 _dumps = 0
+_dump_evictions = 0  # snapshot sections pruned past TFT_FLIGHT_DUMP_KEEP
 
 # the always-on query correlation id (serve query ids, or whatever the
 # caller scopes); independent of the TFT_TRACE query trace so decisions
@@ -197,7 +198,7 @@ def stats() -> Dict[str, Any]:
     with _ring_lock:
         return {"enabled": enabled(), "records": len(_ring),
                 "capacity": _ring.maxlen, "recorded_total": _recorded,
-                "dumps": _dumps}
+                "dumps": _dumps, "dump_evictions": _dump_evictions}
 
 
 def clear() -> None:
@@ -248,6 +249,63 @@ def append_jsonl(path: str, lines: List[str]) -> None:
 # dumps
 # ---------------------------------------------------------------------------
 
+def _dump_keep() -> int:
+    """``TFT_FLIGHT_DUMP_KEEP``: newest snapshot sections kept in the
+    dump file (default 8; ``0`` disables pruning). Each anomaly appends
+    one section, across restarts — without a bound the dump file is
+    the one observability artifact that grows forever."""
+    return max(_env_int("TFT_FLIGHT_DUMP_KEEP", 8), 0)
+
+
+def _prune_dump_snapshots(path: str) -> int:
+    """Drop the oldest snapshot sections past :func:`_dump_keep`,
+    rewriting the file atomically under the shared sink lock; returns
+    the number of sections evicted (counted in :func:`stats` and the
+    ``tft_flight_dump_evictions_total`` metric)."""
+    keep = _dump_keep()
+    if not keep:
+        return 0
+    global _dump_evictions
+    with _file_lock:
+        try:
+            with open(path) as f:
+                lines = f.read().splitlines()
+        except OSError:
+            return 0
+        heads = []
+        for i, line in enumerate(lines):
+            s = line.strip()
+            if '"flight_dump"' not in s:
+                continue
+            try:
+                rec = json.loads(s)
+            except ValueError:
+                continue
+            if isinstance(rec, dict) \
+                    and rec.get("type") == "flight_dump":
+                heads.append(i)
+        excess = len(heads) - keep
+        if excess <= 0:
+            return 0
+        tmp = path + ".prune"
+        try:
+            with open(tmp, "w") as f:
+                f.write("\n".join(lines[heads[excess]:]) + "\n")
+            os.replace(tmp, path)
+        except OSError as e:
+            _log.warning("flight dump prune of %s failed: %s", path, e)
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            return 0
+    with _ring_lock:
+        _dump_evictions += excess
+    _log.info("flight dump %s: %d old snapshot section(s) evicted "
+              "(TFT_FLIGHT_DUMP_KEEP=%d)", path, excess, keep)
+    return excess
+
+
 def dump(path: Optional[str] = None,
          reason: str = "manual",
          worker: Optional[str] = None) -> Optional[str]:
@@ -279,6 +337,7 @@ def dump(path: Optional[str] = None,
     global _dumps
     with _ring_lock:
         _dumps += 1
+    _prune_dump_snapshots(path)
     _log.info("flight recorder dumped %d decision(s) to %s (%s)",
               len(records), path, reason)
     return path
@@ -367,6 +426,10 @@ def _render_metrics() -> List[str]:
         "(slow query / giveup / device loss / exit / manual).",
         "# TYPE tft_flight_dumps_total counter",
         f"tft_flight_dumps_total {s['dumps']}",
+        "# HELP tft_flight_dump_evictions_total Old dump snapshot "
+        "sections pruned past TFT_FLIGHT_DUMP_KEEP.",
+        "# TYPE tft_flight_dump_evictions_total counter",
+        f"tft_flight_dump_evictions_total {s['dump_evictions']}",
     ]
 
 

@@ -227,6 +227,15 @@ def _invariants_section(counts: Dict[str, int]) -> Dict[str, Any]:
     return out
 
 
+def _history_section() -> Dict[str, Any]:
+    try:
+        from . import history as _history
+        return _history.stats()
+    except Exception as e:  # noqa: BLE001 - optional subsystem
+        _log.debug("health: history archive unavailable: %s", e)
+        return {"enabled": False}
+
+
 def _quarantine_section() -> Dict[str, Any]:
     try:
         from ..serve import quarantine as _quarantine
@@ -289,6 +298,19 @@ def _warnings(snap: Dict[str, Any]) -> List[str]:
             f"violation(s) recorded — accounting drifted somewhere; "
             f"the flight ring's invariant.violation records name the "
             f"auditor and quiesce point")
+    hs = snap.get("history") or {}
+    if hs.get("unclean"):
+        u = hs["unclean"]
+        warns.append(
+            f"history: UNCLEAN SHUTDOWN detected — pid {u.get('pid')} "
+            + (f"(worker {u['worker']}) " if u.get("worker") else "")
+            + f"died without its clean-exit hook; tft.postmortem() "
+            f"has the triage report")
+    if hs.get("corrupt_segments"):
+        warns.append(
+            f"history: {hs['corrupt_segments']} archive segment(s) "
+            f"went cold (corrupt/truncated, unlinked) — records lost, "
+            f"never wrong; earlier segments remain readable")
     quar = snap.get("quarantine") or {}
     for fp, info in (quar.get("active") or {}).items():
         warns.append(
@@ -317,6 +339,7 @@ def health() -> Dict[str, Any]:
         "streams": _stream_section(),
         "slo": _slo.slo_status(),
         "flight": _flight.stats(),
+        "history": _history_section(),
         "perf": _baseline.perf_stats(),
         "invariants": _invariants_section(counts),
         "quarantine": _quarantine_section(),

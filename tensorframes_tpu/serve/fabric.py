@@ -78,6 +78,7 @@ import numpy as np
 
 from ..memory import persist as _persist
 from ..observability import flight as _flight
+from ..observability import history as _history
 from ..resilience import (ServeRejected, WorkerLost, env_bool, env_float,
                           env_int)
 from ..resilience import faults as _faults
@@ -539,6 +540,8 @@ class ServeFabric:
         if fq.done():
             return
         with self._lock:
+            prev = (self._workers[fq.worker_index].worker_id
+                    if fq.worker_index is not None else None)
             idx = self._place_locked(fq.tenant)
             w = self._workers[idx] if idx is not None else None
         if w is None:
@@ -573,6 +576,18 @@ class ServeFabric:
                        resumed_blocks=(cp.parked_blocks
                                        if cp is not None else 0),
                        from_checkpoint=cp is not None)
+        # durable query history: a dead worker never reaches its own
+        # _finish fold, so the coordinator stamps the migration here —
+        # the survivor's terminal record stitches onto this one (same
+        # query id, worker path A->B) in tft.history()
+        _history.record_finish(
+            fq.query_id, tenant=fq.tenant, outcome="migrated",
+            worker=prev, source="fabric",
+            summary=f"re-dispatched to {w.worker_id} ({reason}, "
+                    f"attempt #{fq.attempts}, "
+                    + (f"{cp.parked_blocks} block(s) from checkpoint"
+                       if cp is not None else "cold re-run") + ")",
+            decisions=_flight.for_query(fq.query_id))
         _log.info("fabric %r: query %s re-dispatched to %s (%s, "
                   "%s)", self.name, fq.query_id, w.worker_id, reason,
                   f"{cp.parked_blocks} block(s) from checkpoint"
@@ -842,6 +857,7 @@ class ServeFabric:
             "queries": {"total": queries, "done": done,
                         "inflight": queries - done},
             "persist": _persist.stats(),
+            "history": _history.stats(),
         }
 
     def audit_invariants(self, point: str = "inline") -> List[str]:
@@ -893,6 +909,12 @@ class ServeFabric:
                 f"persist: {ps['checkpoints']} checkpoint(s) "
                 f"({ps['checkpoint_bytes']} B), {ps['results']} "
                 f"result(s) ({ps['result_bytes']} B) at {ps['dir']}")
+        hs = snap.get("history") or {}
+        if hs.get("enabled"):
+            lines.append(
+                f"history: {hs['segments']} segment(s) "
+                f"({hs['bytes']} B) at {hs['dir']}, "
+                f"{hs['records_written']} record(s) this process")
         return "\n".join(lines)
 
     def __repr__(self):

@@ -90,6 +90,7 @@ from ..observability import baseline as _baseline
 from ..observability import device as _obs_device
 from ..observability import events as _obs
 from ..observability import flight as _flight
+from ..observability import history as _history
 from ..observability import slo as _slo
 from ..resilience import (AdmissionDeadline, DeadlineExceeded, OverQuota,
                           QueryCancelled, QueryPreempted, QueueFull,
@@ -1171,9 +1172,26 @@ class QueryScheduler:
         # a plan regression
         run_s = dur if q.started_at is None \
             else q.finished_at - q.started_at
-        _baseline.finalize(latency_s=run_s, outcome=key)
+        vec = _baseline.finalize(latency_s=run_s, outcome=key)
         _flight.record("serve.finish", query=q.query_id, tenant=t.name,
                        outcome=key, latency_s=round(dur, 6))
+        # durable query history: fold this completion — cost vector,
+        # flight-decision digest, worker stamp — into the on-disk
+        # archive, AFTER the serve.finish record so the digest carries
+        # the terminal decision too (best-effort; never raises)
+        _history.record_finish(
+            q.query_id, tenant=t.name, fingerprint=q.fingerprint,
+            outcome=key,
+            error=(f"{type(error).__name__}: {error}"
+                   if error is not None else None),
+            error_kind=outcome if error is not None else None,
+            worker=self.worker_id, cost=vec,
+            queued_s=(q.started_at - q.submitted_at
+                      if q.started_at is not None else None),
+            run_s=run_s, total_s=dur,
+            est_rows=q.est_rows, est_bytes=q.est_bytes,
+            preemptions=q.preemptions, source="serve",
+            decisions=_flight.for_query(q.query_id))
         # SLO burn-rate callbacks evaluate off the completion path
         # (throttled per tenant; docs/observability.md)
         _slo.note_completion(t.name)

@@ -201,6 +201,19 @@ def serve_report(scheduler=None) -> str:
                 "or submit a query through tft.submit())")
     out = ServerStats(scheduler).render()
     try:
+        from ..observability import history as _history
+        hs = _history.stats()
+    except Exception:  # noqa: BLE001 - report must render regardless
+        hs = {"enabled": False}
+    if hs.get("enabled"):
+        out += (f"\n  history: {hs['segments']} segment(s) "
+                f"({hs['bytes']} B) at {hs['dir']} · "
+                f"{hs['records_written']} record(s) this process · "
+                f"tft.history() / tft.why(qid)")
+        if hs.get("unclean"):
+            out += ("\n  UNCLEAN SHUTDOWN detected on startup — "
+                    "tft.postmortem() has the triage report")
+    try:
         from .fabric import live_fabric
         fab = live_fabric()
     except Exception:  # noqa: BLE001 - report must render regardless

@@ -432,6 +432,14 @@ class StreamHandle:
             _flight.record("stream.batch_skip", stream=self.name,
                            batch=i, error=type(e).__name__,
                            error_kind=kind)
+            # durable query history: a poisoned batch is exactly the
+            # record a post-mortem wants to find after the process dies
+            from ..observability import history as _history
+            _history.record_finish(
+                f"{self.name}-b{i}", tenant=self.name,
+                outcome="skipped", error=f"{type(e).__name__}: {e}",
+                error_kind=kind, source="stream",
+                summary=f"stream {self.name!r} batch {i} skipped")
             if env_bool("TFT_STREAM_FAIL_FAST", False):
                 raise
             _log.error(
@@ -455,6 +463,17 @@ class StreamHandle:
         # batch boundaries are the timeline's beat on streaming-only
         # processes (interval-gated; off-interval cost is one compare)
         _timeline.maybe_sample()
+        if self._agg is not None and outputs:
+            # durable query history: a window EMIT is the stream's
+            # query-terminal moment (committed results left the
+            # runtime) — per emit, never per batch, so plain pass-
+            # through streams pay nothing here
+            from ..observability import history as _history
+            _history.record_finish(
+                f"{self.name}-b{i}", tenant=self.name, outcome="ok",
+                run_s=dt, total_s=dt, est_rows=rows, source="stream",
+                summary=f"stream {self.name!r} batch {i}: "
+                        f"{len(outputs)} window frame(s) emitted")
         for frame in outputs:
             self._deliver(frame)
 

@@ -165,6 +165,15 @@ def pytest_configure(config):
                    "scheduler/fabric accounting; strict vs always-on "
                    "modes (run-tests.sh --chaos runs this lane too)")
     config.addinivalue_line(
+        "markers", "history: durable query-history/post-mortem suite — "
+                   "checksummed append-only segments with rotation and "
+                   "retention, corrupt-segment cold behavior under "
+                   "fault injection, tft.history() filters and "
+                   "stitching, unclean-shutdown markers + "
+                   "tft.postmortem(), cross-restart tft.why(), "
+                   "TFT_HISTORY=0 bypass (run-tests.sh --history runs "
+                   "this lane standalone)")
+    config.addinivalue_line(
         "markers", "timing: wall-clock-sensitive deadline assertions — "
                    "margins are widened for loaded machines "
                    "(TFT_TIMING_MARGIN multiplies the bounds; "

@@ -563,7 +563,7 @@ class TestMetricsConformance:
             # the sweep must actually cover the fleet
             for expected in ("flight", "serve.slo", "plan.adaptive",
                              "mesh", "memory", "relational", "stream",
-                             "perf", "timeline"):
+                             "perf", "timeline", "history"):
                 assert expected in providers, providers
             assert any(p.startswith("serve:") for p in providers)
             text = metrics.metrics_text()

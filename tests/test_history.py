@@ -1,0 +1,487 @@
+"""Durable query-history / crash post-mortem suite (tier-1; marker
+``history``; ``run-tests.sh --history``).
+
+The load-bearing contracts:
+
+- every finished query folds into checksummed append-only segments
+  (``TFT_HISTORY_DIR``), rotated at ``TFT_HISTORY_MAX_BYTES`` with the
+  ``TFT_HISTORY_RETENTION`` newest kept; ``TFT_HISTORY=0`` bypasses the
+  recording hooks at one env check;
+- COLD-NEVER-WRONG: a bit-rotted or truncated segment is counted,
+  flight-recorded (``history.segment_corrupt``), and unlinked — the
+  archive returns fewer records, never wrong ones, and a kill
+  mid-append leaves every PRIOR segment readable;
+- ``tft.history()`` stitches per-attempt records (a query migrated
+  across fabric workers reads as one record with its worker path) and
+  filters by tenant / fingerprint prefix / outcome / since / slow_only;
+- ``tft.why(qid)`` falls through ring → flight dumps → durable history,
+  so a causal chain survives ring rotation AND a process restart, with
+  ``TFT_TRACE`` off;
+- a ``running-<pid>`` marker whose pid is dead means an unclean
+  shutdown: counted, flight-recorded, surfaced by ``tft.postmortem()``
+  / ``doctor()`` / ``health()``;
+- the flight-dump file keeps only the newest ``TFT_FLIGHT_DUMP_KEEP``
+  snapshot sections (evictions counted) instead of growing forever;
+- the restart drill: a mixed serve workload hard-killed with SIGKILL
+  restarts into a process where ``postmortem()`` flags the unclean
+  shutdown, ``history()`` returns every completed query's record with
+  its cost vector and outcome, and ``why(qid)`` reconstructs the
+  pre-kill causal chain from durable state alone.
+"""
+
+import json
+import os
+import signal
+import struct
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import tensorframes_tpu as tft
+from conftest import timing_margin
+from tensorframes_tpu.observability import decisions, flight, health
+from tensorframes_tpu.observability import history as hist
+from tensorframes_tpu.observability import metrics
+from tensorframes_tpu.resilience import faults
+from tensorframes_tpu.serve import QueryScheduler, TenantQuota
+from tensorframes_tpu.utils.tracing import counters
+
+pytestmark = pytest.mark.history
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch, tmp_path):
+    for var in ("TFT_HISTORY", "TFT_HISTORY_MAX_BYTES",
+                "TFT_HISTORY_RETENTION", "TFT_HISTORY_DECISIONS",
+                "TFT_FLIGHT", "TFT_FLIGHT_DUMP", "TFT_FLIGHT_DUMP_KEEP",
+                "TFT_SLOW_QUERY_MS"):
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.setenv("TFT_HISTORY_DIR", str(tmp_path / "hist"))
+    faults.reset()
+    flight.clear()
+    hist.clear()
+    yield
+    faults.reset()
+    flight.clear()
+    hist.clear()
+
+
+def _seg_paths():
+    d = hist.active_dir()
+    return sorted(os.path.join(d, n) for n in os.listdir(d)
+                  if n.startswith("seg-") and n.endswith(".hist"))
+
+
+# ---------------------------------------------------------------------------
+# framing + round-trip
+# ---------------------------------------------------------------------------
+
+class TestFraming:
+    def test_round_trip_preserves_the_record(self):
+        assert hist.record_finish(
+            "q-rt", tenant="acme", fingerprint="fp-abc123",
+            outcome="completed", worker="w0",
+            cost={"compute_s": 0.5, "bytes_out": 1024},
+            queued_s=0.01, run_s=0.5, total_s=0.51,
+            est_rows=100, est_bytes=800, source="serve",
+            summary="round trip")
+        recs = tft.history()
+        assert len(recs) == 1
+        r = recs[0]
+        assert r["query"] == "q-rt"
+        assert r["tenant"] == "acme"
+        assert r["fingerprint"] == "fp-abc123"
+        assert r["outcome"] == "completed"
+        assert r["worker"] == "w0"
+        assert r["cost"] == {"compute_s": 0.5, "bytes_out": 1024}
+        assert r["total_s"] == pytest.approx(0.51)
+        assert r["est_rows"] == 100
+
+    def test_on_disk_frame_is_magic_length_sha(self):
+        import hashlib
+        hist.record_finish("q-frame", outcome="ok")
+        (path,) = _seg_paths()
+        with open(path, "rb") as f:
+            data = f.read()
+        assert data.startswith(b"TFTH\x01")
+        (plen,) = struct.unpack(">I", data[5:9])
+        digest, payload = data[9:41], data[41:41 + plen]
+        assert len(payload) == plen and not data[41 + plen:]
+        assert hashlib.sha256(payload).digest() == digest
+        assert json.loads(payload)["query"] == "q-frame"
+
+    def test_bypass_env_records_nothing(self, monkeypatch):
+        monkeypatch.setenv("TFT_HISTORY", "0")
+        assert hist.record_finish("q-off", outcome="ok") is False
+        monkeypatch.delenv("TFT_HISTORY")
+        assert tft.history() == []
+
+    def test_no_dir_no_persist_is_off(self, monkeypatch):
+        monkeypatch.delenv("TFT_HISTORY_DIR")
+        hist.clear()
+        if hist.active_dir() is None:  # a live persist tier may supply one
+            assert hist.record_finish("q-nodir", outcome="ok") is False
+            assert hist.stats()["enabled"] is False
+
+    def test_decision_digest_is_bounded(self, monkeypatch):
+        monkeypatch.setenv("TFT_HISTORY_DECISIONS", "2")
+        decs = [{"kind": f"serve.k{i % 3}", "ts": float(i), "seq": i}
+                for i in range(5)]
+        hist.record_finish("q-digest", outcome="ok", decisions=decs)
+        (r,) = tft.history()
+        assert len(r["decisions"]) == 2
+        assert r["decisions"][-1]["seq"] == 4  # newest kept
+        assert sum(r["decision_kinds"].values()) == 5
+        assert r["decisions_dropped"] == 3
+
+
+# ---------------------------------------------------------------------------
+# rotation + retention
+# ---------------------------------------------------------------------------
+
+class TestRotationRetention:
+    def test_rotation_at_max_bytes(self, monkeypatch):
+        monkeypatch.setenv("TFT_HISTORY_MAX_BYTES", "1")
+        for i in range(4):
+            hist.record_finish(f"q-rot{i}", outcome="ok")
+        assert len(_seg_paths()) == 4  # one record per segment
+        assert len(tft.history()) == 4
+
+    def test_retention_evicts_oldest(self, monkeypatch):
+        monkeypatch.setenv("TFT_HISTORY_MAX_BYTES", "1")
+        monkeypatch.setenv("TFT_HISTORY_RETENTION", "3")
+        ev0 = hist.stats()["evictions"]
+        for i in range(8):
+            hist.record_finish(f"q-ret{i}", outcome="ok")
+        assert len(_seg_paths()) <= 3
+        assert hist.stats()["evictions"] - ev0 >= 5
+        qids = [r["query"] for r in tft.history()]
+        assert "q-ret7" in qids and "q-ret0" not in qids
+
+
+# ---------------------------------------------------------------------------
+# cold-never-wrong
+# ---------------------------------------------------------------------------
+
+class TestColdNeverWrong:
+    def _two_segments(self, monkeypatch):
+        monkeypatch.setenv("TFT_HISTORY_MAX_BYTES", "1")
+        hist.record_finish("q-old", outcome="ok")
+        hist.record_finish("q-new", outcome="ok")
+        paths = _seg_paths()
+        assert len(paths) == 2
+        return paths
+
+    def test_bit_rot_sends_segment_cold_earlier_readable(
+            self, monkeypatch):
+        old_seg, new_seg = self._two_segments(monkeypatch)
+        c0 = hist.stats()["corrupt_segments"]
+        with open(new_seg, "rb") as f:
+            data = bytearray(f.read())
+        data[-1] ^= 0x01  # rot inside the payload: checksum must catch
+        with open(new_seg, "wb") as f:
+            f.write(bytes(data))
+        qids = [r["query"] for r in tft.history()]
+        assert qids == ["q-old"]  # fewer records, never wrong ones
+        assert hist.stats()["corrupt_segments"] - c0 == 1
+        assert not os.path.exists(new_seg), "cold segment not unlinked"
+        recs = flight.recent(kind="history.segment_corrupt")
+        assert recs and "sha256" in recs[-1]["why"]
+
+    def test_kill_mid_append_prior_segments_readable(self, monkeypatch):
+        # a torn tail is what a SIGKILL inside the one write() leaves:
+        # the newest segment goes cold, every prior one stays readable
+        old_seg, new_seg = self._two_segments(monkeypatch)
+        with open(old_seg, "rb") as f:
+            frame = f.read()
+        with open(new_seg, "ab") as f:
+            f.write(frame[:len(frame) // 2])  # torn half-record
+        qids = [r["query"] for r in tft.history()]
+        assert qids == ["q-old"]
+        assert not os.path.exists(new_seg)
+
+    def test_garbage_header_cold(self, monkeypatch):
+        _, new_seg = self._two_segments(monkeypatch)
+        with open(new_seg, "wb") as f:
+            f.write(b"not a framed segment")
+        assert [r["query"] for r in tft.history()] == ["q-old"]
+
+    def test_disk_fault_corruption_mode(self):
+        # the chaos drill's disk site, corruption-shaped (persist.py
+        # idiom): bytes read fine, one bit flipped — checksum catches
+        hist.record_finish("q-chaos", outcome="ok")
+        c0 = hist.stats()["corrupt_segments"]
+        with faults.inject("disk", message="injected corrupt segment"):
+            assert tft.history() == []
+        assert hist.stats()["corrupt_segments"] - c0 == 1
+        assert _seg_paths() == []  # consumed cold
+        assert counters.get("history.segments_corrupt") >= 1
+
+    def test_write_failure_degrades_never_raises(self, monkeypatch):
+        monkeypatch.setenv("TFT_HISTORY_DIR", "/proc/nonexistent/hist")
+        hist.clear()
+        e0 = hist.stats()["write_errors"]
+        assert hist.record_finish("q-nowrite", outcome="ok") is False
+        # the unwritable dir is caught at _ensure_dir (returns None, no
+        # error counted) — both shapes are "degrade, never raise"
+        assert hist.stats()["write_errors"] - e0 in (0, 1)
+
+
+# ---------------------------------------------------------------------------
+# stitching + filters
+# ---------------------------------------------------------------------------
+
+class TestStitchingAndFilters:
+    def test_migrated_query_reads_as_one_record(self):
+        hist.record_finish("q-mig", tenant="t", outcome="migrated",
+                           worker="w0", source="fabric")
+        hist.record_finish("q-mig", tenant="t", outcome="completed",
+                           worker="w1", total_s=1.5)
+        (r,) = tft.history()
+        assert r["outcome"] == "completed"
+        assert r["workers"] == ["w0", "w1"]
+        assert r["migrations"] == 1
+
+    def test_filters(self, monkeypatch):
+        monkeypatch.setenv("TFT_SLOW_QUERY_MS", "1000")
+        hist.record_finish("q-a", tenant="a", fingerprint="fp-aaa",
+                           outcome="completed", total_s=0.1)
+        hist.record_finish("q-b", tenant="b", fingerprint="fp-bbb",
+                           outcome="failed", total_s=2.0)
+        assert [r["query"] for r in tft.history(tenant="a")] == ["q-a"]
+        assert [r["query"]
+                for r in tft.history(fingerprint="fp-b")] == ["q-b"]
+        assert [r["query"]
+                for r in tft.history(outcome="failed")] == ["q-b"]
+        assert [r["query"]
+                for r in tft.history(slow_only=True)] == ["q-b"]
+        all_ts = [r["ts"] for r in tft.history()]
+        assert [r["query"] for r in tft.history(since=max(all_ts))] \
+            == ["q-b"]
+        assert len(tft.history(limit=1)) == 1
+
+
+# ---------------------------------------------------------------------------
+# serve integration: the scheduler fold site
+# ---------------------------------------------------------------------------
+
+class TestServeFold:
+    def test_completed_queries_archive_with_cost_and_decisions(self):
+        with QueryScheduler(quotas={"t": TenantQuota()}, workers=1,
+                            name="histserve") as s:
+            fr = tft.frame({"x": np.arange(16.0)}, num_partitions=2)
+            futs = [s.submit(fr, lambda x: {"z": x + 1.0}, tenant="t")
+                    for _ in range(3)]
+            for f in futs:
+                f.result(timeout=timing_margin(30))
+        recs = tft.history(outcome="completed")
+        assert len(recs) == 3
+        for r in recs:
+            assert r["tenant"] == "t"
+            assert r["source"] == "serve"
+            assert "cost" in r
+            assert "serve.finish" in r.get("decision_kinds", {})
+
+
+# ---------------------------------------------------------------------------
+# why() fall-through
+# ---------------------------------------------------------------------------
+
+class TestWhyFallthrough:
+    def test_why_reads_archive_after_ring_rotation(self):
+        with flight.scope("q-why"):
+            flight.record("serve.start", query="q-why", tenant="t",
+                          queue_wait_s=0.0)
+            flight.record("serve.finish", query="q-why", outcome="ok",
+                          latency_s=0.2)
+        hist.record_finish("q-why", tenant="t", outcome="completed",
+                           total_s=0.2, worker="w0",
+                           decisions=flight.for_query("q-why"))
+        flight.clear()  # the ring forgets; the archive must not
+        out = tft.why("q-why")
+        assert "durable history" in out
+        assert "completed" in out and "w0" in out
+        assert "archived decision" in out and "serve.finish" in out
+
+    def test_why_unknown_query_names_all_sources(self):
+        out = tft.why("q-never-ran")
+        assert "durable history" in out
+
+    def test_ring_still_wins_when_live(self):
+        flight.record("serve.start", query="q-live", tenant="t",
+                      queue_wait_s=0.0)
+        assert "flight ring" in tft.why("q-live")
+
+
+# ---------------------------------------------------------------------------
+# unclean shutdown + postmortem
+# ---------------------------------------------------------------------------
+
+def _dead_pid():
+    p = subprocess.Popen([sys.executable, "-c", "pass"])
+    p.wait()
+    return p.pid
+
+
+class TestUncleanShutdown:
+    def test_stale_marker_of_dead_pid_is_detected(self):
+        d = hist.active_dir()
+        os.makedirs(d, exist_ok=True)
+        pid = _dead_pid()
+        with open(os.path.join(d, f"running-{pid}.marker"), "w") as f:
+            f.write(json.dumps({"pid": pid, "started_ts": 123.0,
+                                "worker": "w9"}))
+        u0 = hist.stats()["unclean_shutdowns"]
+        hist.clear()  # a fresh consumer over the same dir
+        info = hist.unclean_shutdown()
+        assert info is not None and info["pid"] == pid
+        assert info["worker"] == "w9"
+        assert hist.stats()["unclean_shutdowns"] - u0 == 1
+        assert flight.recent(kind="history.unclean_shutdown")
+        assert not os.path.exists(
+            os.path.join(d, f"running-{pid}.marker"))  # consumed
+        pm = tft.postmortem()
+        assert "UNCLEAN SHUTDOWN" in pm and str(pid) in pm
+        # surfaced by health() warnings and doctor()
+        assert any("UNCLEAN" in w.upper()
+                   for w in health()["warnings"])
+        assert "tft.postmortem()" in decisions.doctor()
+
+    def test_own_marker_is_not_unclean(self):
+        hist.record_finish("q-own", outcome="ok")  # drops our marker
+        hist.clear()
+        assert hist.unclean_shutdown() is None
+        assert "no unclean shutdown" in tft.postmortem()
+
+    def test_postmortem_renders_history_tail(self):
+        hist.record_finish("q-pm", tenant="t", outcome="completed",
+                           total_s=0.3, worker="w0")
+        pm = tft.postmortem()
+        assert "q-pm" in pm and "completed" in pm
+
+
+# ---------------------------------------------------------------------------
+# satellite: flight-dump pruning
+# ---------------------------------------------------------------------------
+
+class TestDumpPrune:
+    def _sections(self, path):
+        with open(path) as f:
+            return [json.loads(s) for s in f
+                    if s.strip()
+                    and json.loads(s).get("type") == "flight_dump"]
+
+    def test_keep_newest_sections(self, monkeypatch, tmp_path):
+        path = str(tmp_path / "dump.jsonl")
+        monkeypatch.setenv("TFT_FLIGHT_DUMP", path)
+        monkeypatch.setenv("TFT_FLIGHT_DUMP_KEEP", "2")
+        ev0 = flight.stats()["dump_evictions"]
+        for i in range(5):
+            flight.record("test.kind", i=i)
+            flight.dump(reason=f"r{i}")
+        heads = self._sections(path)
+        assert len(heads) == 2
+        assert [h["reason"] for h in heads] == ["r3", "r4"]
+        assert flight.stats()["dump_evictions"] - ev0 == 3
+        # the surviving sections still parse through load_dumps
+        assert flight.load_dumps(path)
+
+    def test_keep_zero_disables_pruning(self, monkeypatch, tmp_path):
+        path = str(tmp_path / "dump0.jsonl")
+        monkeypatch.setenv("TFT_FLIGHT_DUMP", path)
+        monkeypatch.setenv("TFT_FLIGHT_DUMP_KEEP", "0")
+        for i in range(3):
+            flight.record("test.kind", i=i)
+            flight.dump(reason=f"r{i}")
+        assert len(self._sections(path)) == 3
+
+
+# ---------------------------------------------------------------------------
+# metrics + surfaces
+# ---------------------------------------------------------------------------
+
+class TestSurfaces:
+    def test_metrics_provider_renders(self):
+        hist.record_finish("q-met", outcome="ok")
+        text = metrics.metrics_text()
+        assert "tft_history_records_total" in text
+        assert "tft_history_segments" in text
+        assert "tft_flight_dump_evictions_total" in text
+
+    def test_health_section(self):
+        hist.record_finish("q-health", outcome="ok")
+        hs = health()["history"]
+        assert hs["enabled"] and hs["segments"] >= 1
+
+    def test_doctor_names_the_archive(self):
+        hist.record_finish("q-doc", outcome="ok")
+        assert "history  :" in decisions.doctor()
+
+
+# ---------------------------------------------------------------------------
+# the restart drill (acceptance): hard-kill a serve workload, restart
+# ---------------------------------------------------------------------------
+
+class TestRestartDrill:
+    def test_sigkill_then_postmortem_history_why(self, tmp_path,
+                                                 monkeypatch):
+        d = str(tmp_path / "drill-hist")
+        child = textwrap.dedent("""
+            import os, signal
+            import numpy as np
+            import tensorframes_tpu as tft
+            from tensorframes_tpu.serve import (QueryScheduler,
+                                                TenantQuota)
+
+            sched = QueryScheduler(quotas={"a": TenantQuota(),
+                                           "b": TenantQuota()},
+                                   workers=2, name="drill")
+            futs = []
+            for i in range(6):
+                fr = tft.frame({"x": np.arange(64.0) + i},
+                               num_partitions=2)
+                futs.append(sched.submit(
+                    fr, lambda x: {"z": x + 1.0},
+                    tenant="a" if i % 2 else "b"))
+            for f in futs:
+                f.result(timeout=60)
+            # quiesce: the future resolves a hair before _finish's
+            # archive append; wait for all 6 records to be durable so
+            # the SIGKILL tests crash-after-completion, not a race
+            import time
+            for _ in range(200):
+                if len(tft.history(outcome="completed")) >= 6:
+                    break
+                time.sleep(0.05)
+            print("DRILL-DONE", flush=True)
+            os.kill(os.getpid(), signal.SIGKILL)
+        """)
+        env = dict(os.environ)
+        env.update({"JAX_PLATFORMS": "cpu", "TFT_HISTORY_DIR": d})
+        env.pop("TFT_HISTORY", None)
+        proc = subprocess.run([sys.executable, "-c", child], env=env,
+                              capture_output=True, text=True,
+                              timeout=timing_margin(300))
+        assert "DRILL-DONE" in proc.stdout, proc.stderr[-2000:]
+        assert proc.returncode == -signal.SIGKILL
+
+        # the restart: a fresh consumer over the same dir, tracing off,
+        # this process's flight ring knowing nothing about the child
+        monkeypatch.setenv("TFT_HISTORY_DIR", d)
+        hist.clear()
+        flight.clear()
+        pm = tft.postmortem()
+        assert "UNCLEAN SHUTDOWN" in pm
+        recs = tft.history(outcome="completed")
+        assert len(recs) == 6
+        for r in recs:
+            assert r["outcome"] == "completed"
+            assert "cost" in r, "cost vector missing from the archive"
+            assert r["tenant"] in ("a", "b")
+        qid = recs[0]["query"]
+        out = tft.why(qid)
+        assert "durable history" in out
+        assert "archived decision" in out and "serve.finish" in out

@@ -10,14 +10,17 @@
 2. No ``except Exception: pass`` under ``tensorframes_tpu/observability/``,
    — a rule that now covers the always-on flight-recorder layer
    (``observability/flight.py``, ``decisions.py``, ``slo.py``,
-   ``health.py``) and the performance sentinel
-   (``observability/timeline.py``, ``baseline.py``): a silently
+   ``health.py``), the performance sentinel
+   (``observability/timeline.py``, ``baseline.py``), and the durable
+   query history (``observability/history.py``): a silently
    swallowed ring write, dump, SLO burn evaluation, health probe,
-   timeline sample, or baseline update/persist would erase exactly the
-   post-mortem evidence the layer exists to keep (a flight recorder
-   that loses its own records without a log line is worse than none,
-   and a regression detector that silently stops calibrating reports
-   "all fast" forever) —
+   timeline sample, baseline update/persist, or history append /
+   segment walk would erase exactly the post-mortem evidence the
+   layer exists to keep (a flight recorder that loses its own records
+   without a log line is worse than none, a regression detector that
+   silently stops calibrating reports "all fast" forever, and a crash
+   archive that drops a record silently answers the next post-mortem
+   with a hole exactly where the interesting query was) —
    ``tensorframes_tpu/serve/``, ``tensorframes_tpu/stream/``, or
    ``tensorframes_tpu/parallel/``: the observability layer is the last
    place a failure may vanish silently — an event sink or metrics
